@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 synthetic throughput (the reference's headline benchmark).
+
+Mirrors ``examples/tensorflow2_synthetic_benchmark.py`` /
+``examples/pytorch_synthetic_benchmark.py`` from the reference (random data,
+forward+backward+optimizer step, images/sec). Baseline for ``vs_baseline``:
+the reference's published tf_cnn_benchmarks number — ResNet-101, bs=64 on 16
+Pascal GPUs ≈ 1656.82 images/sec ⇒ ~103.55 images/sec/GPU (docs/benchmarks.rst:38-41).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 1656.82 / 16.0  # reference, per accelerator
+
+BATCH_PER_CHIP = 32
+IMAGE_SIZE = 224
+WARMUP = 5
+ITERS = 20
+
+
+def main():
+    hvd.init()
+    n = hvd.size()
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    global_batch = BATCH_PER_CHIP * n
+    images = jax.random.normal(
+        rng, (global_batch, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.bfloat16)
+    labels = jax.random.randint(rng, (global_batch,), 0, 1000)
+
+    variables = model.init(rng, images[:1], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
+    opt_state = opt.init(params)
+
+    def train_step(params, batch_stats, opt_state, batch):
+        imgs, lbls = batch
+
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, imgs, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, lbls).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_stats = hvd.grouped_allreduce(new_stats, op=hvd.Average)
+        return params, new_stats, opt_state, hvd.allreduce(loss, op=hvd.Average)
+
+    step = hvd.run_step(
+        train_step,
+        in_specs=(hvd.REPLICATED, hvd.REPLICATED, hvd.REPLICATED,
+                  (hvd.batch_spec(), hvd.batch_spec())),
+        out_specs=hvd.REPLICATED,
+        donate_argnums=(0, 1, 2))
+
+    batch = hvd.shard_batch((images, labels))
+    params = hvd.replicate(params)
+    batch_stats = hvd.replicate(batch_stats)
+    opt_state = hvd.replicate(opt_state)
+
+    for _ in range(WARMUP):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = global_batch * ITERS / dt
+    per_chip = images_per_sec / n
+    print(json.dumps({
+        "metric": "ResNet-50 synthetic training throughput per chip "
+                  f"(bf16, bs={BATCH_PER_CHIP}/chip, {n} chip(s))",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

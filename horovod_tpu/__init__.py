@@ -1,0 +1,97 @@
+"""horovod_tpu — TPU-native distributed deep-learning training framework.
+
+A ground-up rebuild of the capability surface of Horovod 0.20 + the IST-DASLab
+gradient-compression fork (reference: ``/root/reference``), designed for TPUs:
+collectives are XLA programs over ICI/DCN (JAX ``shard_map``/``pjit``), compression
+kernels are Pallas, and the eager multi-process runtime is a native C++ controller
+with rank-0 negotiation, tensor fusion and ring reduction over TCP — no MPI/NCCL.
+
+Public surface mirrors ``import horovod.torch as hvd`` (reference
+``horovod/torch/__init__.py``) plus TPU-first additions (mesh/step helpers,
+reducescatter, sequence/context parallel primitives).
+"""
+
+__version__ = "0.1.0"
+
+# Topology / lifecycle (reference: horovod/common/basics.py).
+from .runtime import (init, shutdown, is_initialized, rank, size, local_rank,
+                      local_size, cross_rank, cross_size, is_homogeneous, mesh,
+                      dp_axis, mode)
+
+# Collectives (reference: horovod/torch/mpi_ops.py).
+from .ops.collectives import (
+    ReduceOp, Average, Sum, Adasum, Min, Max, Product,
+    allreduce, allreduce_async, grouped_allreduce,
+    allgather, allgather_async, broadcast, broadcast_async,
+    alltoall, alltoall_async, reducescatter, join, poll, synchronize,
+    release_handle,
+    # In-step primitives (inside shard_map / run_step).
+    allreduce_p, allgather_p, broadcast_p, alltoall_p, reducescatter_p,
+    ppermute_p, rank_in_step, size_in_step, in_named_trace,
+)
+
+# Optimizer / gradient API (reference: horovod/torch/optimizer.py,
+# horovod/tensorflow/__init__.py DistributedGradientTape).
+from .parallel.optimizer import (DistributedOptimizer, DistributedGradientTape,
+                                 allreduce_gradients, broadcast_parameters,
+                                 broadcast_optimizer_state)
+
+# Compression (reference: horovod/torch/compression.py + IST fork subsystem).
+from .compression import Compression
+
+# Object collectives (reference: horovod/torch/functions.py).
+from .functions import broadcast_object, allgather_object
+
+# Compiled-step helpers (TPU-native).
+from .step import (run_step, data_parallel_step, shard_batch, replicate,
+                   batch_spec, REPLICATED)
+
+from .exceptions import (HvdTpuInternalError, HostsUpdatedInterrupt,
+                         TensorShapeMismatchError, TensorDtypeMismatchError,
+                         DuplicateNameError, NotInitializedError)
+
+from . import elastic  # noqa: E402  (reference: horovod/torch/elastic.py)
+
+
+def mpi_threads_supported() -> bool:
+    """Signature parity with ``hvd.mpi_threads_supported()``
+    (reference ``basics.py``): there is no MPI here; returns False."""
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    """The native TCP controller fills gloo's role (process mode)."""
+    from . import runtime as _rt
+    return _rt.is_initialized() and _rt.mode() == "process"
+
+
+def gloo_built() -> bool:
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False

@@ -1,0 +1,5 @@
+"""Elastic fault-tolerant training (reference: horovod/runner/elastic/ +
+horovod/common/elastic.py). Full implementation lands with the elastic driver;
+the State/run API lives in horovod_tpu/elastic/state.py."""
+
+from .state import State, ObjectState, TpuState, run, run_fn  # noqa: F401

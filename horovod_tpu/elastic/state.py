@@ -1,0 +1,239 @@
+"""Elastic state management: commit / restore / sync and the retry loop.
+
+Reference: ``horovod/common/elastic.py`` — ``State`` (:60, commit/restore/sync +
+host-update checks), ``ObjectState`` (:109, pickled attr sync via
+``broadcast_object``), ``run`` (:147, the catch-restore-reset retry loop) — plus
+the torch flavor ``horovod/torch/elastic.py`` (``TorchState`` :51).
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .. import runtime
+from ..exceptions import HostsUpdatedInterrupt, HvdTpuInternalError
+from ..functions import broadcast_object
+from ..parallel.optimizer import broadcast_parameters
+from ..utils import logging as log
+
+
+class State:
+    """Base elastic state (reference: ``horovod/common/elastic.py:60``).
+
+    Subclasses implement ``save`` (snapshot to memory), ``restore`` (roll back to
+    last commit) and ``sync`` (broadcast from rank 0 to (re)joined workers).
+    """
+
+    def __init__(self, **kwargs):
+        self._host_messages: "queue.Queue" = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks: List[Callable[[], None]] = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        """Callbacks run after a reset event before training resumes
+        (reference :75)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self._host_messages = queue.Queue()
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res) -> None:
+        """Called by the worker notification service when the driver reports a
+        host-set change (reference :82)."""
+        self._host_messages.put((timestamp, update_res))
+
+    def commit(self) -> None:
+        """Save state and check for pending host updates (reference :87).
+        Raises :class:`HostsUpdatedInterrupt` when the world changed."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Drain host-update messages; raise ``HostsUpdatedInterrupt`` once all
+        ranks agree an update happened (reference :93-107 — the max-timestamp
+        allreduce keeps ranks in lockstep)."""
+        last_updated_timestamp = prev_timestamp = self._last_updated_timestamp
+        all_update = 0
+        while not self._host_messages.empty():
+            timestamp, update = self._host_messages.get()
+            if timestamp > last_updated_timestamp:
+                last_updated_timestamp = timestamp
+                all_update |= int(update)
+        from ..ops import collectives as C
+        # One MAX-allreduce over (prev, cur, update_flag) so every rank agrees
+        # on both whether to raise AND on skip_sync — a rank-local skip_sync
+        # would let ranks diverge on whether to run the sync() collective
+        # (the reference broadcasts the tuple from rank 0 for the same reason).
+        local = np.array([prev_timestamp, last_updated_timestamp, all_update],
+                         dtype=np.int64)
+        agreed = np.asarray(C.allreduce(local, op=C.ReduceOp.MAX,
+                                        name="elastic.host_updates"))
+        self._last_updated_timestamp = int(agreed[1])
+        if self._last_updated_timestamp > int(agreed[0]):
+            raise HostsUpdatedInterrupt(skip_sync=(int(agreed[2]) == 0))
+
+    # -- subclass hooks ----------------------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """State of picklable attributes, synced via ``broadcast_object``
+    (reference: ``horovod/common/elastic.py:109``)."""
+
+    def __init__(self, bcast_object=broadcast_object, **kwargs):
+        self._bcast_object = bcast_object
+        self._saved_state = kwargs
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        new_state = {}
+        for k in self._saved_state.keys():
+            new_state[k] = copy.deepcopy(getattr(self, k))
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0,
+                                        name="elastic.object_state")
+            for k, v in synced.items():
+                self._saved_state[k] = v
+                setattr(self, k, v)
+
+
+class TpuState(ObjectState):
+    """Elastic state holding JAX pytrees (params / optimizer state) plus
+    arbitrary picklable attrs — the TPU analog of ``TorchState``
+    (reference ``horovod/torch/elastic.py:51``).
+
+    Pytree snapshots are taken to host memory (``jax.device_get``) so a restore
+    survives runtime re-initialization / mesh rebuilds.
+    """
+
+    def __init__(self, params=None, opt_state=None, **kwargs):
+        self.params = params
+        self.opt_state = opt_state
+        self._tree_snapshot = None
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        self._tree_snapshot = jax.device_get((self.params, self.opt_state))
+        super().save()
+
+    def restore(self) -> None:
+        if self._tree_snapshot is not None:
+            self.params, self.opt_state = jax.tree.map(
+                np.asarray, self._tree_snapshot)
+        super().restore()
+
+    def sync(self) -> None:
+        if self.params is not None:
+            self.params = broadcast_parameters(self.params, root_rank=0)
+        if self.opt_state is not None:
+            self.opt_state = broadcast_parameters(self.opt_state, root_rank=0)
+        super().sync()
+
+
+def run_fn(func: Callable, reset: Callable) -> Callable:
+    """The elastic retry loop (reference: ``horovod/common/elastic.py:147``)::
+
+        on HvdTpuInternalError  -> restore last commit, reset, sync, retry
+        on HostsUpdatedInterrupt -> keep state, reset, (maybe) sync, retry
+    """
+
+    def wrapper(state: State, *args, **kwargs):
+        notification_manager.init()
+        notification_manager.register_listener(state)
+        skip_sync = False
+        try:
+            while True:
+                if not skip_sync:
+                    state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HvdTpuInternalError:
+                    log.warning("elastic: internal error — restoring last commit")
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    log.info("elastic: hosts updated — resetting")
+                    skip_sync = e.skip_sync
+                reset()
+                state.on_reset()
+        finally:
+            notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+def _reset() -> None:
+    """Re-initialize the runtime after a topology change, preserving the
+    original init arguments (mesh shape, axis names, mode)
+    (reference: ``horovod/torch/elastic.py:46`` shutdown+init)."""
+    runtime.reinit()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator for elastic training functions: ``hvd.elastic.run(train)(state)``
+    (reference: ``horovod/common/elastic.py:147``)."""
+    return run_fn(func, _reset)
+
+
+class _NotificationManager:
+    """Listener registry fed by the worker notification service
+    (reference: ``horovod/runner/elastic/worker.py`` WorkerNotificationManager).
+    The HTTP service that feeds it lands with the elastic driver; in-process
+    use (tests, SPMD mode) pushes updates directly via :meth:`handle_hosts_updated`.
+    """
+
+    def __init__(self):
+        self._listeners: List[State] = []
+        self._initialized = False
+
+    def init(self) -> None:
+        if self._initialized:
+            return
+        self._initialized = True
+        try:
+            from ..runner.elastic_worker import start_notification_service
+            start_notification_service(self)
+        except Exception:
+            # No driver / not launched elastically: local-only notifications.
+            pass
+
+    def register_listener(self, state: State) -> None:
+        self._listeners.append(state)
+
+    def remove_listener(self, state: State) -> None:
+        if state in self._listeners:
+            self._listeners.remove(state)
+
+    def handle_hosts_updated(self, timestamp, update_res) -> None:
+        for listener in self._listeners:
+            listener.on_hosts_updated(timestamp, update_res)
+
+
+notification_manager = _NotificationManager()

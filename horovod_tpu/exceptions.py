@@ -1,0 +1,59 @@
+"""Framework exceptions.
+
+Reference: ``horovod/common/exceptions.py`` — ``HorovodInternalError`` (collective
+failure, triggers elastic restore) and ``HostsUpdatedInterrupt`` (driver-signalled
+topology change, triggers elastic reset without state rollback).
+"""
+
+from __future__ import annotations
+
+
+class HvdTpuInternalError(RuntimeError):
+    """Internal error raised when a collective fails.
+
+    Elastic mode (``horovod_tpu.elastic.run``) catches this, restores the last
+    committed state, and re-initialises the runtime — mirroring
+    ``HorovodInternalError`` (reference ``horovod/common/exceptions.py:20``).
+    """
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised when the elastic driver reports a host-set change.
+
+    Reference: ``horovod/common/elastic.py:73-93`` — raised at ``state.commit()`` /
+    ``check_host_updates()`` so every rank agrees on the restart point. Carries
+    ``skip_sync`` to tell the restart loop whether state re-broadcast is needed.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class TensorShapeMismatchError(ValueError):
+    """Mismatched shapes between ranks for a named collective.
+
+    Reference: controller validation in ``horovod/common/controller.cc:380-657``,
+    surfaced to tests as "Mismatched ... shapes" (``test/test_torch.py:435``).
+    """
+
+
+class TensorDtypeMismatchError(ValueError):
+    """Mismatched dtypes between ranks for a named collective
+    (reference: ``controller.cc:380-657``, ``test/test_torch.py:469``)."""
+
+
+class DuplicateNameError(ValueError):
+    """A tensor name was enqueued twice before completing.
+
+    Reference: ``DUPLICATE_NAME_ERROR`` (``horovod/common/common.h:214``,
+    ``tensor_queue.cc``), ``test/test_torch.py:525``.
+    """
+
+
+class NotInitializedError(RuntimeError):
+    """An API was called before ``init()`` (reference: basics.py check)."""
+
+    def __init__(self, what: str = "horovod_tpu"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first.")

@@ -1,0 +1,63 @@
+"""Object collectives: broadcast/allgather arbitrary picklable Python objects.
+
+Reference: ``horovod/torch/functions.py`` (``broadcast_object`` :186,
+``allgather_object`` :229) and the TF twins (``horovod/tensorflow/functions.py:59/:136``)
+— objects are cloudpickled into byte tensors, sizes exchanged first, then payloads.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import runtime
+from .ops import collectives as C
+
+
+def _serialize(obj: Any) -> np.ndarray:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+
+
+def _deserialize(arr: np.ndarray) -> Any:
+    return pickle.loads(np.asarray(arr, dtype=np.uint8).tobytes())
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Broadcast a picklable object from ``root_rank``
+    (reference: ``horovod/torch/functions.py:186``)."""
+    name = name or "broadcast_object"
+    if runtime.mode() == "process" and runtime.size() > 1:
+        payload = _serialize(obj) if runtime.rank() == root_rank else \
+            np.zeros(0, dtype=np.uint8)
+        sz = np.array([payload.size], dtype=np.int64)
+        sz = np.asarray(C.broadcast(sz, root_rank=root_rank, name=f"{name}.sz"))
+        if runtime.rank() != root_rank:
+            payload = np.zeros(int(sz[0]), dtype=np.uint8)
+        out = np.asarray(C.broadcast(payload, root_rank=root_rank, name=name))
+        return _deserialize(out)
+    # SPMD / single process: the controller already holds the object.
+    return obj
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Gather one picklable object per rank into a list ordered by rank
+    (reference: ``horovod/torch/functions.py:229``)."""
+    name = name or "allgather_object"
+    if runtime.mode() == "process" and runtime.size() > 1:
+        payload = _serialize(obj)
+        gathered = np.asarray(C.allgather(payload, name=name))
+        sizes = np.asarray(C.allgather(
+            np.array([payload.size], dtype=np.int64), name=f"{name}.sz"))
+        out, off = [], 0
+        for s in sizes.tolist():
+            out.append(_deserialize(gathered[off:off + int(s)]))
+            off += int(s)
+        return out
+    return [obj] * runtime.size() if runtime.mode() == "spmd" else [obj]

@@ -1,0 +1,7 @@
+"""Model zoo for benchmarks and examples (reference context: the models exercised
+by Horovod's examples/ and docs/benchmarks.rst)."""
+
+from .mlp import MLP  # noqa: F401
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,  # noqa: F401
+                     ResNet152)
+from .transformer import Transformer, default_attention  # noqa: F401

@@ -1,0 +1,25 @@
+"""MNIST-scale MLP — the "minimum end-to-end slice" model.
+
+Reference context: ``examples/pytorch_mnist.py`` (the reference's smallest
+end-to-end training example, used by BASELINE.json config 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.features[-1], dtype=self.dtype)(x)

@@ -1,0 +1,110 @@
+"""Decoder-only Transformer with pluggable attention — long-context flagship.
+
+No direct reference analog (Horovod is model-agnostic); this model exists so the
+framework's sequence/context-parallel mechanisms (ring attention,
+Ulysses-style all-to-all head parallelism — :mod:`horovod_tpu.parallel.ring_attention`,
+:mod:`horovod_tpu.parallel.ulysses`) have a first-class consumer, and to serve as a
+second benchmark family. bfloat16 compute, RoPE, pre-norm.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def default_attention(q, k, v, causal: bool = True):
+    """Plain softmax attention. q/k/v: [B, S, H, D]. Computed in fp32 softmax."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), dtype=bool), klen - qlen)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def rope(x, positions):
+    """Rotary position embedding. x: [B, S, H, D]; positions: [B, S]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class Attention(nn.Module):
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, x, positions):
+        dense = functools.partial(nn.DenseGeneral, dtype=self.dtype,
+                                  param_dtype=jnp.float32)
+        q = dense(features=(self.num_heads, self.head_dim), name="q")(x)
+        k = dense(features=(self.num_heads, self.head_dim), name="k")(x)
+        v = dense(features=(self.num_heads, self.head_dim), name="v")(x)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        out = self.attn_fn(q, k, v, causal=True)
+        return nn.DenseGeneral(features=x.shape[-1], axis=(-2, -1),
+                               dtype=self.dtype, param_dtype=jnp.float32,
+                               name="o")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = x + Attention(self.num_heads, self.head_dim, self.dtype,
+                          self.attn_fn)(h, positions)
+        h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, param_dtype=jnp.float32)(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM. ``attn_fn`` swaps in ring attention for context parallelism."""
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 64
+    embed_dim: int = 512
+    mlp_dim: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jnp.ndarray] = None):
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape)
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     param_dtype=jnp.float32, dtype=self.dtype)(tokens)
+        for _ in range(self.num_layers):
+            x = Block(self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
+                      self.attn_fn)(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          param_dtype=jnp.float32)(x)
+        return logits.astype(jnp.float32)

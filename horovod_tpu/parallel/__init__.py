@@ -1,0 +1,7 @@
+"""Parallelism strategies: data-parallel optimizer, Adasum, and the TPU-first
+sequence/context-parallel primitives (ring attention, Ulysses)."""
+
+from .optimizer import (DistributedOptimizer, DistributedGradientTape,  # noqa: F401
+                        allreduce_gradients, broadcast_parameters,
+                        broadcast_optimizer_state)
+from .adasum import adasum_p, adasum_reference  # noqa: F401

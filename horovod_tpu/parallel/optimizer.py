@@ -1,0 +1,150 @@
+"""Distributed optimizer: data-parallel gradient reduction for optax.
+
+Reference surface: ``horovod/torch/optimizer.py`` (``_DistributedOptimizer`` :32 —
+per-parameter allreduce hooks, ``backward_passes_per_step`` accumulation,
+``synchronize()``; factory :383) and TF's ``DistributedOptimizer`` /
+``DistributedGradientTape`` (``horovod/tensorflow/__init__.py:290/:527``).
+
+TPU-native redesign: instead of per-parameter autograd hooks firing async
+allreduces that a background thread fuses, the whole gradient pytree is reduced
+inside the compiled training step — ``DistributedOptimizer`` is an
+``optax.GradientTransformation`` wrapper whose ``update`` allreduces gradients over
+the data-parallel mesh axis before the inner transform runs. Under ``jit`` XLA
+fuses/schedules these ``psum``s over ICI, which subsumes the reference's tensor
+fusion + cycle machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import optax
+
+from .. import runtime
+from ..ops import collectives as C
+
+
+def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                        compression=None, prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0,
+                        axis: Optional[str] = None):
+    """Allreduce a gradient pytree across the data-parallel axis.
+
+    Functional analog of ``DistributedGradientTape.gradient``
+    (reference ``horovod/tensorflow/__init__.py:509-527``): use directly after
+    ``jax.grad`` when not using :func:`DistributedOptimizer`.
+    """
+    return C.grouped_allreduce(grads, name="grads", op=op,
+                               compression=compression,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor, axis=axis)
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         named_parameters: Any = None,
+                         compression=None,
+                         backward_passes_per_step: int = 1,
+                         op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                         gradient_predivide_factor: float = 1.0,
+                         prescale_factor: Optional[float] = None,
+                         postscale_factor: Optional[float] = None,
+                         axis: Optional[str] = None
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates use cross-rank-reduced gradients.
+
+    Mirrors ``hvd.DistributedOptimizer`` (reference ``horovod/torch/optimizer.py:383``):
+
+    * ``op``: ``Average`` (default), ``Sum`` or ``Adasum``.
+    * ``backward_passes_per_step`` > 1 accumulates that many gradient pytrees
+      locally before one fused allreduce + inner update (reference
+      ``optimizer.py:67/:104-150``), implemented with ``optax.MultiSteps``.
+    * ``gradient_predivide_factor`` splits the averaging between pre- and
+      post-reduction scaling (reference ``optimizer.py:383`` factory docs):
+      prescale = 1/(size/f), postscale = 1/f.
+    * ``compression``: e.g. ``hvd.Compression.fp16`` — wire-dtype compression.
+    * ``named_parameters`` is accepted for signature parity and ignored (optax is
+      functional; parameter identity comes from the pytree).
+
+    Works inside ``jit``/``shard_map`` (collective lowers to ``lax.psum``) and
+    eagerly in either runtime mode.
+    """
+    if gradient_predivide_factor != 1.0:
+        if op != C.ReduceOp.AVERAGE:
+            raise ValueError(
+                "gradient_predivide_factor not supported with op != Average")
+        # Average == prescale 1/size; split it as 1/(size/f) pre, 1/f post
+        # (reference: horovod/torch/optimizer.py factory).
+        pre = None  # resolved at update time (size may come from the axis)
+        post = 1.0 / gradient_predivide_factor
+    else:
+        pre = prescale_factor
+        post = postscale_factor
+
+    def _reduce(grads):
+        eff_op = op
+        pre_f = 1.0 if pre is None else pre
+        post_f = 1.0 if post is None else post
+        if gradient_predivide_factor != 1.0:
+            n = C.size_in_step(axis) if C.in_named_trace(axis) else runtime.size()
+            pre_f = gradient_predivide_factor / n
+            eff_op = C.ReduceOp.SUM
+        return C.grouped_allreduce(grads, name="grads", op=eff_op,
+                                   compression=compression,
+                                   prescale_factor=pre_f,
+                                   postscale_factor=post_f, axis=axis)
+
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(grads, state, params=None, **extra):
+        reduced = _reduce(grads)
+        return optimizer.update(reduced, state, params, **extra)
+
+    wrapped = optax.GradientTransformation(init_fn, update_fn)
+    if backward_passes_per_step > 1:
+        return optax.MultiSteps(wrapped,
+                                every_k_schedule=backward_passes_per_step)
+    return wrapped
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         axis: Optional[str] = None):
+    """Broadcast a parameter pytree from ``root_rank`` to all ranks
+    (reference: ``horovod/torch/functions.py:30``)."""
+    return jax.tree.map(
+        lambda p: C.broadcast(p, root_rank=root_rank, axis=axis), params)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              axis: Optional[str] = None):
+    """Broadcast optimizer state from ``root_rank``
+    (reference: ``horovod/torch/functions.py:62``). With optax, state is a pytree
+    — same mechanism as parameters (the reference needs torch-specific walking)."""
+    return jax.tree.map(
+        lambda p: C.broadcast(p, root_rank=root_rank, axis=axis), opt_state)
+
+
+class DistributedGradientTape:
+    """Callable-style parity shim for TF's ``DistributedGradientTape``
+    (reference ``horovod/tensorflow/__init__.py:527``): wraps a ``jax.grad``-style
+    function so returned gradients are allreduced."""
+
+    def __init__(self, grad_fn, op: C.ReduceOp = C.ReduceOp.AVERAGE,
+                 compression=None, axis: Optional[str] = None):
+        self._grad_fn = grad_fn
+        self._op = op
+        self._compression = compression
+        self._axis = axis
+
+    def __call__(self, *args, **kwargs):
+        out = self._grad_fn(*args, **kwargs)
+        if isinstance(out, tuple) and len(out) == 2:
+            # value_and_grad convention: (value, grads)
+            value, grads = out
+            return value, allreduce_gradients(
+                grads, op=self._op, compression=self._compression,
+                axis=self._axis)
+        return allreduce_gradients(out, op=self._op,
+                                   compression=self._compression,
+                                   axis=self._axis)

@@ -1,0 +1,281 @@
+"""Topology runtime: ``init`` / ``rank`` / ``size`` / device mesh.
+
+Reference surface: ``horovod/common/basics.py:22`` (``HorovodBasics`` — ``init``,
+``shutdown``, ``rank``, ``size``, ``local_rank``, ``local_size``, ``cross_rank``,
+``cross_size``, ``is_initialized``, ``is_homogeneous``) backed by the C API in
+``horovod/common/operations.cc:705-913``.
+
+TPU-native redesign
+-------------------
+The reference assumes one process per accelerator, ranks negotiated by MPI/Gloo.
+On TPU the native regime is SPMD: one process per *host*, all chips driven through a
+``jax.sharding.Mesh``, collectives compiled by XLA onto ICI. We therefore support two
+modes, selected automatically:
+
+* **spmd** (default): ``init()`` builds a mesh over all global devices (multi-host via
+  ``jax.distributed``). A *rank* is a device; ``size()`` is the global device count;
+  ``rank()`` at host level is this process's first device index (so ``rank() == 0``
+  checkpoint guards behave like Horovod's). Inside a step wrapped by
+  :func:`horovod_tpu.run_step` (shard_map over the mesh), ``rank_in_step()`` gives the
+  per-device rank.
+* **process**: Horovod-parity one-rank-per-process mode, selected when the
+  ``hvdrun`` launcher exported ``HVDTPU_RANK``/``HVDTPU_SIZE`` (reference env
+  injection: ``horovod/runner/gloo_run.py:70-95``). Eager named-tensor collectives run
+  through the native C++ controller (``horovod_tpu/native``), no MPI/NCCL.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .exceptions import NotInitializedError
+from .utils import envvars as ev
+from .utils import logging as log
+
+# The default mesh axis name for data parallelism. Additional axes ("tp", "sp",
+# "pp", "ep") are created on demand via init(mesh_shape=...).
+DP_AXIS = "dp"
+
+
+@dataclasses.dataclass
+class _RuntimeState:
+    initialized: bool = False
+    mode: str = "spmd"  # "spmd" | "process"
+    # Horovod-style topology (process mode: per-process; spmd: derived from devices).
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+    homogeneous: bool = True
+    # SPMD state.
+    mesh: Optional[object] = None  # jax.sharding.Mesh
+    axis_names: Tuple[str, ...] = (DP_AXIS,)
+    dp_axis: str = DP_AXIS
+    # Process-mode native controller handle (horovod_tpu.basics.NativeCore).
+    core: Optional[object] = None
+    # Monotonic epoch, bumped on shutdown/re-init (elastic resets).
+    epoch: int = 0
+
+
+_state = _RuntimeState()
+_lock = threading.RLock()
+_init_kwargs: dict = {}
+
+
+def _detect_mode() -> str:
+    if os.environ.get(ev.HVDTPU_SIZE):
+        return "process"
+    return "spmd"
+
+
+def _build_mesh(mesh_shape, axis_names, devices):
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if mesh_shape is None:
+        shape_env = os.environ.get(ev.HVDTPU_MESH_SHAPE)
+        if shape_env:
+            # e.g. "dp=4,tp=2"
+            mesh_shape = {}
+            for part in shape_env.split(","):
+                k, v = part.split("=")
+                mesh_shape[k.strip()] = int(v)
+        else:
+            mesh_shape = {DP_AXIS: n}
+    if isinstance(mesh_shape, dict):
+        axis_names = tuple(mesh_shape.keys())
+        dims = tuple(mesh_shape.values())
+    else:
+        dims = tuple(mesh_shape)
+        axis_names = tuple(axis_names)
+    total = int(np.prod(dims)) if dims else 1
+    if total != n:
+        raise ValueError(
+            f"mesh_shape {dims} (={total} devices) does not match the "
+            f"{n} available devices")
+    dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, axis_names), axis_names
+
+
+def init(comm: Optional[Sequence[int]] = None,
+         mode: Optional[str] = None,
+         mesh_shape=None,
+         axis_names: Sequence[str] = (DP_AXIS,),
+         dp_axis: str = DP_AXIS,
+         devices=None) -> None:
+    """Initialize the runtime.
+
+    Mirrors ``hvd.init()`` (reference ``horovod/common/basics.py:34``; ``comm`` as a
+    rank subset is accepted for signature parity but only the full world is
+    supported). Safe to call twice (second call is a no-op, like the reference's
+    ``InitializeHorovodOnce``, ``operations.cc:648``).
+
+    Args:
+      mode: "spmd", "process", or None to auto-detect (process mode iff the
+        launcher exported ``HVDTPU_SIZE``).
+      mesh_shape: SPMD mode — dict ``{"dp": 4, "tp": 2}`` or tuple of dims for the
+        device mesh; default is a 1-D data-parallel mesh over all devices.
+      axis_names: names for tuple-form ``mesh_shape``.
+      dp_axis: which mesh axis is the data-parallel (Horovod-rank) axis.
+      devices: explicit device list (testing); default ``jax.devices()``.
+    """
+    global _state, _init_kwargs
+    with _lock:
+        if _state.initialized:
+            return
+        # Remember the call signature so elastic resets re-initialize with the
+        # same topology (mesh shape, axis names, mode).
+        _init_kwargs = dict(comm=comm, mode=mode, mesh_shape=mesh_shape,
+                            axis_names=axis_names, dp_axis=dp_axis,
+                            devices=devices)
+        mode = mode or _detect_mode()
+        st = _RuntimeState(mode=mode, epoch=_state.epoch + 1)
+        if mode == "process":
+            st.rank = ev.get_int(ev.HVDTPU_RANK, 0)
+            st.size = ev.get_int(ev.HVDTPU_SIZE, 1)
+            st.local_rank = ev.get_int(ev.HVDTPU_LOCAL_RANK, 0)
+            st.local_size = ev.get_int(ev.HVDTPU_LOCAL_SIZE, 1)
+            st.cross_rank = ev.get_int(ev.HVDTPU_CROSS_RANK, st.rank)
+            st.cross_size = ev.get_int(ev.HVDTPU_CROSS_SIZE, st.size)
+            if st.size > 1:
+                try:
+                    from . import basics
+                except ImportError as e:
+                    raise NotInitializedError(
+                        "process mode (HVDTPU_SIZE > 1) requires the native "
+                        "core binding (horovod_tpu/basics.py + "
+                        "horovod_tpu/native); build it with "
+                        "`make -C horovod_tpu/native`") from e
+                st.core = basics.NativeCore(
+                    rank=st.rank, size=st.size,
+                    local_rank=st.local_rank, local_size=st.local_size,
+                    cross_rank=st.cross_rank, cross_size=st.cross_size)
+                st.core.start()
+            log.debug("init: process mode rank=%d size=%d local=%d/%d",
+                      st.rank, st.size, st.local_rank, st.local_size)
+        else:
+            import jax
+            st.mesh, st.axis_names = _build_mesh(mesh_shape, axis_names, devices)
+            st.dp_axis = dp_axis if dp_axis in st.axis_names else st.axis_names[0]
+            st.size = int(np.prod(list(st.mesh.shape.values())))
+            n_local = len([d for d in st.mesh.devices.flat
+                           if d.process_index == jax.process_index()])
+            st.local_size = max(n_local, 1)
+            st.local_rank = 0
+            st.rank = jax.process_index() * st.local_size
+            st.cross_rank = jax.process_index()
+            st.cross_size = jax.process_count()
+            log.debug("init: spmd mode mesh=%s size=%d", st.mesh.shape, st.size)
+        st.initialized = True
+        _state = st
+
+
+def shutdown() -> None:
+    """Tear down the runtime (reference: ``horovod_shutdown``, operations.cc:718)."""
+    global _state
+    with _lock:
+        if not _state.initialized:
+            return
+        if _state.core is not None:
+            _state.core.shutdown()
+        _state = _RuntimeState(epoch=_state.epoch)
+        # Compiled eager-collective programs close over the old Mesh; drop them
+        # so elastic re-inits don't accumulate stale executables.
+        from .ops import collectives as _C
+        _C._sharded_collective_fn.cache_clear()
+
+
+def reinit() -> None:
+    """Shutdown + init with the arguments from the last ``init`` call —
+    used by elastic resets so the topology/mesh layout is preserved."""
+    kwargs = dict(_init_kwargs)
+    shutdown()
+    init(**kwargs)
+
+
+def is_initialized() -> bool:
+    """Reference: ``horovod_is_initialized`` (operations.cc added 0.20)."""
+    return _state.initialized
+
+
+def _require_init() -> _RuntimeState:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def rank() -> int:
+    """Global rank of this process (device rank of first local device in SPMD)."""
+    return _require_init().rank
+
+
+def size() -> int:
+    """Number of ranks (SPMD: global device count)."""
+    return _require_init().size
+
+
+def local_rank() -> int:
+    return _require_init().local_rank
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def cross_rank() -> int:
+    return _require_init().cross_rank
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def is_homogeneous() -> bool:
+    """True when every node has the same number of ranks
+    (reference: ``horovod_is_homogeneous``, controller.cc)."""
+    return _require_init().homogeneous
+
+
+def mode() -> str:
+    return _require_init().mode
+
+
+def mesh():
+    """The global :class:`jax.sharding.Mesh` (SPMD mode).
+
+    Process mode builds a trivial 1-device mesh over this process's first device so
+    compiled-path helpers still work.
+    """
+    st = _require_init()
+    if st.mesh is None:
+        st.mesh, st.axis_names = _build_mesh(None, (DP_AXIS,), None)
+        st.dp_axis = st.axis_names[0]
+    return st.mesh
+
+
+def dp_axis() -> str:
+    """Name of the data-parallel mesh axis."""
+    return _require_init().dp_axis
+
+
+def axis_names() -> Tuple[str, ...]:
+    return _require_init().axis_names
+
+
+def core():
+    """Native controller handle (process mode, size > 1) or None."""
+    return _require_init().core
+
+
+def epoch() -> int:
+    return _state.epoch

@@ -1,0 +1,120 @@
+"""Environment-variable knobs for horovod_tpu.
+
+The reference parses ~40 ``HOROVOD_*`` env vars in C++
+(``horovod/common/utils/env_parser.cc``, names in ``horovod/common/common.h:68-108``).
+We mirror that config surface under the ``HVDTPU_*`` prefix, parsed in Python (and in
+the native core where relevant). Every knob the reference exposes that still makes
+sense on TPU has an equivalent here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Knob names (reference: horovod/common/common.h:68-108)
+# ---------------------------------------------------------------------------
+
+# Topology / rendezvous (reference: HOROVOD_RANK/SIZE/LOCAL_RANK/... set by the
+# gloo_run launcher, horovod/runner/gloo_run.py:70-95)
+HVDTPU_RANK = "HVDTPU_RANK"
+HVDTPU_SIZE = "HVDTPU_SIZE"
+HVDTPU_LOCAL_RANK = "HVDTPU_LOCAL_RANK"
+HVDTPU_LOCAL_SIZE = "HVDTPU_LOCAL_SIZE"
+HVDTPU_CROSS_RANK = "HVDTPU_CROSS_RANK"
+HVDTPU_CROSS_SIZE = "HVDTPU_CROSS_SIZE"
+HVDTPU_HOSTNAME = "HVDTPU_HOSTNAME"
+HVDTPU_RENDEZVOUS_ADDR = "HVDTPU_RENDEZVOUS_ADDR"
+HVDTPU_RENDEZVOUS_PORT = "HVDTPU_RENDEZVOUS_PORT"
+HVDTPU_CONTROLLER_ADDR = "HVDTPU_CONTROLLER_ADDR"
+HVDTPU_CONTROLLER_PORT = "HVDTPU_CONTROLLER_PORT"
+
+# Background-loop / fusion tuning (reference: HOROVOD_FUSION_THRESHOLD,
+# HOROVOD_CYCLE_TIME — horovod/common/operations.cc:456-472)
+HVDTPU_FUSION_THRESHOLD = "HVDTPU_FUSION_THRESHOLD"
+HVDTPU_CYCLE_TIME = "HVDTPU_CYCLE_TIME"
+
+# Response cache (reference: HOROVOD_CACHE_CAPACITY)
+HVDTPU_CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
+
+# Stall inspector (reference: HOROVOD_STALL_CHECK_DISABLE, ..._TIME_SECONDS,
+# ..._SHUTDOWN_TIME_SECONDS — horovod/common/stall_inspector.cc)
+HVDTPU_STALL_CHECK_DISABLE = "HVDTPU_STALL_CHECK_DISABLE"
+HVDTPU_STALL_CHECK_TIME_SECONDS = "HVDTPU_STALL_CHECK_TIME_SECONDS"
+HVDTPU_STALL_SHUTDOWN_TIME_SECONDS = "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS"
+
+# Timeline (reference: HOROVOD_TIMELINE, HOROVOD_TIMELINE_MARK_CYCLES —
+# horovod/common/operations.cc:437-454)
+HVDTPU_TIMELINE = "HVDTPU_TIMELINE"
+HVDTPU_TIMELINE_MARK_CYCLES = "HVDTPU_TIMELINE_MARK_CYCLES"
+
+# Autotune (reference: HOROVOD_AUTOTUNE, HOROVOD_AUTOTUNE_LOG,
+# horovod/common/operations.cc:474-532)
+HVDTPU_AUTOTUNE = "HVDTPU_AUTOTUNE"
+HVDTPU_AUTOTUNE_LOG = "HVDTPU_AUTOTUNE_LOG"
+HVDTPU_AUTOTUNE_WARMUP_SAMPLES = "HVDTPU_AUTOTUNE_WARMUP_SAMPLES"
+HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE = "HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE"
+HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+
+# Logging (reference: HOROVOD_LOG_LEVEL, HOROVOD_LOG_HIDE_TIME —
+# horovod/common/logging.cc)
+HVDTPU_LOG_LEVEL = "HVDTPU_LOG_LEVEL"
+HVDTPU_LOG_HIDE_TIME = "HVDTPU_LOG_HIDE_TIME"
+
+# Compression subsystem (reference fork knobs: horovod/common/common.h:96-108 —
+# HOROVOD_COMPRESSION, HOROVOD_REDUCTION, HOROVOD_COMMUNICATOR,
+# HOROVOD_QUANTIZATION_BITS, HOROVOD_COMPRESSION_BUCKET_SIZE,
+# HOROVOD_COMPRESSION_ERROR_FEEDBACK, HOROVOD_COMPRESSION_TOPK_RATIO,
+# HOROVOD_COMPRESSION_CONFIG_FILE)
+HVDTPU_COMPRESSION = "HVDTPU_COMPRESSION"
+HVDTPU_REDUCTION = "HVDTPU_REDUCTION"
+HVDTPU_COMMUNICATOR = "HVDTPU_COMMUNICATOR"
+HVDTPU_QUANTIZATION_BITS = "HVDTPU_QUANTIZATION_BITS"
+HVDTPU_COMPRESSION_BUCKET_SIZE = "HVDTPU_COMPRESSION_BUCKET_SIZE"
+HVDTPU_COMPRESSION_ERROR_FEEDBACK = "HVDTPU_COMPRESSION_ERROR_FEEDBACK"
+HVDTPU_COMPRESSION_TOPK_RATIO = "HVDTPU_COMPRESSION_TOPK_RATIO"
+HVDTPU_COMPRESSION_CONFIG_FILE = "HVDTPU_COMPRESSION_CONFIG_FILE"
+
+# Elastic (reference: HOROVOD_ELASTIC_TIMEOUT, HOROVOD_GLOO_TIMEOUT_SECONDS)
+HVDTPU_ELASTIC_TIMEOUT = "HVDTPU_ELASTIC_TIMEOUT"
+
+# Mesh / SPMD-mode knobs (TPU-native, no reference analog: control how the
+# single-process device mesh is laid out).
+HVDTPU_MESH_SHAPE = "HVDTPU_MESH_SHAPE"
+HVDTPU_DP_AXIS = "HVDTPU_DP_AXIS"
+
+
+def get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}")
+
+
+def get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a float, got {v!r}")
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v

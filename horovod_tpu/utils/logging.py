@@ -1,0 +1,70 @@
+"""Leveled, rank-prefixed logging.
+
+Reference: ``horovod/common/logging.{h,cc}`` — glog-style ``LOG(level, rank)`` macros
+controlled by ``HOROVOD_LOG_LEVEL``. Here the same surface is provided on top of the
+stdlib ``logging`` module, controlled by ``HVDTPU_LOG_LEVEL`` ∈
+{trace, debug, info, warning, error, fatal, off}.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+import os
+import sys
+
+TRACE = 5
+_pylogging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": _pylogging.DEBUG,
+    "info": _pylogging.INFO,
+    "warning": _pylogging.WARNING,
+    "error": _pylogging.ERROR,
+    "fatal": _pylogging.CRITICAL,
+    "off": _pylogging.CRITICAL + 10,
+}
+
+
+def _make_logger() -> _pylogging.Logger:
+    logger = _pylogging.getLogger("horovod_tpu")
+    if not logger.handlers:
+        handler = _pylogging.StreamHandler(sys.stderr)
+        hide_time = os.environ.get("HVDTPU_LOG_HIDE_TIME", "").lower() in ("1", "true")
+        fmt = "[%(levelname)s] %(message)s" if hide_time else \
+            "%(asctime)s [%(levelname)s] %(message)s"
+        handler.setFormatter(_pylogging.Formatter(fmt))
+        logger.addHandler(handler)
+        level_name = os.environ.get("HVDTPU_LOG_LEVEL", "warning").lower()
+        logger.setLevel(_LEVELS.get(level_name, _pylogging.WARNING))
+        logger.propagate = False
+    return logger
+
+
+logger = _make_logger()
+
+
+def _prefix(msg: str) -> str:
+    # Rank prefix, like the reference's "[<rank>]:" (logging.cc LogMessage).
+    rank = os.environ.get("HVDTPU_RANK")
+    return f"[{rank}]: {msg}" if rank is not None else msg
+
+
+def trace(msg: str, *args) -> None:
+    logger.log(TRACE, _prefix(msg), *args)
+
+
+def debug(msg: str, *args) -> None:
+    logger.debug(_prefix(msg), *args)
+
+
+def info(msg: str, *args) -> None:
+    logger.info(_prefix(msg), *args)
+
+
+def warning(msg: str, *args) -> None:
+    logger.warning(_prefix(msg), *args)
+
+
+def error(msg: str, *args) -> None:
+    logger.error(_prefix(msg), *args)

@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing multi-node behavior as multi-process
+on one machine (SURVEY.md §4): here, multi-chip behavior is tested as a virtual
+8-device CPU mesh (`--xla_force_host_platform_device_count=8`), exactly how the
+driver dry-runs the multi-chip path. Process-mode (eager controller) tests spawn
+real subprocesses over localhost TCP instead.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU backend and overrides
+# jax_platforms; override it back — tests run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture
+def spmd8():
+    """Initialized SPMD runtime over the 8-device CPU mesh."""
+    hvd.shutdown()
+    hvd.init()
+    assert hvd.size() == 8
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture
+def make_runtime():
+    """Factory for runtimes over custom device subsets / mesh shapes."""
+    def _make(**kwargs):
+        hvd.shutdown()
+        hvd.init(**kwargs)
+        return hvd
+    yield _make
+    hvd.shutdown()

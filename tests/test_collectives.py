@@ -1,0 +1,224 @@
+"""Collective-op correctness on the 8-device mesh.
+
+Test shapes mirror the reference's framework-op unit tests
+(``test/test_torch.py`` — correctness :142, averaging, fusion :239,
+pre/postscale :327/:381, plus allgather/broadcast/alltoall menus).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+DTYPES = [jnp.float32, jnp.float64, jnp.int32, jnp.int64, jnp.bfloat16]
+
+
+def _per_rank(shape, dtype, size=8, seed=0):
+    """One distinct array per rank; returns (stacked_global, per_rank_list)."""
+    rng = np.random.RandomState(seed)
+    if jnp.issubdtype(dtype, jnp.integer):
+        vals = [rng.randint(-100, 100, size=shape).astype(dtype)
+                for _ in range(size)]
+    else:
+        vals = [rng.randn(*shape).astype(dtype) for _ in range(size)]
+    return np.concatenate([v[None] for v in vals], axis=0), vals
+
+
+class TestAllreduceSharded:
+    """Eager allreduce on arrays sharded over the dp axis (one shard == one
+    rank's tensor; reference: test_horovod_allreduce, test_torch.py:142)."""
+
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_sum(self, spmd8, dtype):
+        stacked, vals = _per_rank((4, 5), dtype)
+        x = hvd.shard_batch(jnp.asarray(stacked))
+        out = hvd.allreduce(x, op=hvd.Sum)
+        expect = np.sum(np.asarray(stacked, dtype=np.float64), axis=0)
+        tol = 1e-2 if dtype == jnp.bfloat16 else 1e-5
+        np.testing.assert_allclose(np.asarray(out, np.float64)[0], expect,
+                                   rtol=tol, atol=tol)
+
+    def test_average(self, spmd8):
+        stacked, _ = _per_rank((8, 3), jnp.float32)
+        x = hvd.shard_batch(jnp.asarray(stacked))
+        out = hvd.allreduce(x, op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   stacked.mean(axis=0), rtol=1e-5, atol=1e-5)
+
+    def test_min_max(self, spmd8):
+        stacked, _ = _per_rank((2, 7), jnp.float32)
+        x = hvd.shard_batch(jnp.asarray(stacked))
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Min))[0],
+                                   stacked.min(axis=0))
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Max))[0],
+                                   stacked.max(axis=0))
+
+    def test_prescale_postscale(self, spmd8):
+        """Reference: test_horovod_allreduce_prescale/postscale
+        (test_torch.py:327/:381)."""
+        stacked, _ = _per_rank((4, 4), jnp.float32)
+        x = hvd.shard_batch(jnp.asarray(stacked))
+        out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                            postscale_factor=3.0)
+        expect = 3.0 * np.sum(0.5 * stacked, axis=0)
+        np.testing.assert_allclose(np.asarray(out)[0], expect, rtol=1e-5)
+
+    def test_replicated_semantics(self, spmd8):
+        """All ranks hold the same tensor: sum == x * size, avg == x."""
+        x = jnp.ones((3, 3), jnp.float32)
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Sum)),
+                                   8 * np.ones((3, 3)))
+        np.testing.assert_allclose(np.asarray(hvd.allreduce(x, op=hvd.Average)),
+                                   np.ones((3, 3)))
+
+
+class TestInStep:
+    """Collectives inside a compiled shard_map step — the TPU hot path."""
+
+    def test_allreduce_in_step(self, spmd8):
+        data = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P())
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Average)
+
+        out = step(jnp.asarray(data))
+        np.testing.assert_allclose(np.asarray(out),
+                                   data.mean(axis=0, keepdims=True), rtol=1e-5)
+
+    def test_rank_and_size_in_step(self, spmd8):
+        @hvd.run_step(in_specs=P("dp"), out_specs=P("dp"))
+        def step(x):
+            r = hvd.rank_in_step()
+            return x + r * 0 + r, hvd.size_in_step() + x * 0
+
+        ranks, sizes = step(jnp.zeros((8,), jnp.int32))
+        np.testing.assert_array_equal(np.asarray(ranks), np.arange(8))
+        np.testing.assert_array_equal(np.asarray(sizes), np.full(8, 8))
+
+    def test_allgather_in_step(self, spmd8):
+        x = jnp.arange(16.0).reshape(8, 2)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P())
+        def step(shard):
+            return hvd.allgather(shard)
+
+        out = step(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def test_broadcast_in_step(self, spmd8):
+        x = jnp.arange(8.0)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P())
+        def step(shard):
+            return hvd.broadcast(shard, root_rank=3)
+
+        out = step(x)
+        np.testing.assert_allclose(np.asarray(out), [3.0])
+
+    def test_reducescatter_in_step(self, spmd8):
+        x = jnp.ones((64, 2), jnp.float32)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P("dp"))
+        def step(shard):
+            return hvd.reducescatter(shard, op=hvd.Sum)
+
+        out = step(x)
+        assert out.shape == (8, 2)
+        np.testing.assert_allclose(np.asarray(out), 8 * np.ones((8, 2)))
+
+    def test_alltoall_in_step(self, spmd8):
+        x = jnp.arange(64, dtype=jnp.int32)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P("dp"))
+        def step(shard):
+            return hvd.alltoall(shard)
+
+        out = np.asarray(step(x)).reshape(8, 8)
+        np.testing.assert_array_equal(out, np.arange(64).reshape(8, 8).T)
+
+
+class TestEagerOthers:
+    def test_allgather_sharded(self, spmd8):
+        stacked, _ = _per_rank((2, 3), jnp.float32)
+        x = hvd.shard_batch(jnp.asarray(stacked).reshape(16, 3))
+        out = hvd.allgather(x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(stacked).reshape(16, 3))
+
+    def test_broadcast_sharded(self, spmd8):
+        x = hvd.shard_batch(jnp.arange(8.0))
+        out = hvd.broadcast(x, root_rank=5)
+        np.testing.assert_allclose(np.asarray(out), [5.0])
+
+    def test_grouped_allreduce(self, spmd8):
+        a = hvd.shard_batch(jnp.ones((8, 2)))
+        b = hvd.shard_batch(jnp.full((8, 4), 2.0))
+        out_a, out_b = hvd.grouped_allreduce([a, b], op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out_a), 8 * np.ones((1, 2)))
+        np.testing.assert_allclose(np.asarray(out_b), 16 * np.ones((1, 4)))
+
+    def test_async_handles(self, spmd8):
+        """Reference: allreduce_async/poll/synchronize
+        (test_torch.py:239 fused-async pattern)."""
+        xs = [hvd.shard_batch(jnp.full((8, 2), float(i))) for i in range(4)]
+        handles = [hvd.allreduce_async(x, op=hvd.Average) for x in xs]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            np.testing.assert_allclose(np.asarray(out), np.full((1, 2), float(i)))
+
+    def test_poll_unknown_handle(self, spmd8):
+        with pytest.raises(ValueError):
+            hvd.poll(123456)
+
+    def test_join_spmd(self, spmd8):
+        assert hvd.join() == hvd.rank()
+
+
+class TestTopology:
+    def test_rank_size(self, spmd8):
+        assert hvd.size() == 8
+        assert hvd.rank() == 0
+        assert hvd.local_size() == 8
+        assert hvd.cross_size() == 1
+        assert hvd.is_initialized()
+
+    def test_not_initialized(self):
+        hvd.shutdown()
+        with pytest.raises(hvd.NotInitializedError):
+            hvd.rank()
+
+    def test_custom_mesh(self, make_runtime):
+        h = make_runtime(mesh_shape={"dp": 4, "tp": 2})
+        assert h.size() == 8
+        mesh = h.mesh()
+        assert mesh.shape == {"dp": 4, "tp": 2}
+        assert h.dp_axis() == "dp"
+
+    def test_mesh_shape_mismatch(self, make_runtime):
+        with pytest.raises(ValueError):
+            make_runtime(mesh_shape={"dp": 3})
+
+    def test_builds(self, spmd8):
+        assert hvd.gloo_built() and not hvd.nccl_built() and not hvd.mpi_built()
+
+
+class TestProduct:
+    def test_product_with_negatives_and_zeros(self, spmd8):
+        """PRODUCT must handle negatives (sign tracking) and zeros without NaN."""
+        vals = np.array([[-1.0], [2.0], [-3.0], [1.0], [1.0], [1.0], [1.0],
+                         [1.0]], np.float32)
+        x = hvd.shard_batch(jnp.asarray(vals))
+        out = np.asarray(hvd.allreduce(x, op=hvd.Product))
+        np.testing.assert_allclose(out, [[6.0]], rtol=1e-5)
+        vals[3, 0] = 0.0
+        x = hvd.shard_batch(jnp.asarray(vals))
+        out = np.asarray(hvd.allreduce(x, op=hvd.Product))
+        np.testing.assert_allclose(out, [[0.0]], atol=1e-7)
+
+    def test_eager_replicated_alltoall_rejected(self, spmd8):
+        with pytest.raises(ValueError):
+            hvd.alltoall(jnp.arange(8.0))

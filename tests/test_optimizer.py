@@ -1,0 +1,187 @@
+"""DistributedOptimizer semantics.
+
+Reference: ``horovod/torch/optimizer.py`` tests in ``test/test_torch.py``
+(gradient averaging, ``backward_passes_per_step``) — here validated functionally:
+data-parallel training over 8 shards must equal single-device training on the
+full batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+
+
+def _loss_fn(model, params, batch):
+    x, y = batch
+    logits = model.apply(params, x)
+    one_hot = jax.nn.one_hot(y, logits.shape[-1])
+    return jnp.mean(jnp.sum((logits - one_hot) ** 2, axis=-1))
+
+
+@pytest.fixture
+def problem():
+    model = MLP(features=(16, 10))
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 28).astype(np.float32)
+    y = rng.randint(0, 10, size=(64,))
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+    return model, params, (x, y)
+
+
+class TestDistributedOptimizer:
+    def test_matches_full_batch_sgd(self, spmd8, problem):
+        """DP training over 8 shards == full-batch single-device training."""
+        model, params, (x, y) = problem
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+
+        def train_step(p, opt_state, batch):
+            grads = jax.grad(lambda q: _loss_fn(model, q, batch))(p)
+            updates, opt_state = opt.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state
+
+        # Distributed: shard_map over the mesh.
+        step = hvd.run_step(train_step,
+                            in_specs=(P(), P(), (P("dp"), P("dp"))),
+                            out_specs=P())
+        opt_state = opt.init(params)
+        p_dist, _ = step(params, opt_state,
+                         (jnp.asarray(x), jnp.asarray(y)))
+
+        # Single-device full batch with plain sgd (average of shard grads ==
+        # full-batch grad since shards are equal sized and loss is a mean).
+        ref_opt = optax.sgd(0.1)
+        grads = jax.grad(lambda q: _loss_fn(model, q,
+                                            (jnp.asarray(x), jnp.asarray(y))))(params)
+        updates, _ = ref_opt.update(grads, ref_opt.init(params), params)
+        p_ref = optax.apply_updates(params, updates)
+
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            p_dist, p_ref)
+
+    def test_sum_op(self, spmd8, problem):
+        model, params, (x, y) = problem
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Sum)
+
+        def grads_of(p, batch):
+            return jax.grad(lambda q: _loss_fn(model, q, batch))(p)
+
+        def train_step(p, opt_state, batch):
+            grads = grads_of(p, batch)
+            updates, opt_state = opt.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state
+
+        step = hvd.run_step(train_step,
+                            in_specs=(P(), P(), (P("dp"), P("dp"))),
+                            out_specs=P())
+        p_dist, _ = step(params, opt.init(params),
+                         (jnp.asarray(x), jnp.asarray(y)))
+
+        # Reference: sum of per-shard grads.
+        shard_grads = [grads_of(params, (jnp.asarray(x[i * 8:(i + 1) * 8]),
+                                         jnp.asarray(y[i * 8:(i + 1) * 8])))
+                       for i in range(8)]
+        summed = jax.tree.map(lambda *g: sum(g), *shard_grads)
+        updates, _ = optax.sgd(0.1).update(summed, optax.sgd(0.1).init(params),
+                                           params)
+        p_ref = optax.apply_updates(params, updates)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+            p_dist, p_ref)
+
+    def test_backward_passes_per_step(self, spmd8, problem):
+        """Gradient accumulation (reference: optimizer.py:67
+        backward_passes_per_step): update applies only every k-th call."""
+        model, params, (x, y) = problem
+        k = 2
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                       backward_passes_per_step=k)
+
+        def train_step(p, opt_state, batch):
+            grads = jax.grad(lambda q: _loss_fn(model, q, batch))(p)
+            updates, opt_state = opt.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state
+
+        step = hvd.run_step(train_step,
+                            in_specs=(P(), P(), (P("dp"), P("dp"))),
+                            out_specs=P())
+        opt_state = opt.init(params)
+        batch = (jnp.asarray(x), jnp.asarray(y))
+        p1, opt_state = step(params, opt_state, batch)
+        # After the first (mini) step params must be unchanged.
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b)), p1, params)
+        p2, opt_state = step(p1, opt_state, batch)
+        # After the k-th call the update applies.
+        changed = jax.tree.leaves(jax.tree.map(
+            lambda a, b: np.any(np.asarray(a) != np.asarray(b)), p2, params))
+        assert any(changed)
+
+    def test_gradient_predivide_factor(self, spmd8):
+        """prescale = f/size, postscale = 1/f (reference: optimizer.py factory)."""
+        opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                       gradient_predivide_factor=2.0)
+        grads = {"w": jnp.full((8, 2), 4.0)}
+
+        @hvd.run_step(in_specs=(P("dp"),), out_specs=P())
+        def reduce_only(g):
+            updates, _ = opt.update(g, opt.init(g))
+            return updates
+
+        out = reduce_only(grads["w"])
+        # average of 8 identical shards = shard value; sgd(1.0) negates.
+        np.testing.assert_allclose(np.asarray(out), -4.0 * np.ones((1, 2)),
+                                   rtol=1e-6)
+
+    def test_eager_broadcast_parameters(self, spmd8, problem):
+        model, params, _ = problem
+        out = hvd.broadcast_parameters(params, root_rank=0)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b)), out, params)
+
+    def test_gradient_tape(self, spmd8):
+        """DistributedGradientTape analog wraps a grad fn."""
+        def loss(w, x):
+            return jnp.sum(w * x)
+
+        tape = hvd.DistributedGradientTape(jax.grad(loss))
+        g = tape(jnp.ones(4), jnp.full(4, 2.0))
+        np.testing.assert_allclose(np.asarray(g), 2.0 * np.ones(4))
+
+
+class TestEndToEndTraining:
+    def test_mlp_loss_decreases(self, spmd8):
+        """Minimum end-to-end slice (SURVEY.md §7 milestone 1): MLP trains under
+        data_parallel_step + DistributedOptimizer and the loss drops."""
+        model = MLP(features=(32, 10))
+        rng = np.random.RandomState(1)
+        x = rng.randn(128, 20).astype(np.float32)
+        w_true = rng.randn(20, 10).astype(np.float32)
+        y = np.argmax(x @ w_true, axis=1)
+        params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+        opt = hvd.DistributedOptimizer(optax.adam(1e-2))
+        opt_state = opt.init(params)
+
+        def train_step(p, s, batch):
+            def loss_fn(q):
+                logits = model.apply(q, batch[0])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch[1]).mean()
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            updates, s = opt.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return p, s, hvd.allreduce(loss, op=hvd.Average)
+
+        step = hvd.data_parallel_step(train_step, donate_state=False)
+        batch = hvd.shard_batch((jnp.asarray(x), jnp.asarray(y)))
+        losses = []
+        for _ in range(30):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses
