@@ -1,0 +1,223 @@
+"""ctypes binding to the native core runtime.
+
+Reference surface: ``horovod/common/basics.py:22`` (``HorovodBasics`` — the
+ctypes wrapper over the C API in ``operations.cc:705-913``). Here the C API is
+the one exported by ``horovod_tpu/native/core.cpp`` (TCP controller + ring data
+plane), built as ``libhvdtpu_core.so`` by ``make -C horovod_tpu/native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .exceptions import (DuplicateNameError, HvdTpuInternalError,
+                         TensorDtypeMismatchError, TensorShapeMismatchError)
+from .utils import envvars as ev
+from .utils import logging as log
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhvdtpu_core.so")
+
+# Matches hvdtpu::OpType (native/common.h).
+_OP_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "alltoall": 3,
+             "reducescatter": 4, "join": 5}
+
+# numpy dtype name -> hvdtpu::DataType (native/common.h, mirroring the
+# reference DataType enum in horovod/common/message.h:28-39).
+_DTYPES = {"uint8": 0, "int8": 1, "int32": 4, "int64": 5, "float16": 6,
+           "float32": 7, "float64": 8, "bool": 9, "bfloat16": 10}
+
+
+def _ensure_built() -> str:
+    if not os.path.exists(_LIB_PATH):
+        log.info("building native core in %s", _NATIVE_DIR)
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True)
+    return _LIB_PATH
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_ensure_built())
+    lib.hvdtpu_create.restype = ctypes.c_void_p
+    lib.hvdtpu_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_double, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_double]
+    lib.hvdtpu_start.restype = ctypes.c_int
+    lib.hvdtpu_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int]
+    lib.hvdtpu_shutdown.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_enqueue.restype = ctypes.c_longlong
+    lib.hvdtpu_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.hvdtpu_wait.restype = ctypes.c_int
+    lib.hvdtpu_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                ctypes.c_char_p, ctypes.c_int]
+    lib.hvdtpu_poll.restype = ctypes.c_int
+    lib.hvdtpu_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvdtpu_result_bytes.restype = ctypes.c_longlong
+    lib.hvdtpu_result_bytes.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvdtpu_copy_result.restype = ctypes.c_int
+    lib.hvdtpu_copy_result.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
+    lib.hvdtpu_join.restype = ctypes.c_longlong
+    lib.hvdtpu_join.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _raise_for(message: str):
+    """Map a native error message onto the exception hierarchy
+    (reference error strings: controller.cc ConstructResponse)."""
+    if "already pending" in message:
+        raise DuplicateNameError(message)
+    if "Mismatched data types" in message:
+        raise TensorDtypeMismatchError(message)
+    if "Mismatched" in message and ("shape" in message
+                                    or "tensor ranks" in message):
+        raise TensorShapeMismatchError(message)
+    raise HvdTpuInternalError(message)
+
+
+def _np_view(arr: np.ndarray):
+    """(contiguous array, DataType code, wire-view) — bfloat16 (ml_dtypes)
+    travels as raw uint16 words; the native core reduces it natively."""
+    arr = np.ascontiguousarray(arr)
+    name = arr.dtype.name
+    if name not in _DTYPES:
+        raise TypeError(f"unsupported dtype for native collective: {name}")
+    return arr, _DTYPES[name]
+
+
+class NativeCore:
+    """One process's handle to the native runtime (process mode)."""
+
+    def __init__(self, rank: int, size: int, local_rank: int = 0,
+                 local_size: int = 1, cross_rank: Optional[int] = None,
+                 cross_size: Optional[int] = None):
+        global _lib
+        if _lib is None:
+            _lib = _load_lib()
+        self._lib = _lib
+        self.rank = rank
+        self.size = size
+        coord_host = ev.get_str(ev.HVDTPU_CONTROLLER_ADDR, "127.0.0.1")
+        coord_port = ev.get_int(ev.HVDTPU_CONTROLLER_PORT, 29500)
+        my_host = ev.get_str(ev.HVDTPU_HOSTNAME, "127.0.0.1")
+        cycle_ms = ev.get_float(ev.HVDTPU_CYCLE_TIME, 1.0)
+        fusion = ev.get_int(ev.HVDTPU_FUSION_THRESHOLD, 64 * 1024 * 1024)
+        timeline = ev.get_str(ev.HVDTPU_TIMELINE, "") or ""
+        mark_cycles = ev.get_bool(ev.HVDTPU_TIMELINE_MARK_CYCLES)
+        stall = ev.get_float(ev.HVDTPU_STALL_CHECK_TIME_SECONDS, 60.0)
+        if ev.get_bool(ev.HVDTPU_STALL_CHECK_DISABLE):
+            stall = 1e18
+        self._core = self._lib.hvdtpu_create(
+            rank, size, local_rank, local_size,
+            cross_rank if cross_rank is not None else rank,
+            cross_size if cross_size is not None else size,
+            coord_host.encode(), coord_port, my_host.encode(), cycle_ms,
+            fusion, timeline.encode(), int(mark_cycles), stall)
+        self._started = False
+        # Inputs pinned until their async op completes (the native core reads
+        # the caller's buffer zero-copy).
+        self._inflight = {}
+
+    def start(self) -> None:
+        err = ctypes.create_string_buffer(1024)
+        if self._lib.hvdtpu_start(self._core, err, len(err)) != 0:
+            raise HvdTpuInternalError(
+                f"native core start failed: {err.value.decode()}")
+        self._started = True
+
+    def shutdown(self) -> None:
+        if self._core:
+            self._lib.hvdtpu_shutdown(self._core)
+            self._lib.hvdtpu_destroy(self._core)
+            self._core = None
+
+    # -- collectives -------------------------------------------------------
+
+    def enqueue(self, kind: str, name: str, arr: np.ndarray, op: int = 1,
+                prescale: float = 1.0, postscale: float = 1.0,
+                root_rank: int = 0, splits=None) -> int:
+        arr, dtype_code = _np_view(arr)
+        shape = (ctypes.c_longlong * arr.ndim)(*arr.shape)
+        err = ctypes.create_string_buffer(1024)
+        if splits is not None:
+            splits = np.ascontiguousarray(splits, dtype=np.int32)
+            splits_ptr = splits.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+            nsplits = splits.size
+        else:
+            splits_ptr = None
+            nsplits = 0
+        # Keep a reference so the input buffer outlives the async op.
+        handle = self._lib.hvdtpu_enqueue(
+            self._core, name.encode(), _OP_TYPES[kind], op, dtype_code,
+            shape, arr.ndim, arr.ctypes.data_as(ctypes.c_void_p),
+            prescale, postscale, root_rank, splits_ptr, nsplits, err, len(err))
+        if handle < 0:
+            _raise_for(err.value.decode())
+        self._inflight[handle] = arr
+        return int(handle)
+
+    def wait(self, handle: int, out_dtype, row_shape) -> np.ndarray:
+        err = ctypes.create_string_buffer(2048)
+        rc = self._lib.hvdtpu_wait(self._core, handle, err, len(err))
+        self._inflight.pop(handle, None)
+        if rc != 0:
+            # Release native-side state for the failed handle.
+            self._lib.hvdtpu_copy_result(self._core, handle, None, 0, None, 0)
+            _raise_for(err.value.decode())
+        nbytes = self._lib.hvdtpu_result_bytes(self._core, handle)
+        itemsize = np.dtype(out_dtype).itemsize
+        row_elems = int(np.prod(row_shape)) if row_shape else 1
+        total = nbytes // itemsize
+        if row_elems and total % row_elems == 0 and row_shape:
+            out = np.empty((total // row_elems,) + tuple(row_shape),
+                           dtype=out_dtype)
+        else:
+            out = np.empty((total,), dtype=out_dtype)
+        rc = self._lib.hvdtpu_copy_result(
+            self._core, handle, out.ctypes.data_as(ctypes.c_void_p),
+            out.nbytes, err, len(err))
+        if rc != 0:
+            _raise_for(err.value.decode())
+        return out
+
+    def poll(self, handle: int) -> bool:
+        return bool(self._lib.hvdtpu_poll(self._core, handle))
+
+    def collective(self, kind: str, name: str, arr: np.ndarray, op: int = 1,
+                   prescale: float = 1.0, postscale: float = 1.0,
+                   root_rank: int = 0, splits=None) -> np.ndarray:
+        """Synchronous collective: enqueue + wait, reshaping the output.
+
+        allreduce/broadcast keep the input shape; allgather concatenates on
+        dim 0 (ranks may differ there); alltoall returns received rows;
+        reducescatter returns this rank's dim-0 chunk.
+        """
+        handle = self.enqueue(kind, name, arr, op=op, prescale=prescale,
+                              postscale=postscale, root_rank=root_rank,
+                              splits=splits)
+        row_shape = tuple(arr.shape[1:]) if arr.ndim > 0 else ()
+        out = self.wait(handle, arr.dtype, row_shape)
+        if kind in ("allreduce", "broadcast"):
+            out = out.reshape(arr.shape)
+        return out
+
+    def join(self) -> int:
+        return int(self._lib.hvdtpu_join(self._core))

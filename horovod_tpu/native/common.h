@@ -1,0 +1,148 @@
+// Core types for the native runtime.
+//
+// TPU-native rebuild of the reference's horovod/common/common.h (Status,
+// DataType enum, TensorTableEntry) — redesigned around a TCP control/data plane
+// instead of MPI/NCCL. No external dependencies beyond POSIX + libstdc++.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+// Mirrors the reference DataType enum (horovod/common/message.h:28-39).
+enum class DataType : int32_t {
+  UINT8 = 0,
+  INT8 = 1,
+  INT32 = 4,
+  INT64 = 5,
+  FLOAT16 = 6,
+  FLOAT32 = 7,
+  FLOAT64 = 8,
+  BOOL = 9,
+  BFLOAT16 = 10,
+};
+
+inline size_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64:
+    case DataType::FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+inline const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: return "uint8";
+    case DataType::INT8: return "int8";
+    case DataType::INT32: return "int32";
+    case DataType::INT64: return "int64";
+    case DataType::FLOAT16: return "float16";
+    case DataType::FLOAT32: return "float32";
+    case DataType::FLOAT64: return "float64";
+    case DataType::BOOL: return "bool";
+    case DataType::BFLOAT16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+// Collective op kinds (reference RequestType, message.h:50-52).
+enum class OpType : int32_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  REDUCESCATTER = 4,
+  JOIN = 5,
+};
+
+// Reduction ops (matches horovod_tpu.ops.collectives.ReduceOp).
+enum class ReduceOp : int32_t {
+  AVERAGE = 0,
+  SUM = 1,
+  ADASUM = 2,
+  MIN = 3,
+  MAX = 4,
+  PRODUCT = 5,
+};
+
+enum class StatusCode : int32_t {
+  OK = 0,
+  IN_PROGRESS = 1,
+  INVALID_ARGUMENT = 2,
+  ABORTED = 3,
+  DUPLICATE_NAME = 4,
+};
+
+struct Status {
+  StatusCode code = StatusCode::OK;
+  std::string reason;
+  static Status OK() { return Status{}; }
+  static Status Error(StatusCode c, std::string r) { return Status{c, std::move(r)}; }
+  bool ok() const { return code == StatusCode::OK; }
+};
+
+// A pending collective on this rank (reference: TensorTableEntry, common.h:183).
+struct TensorEntry {
+  std::string name;
+  OpType op_type = OpType::ALLREDUCE;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  DataType dtype = DataType::FLOAT32;
+  std::vector<int64_t> shape;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int32_t root_rank = 0;            // broadcast
+  std::vector<int32_t> splits;      // alltoall (may be empty = even)
+  const void* input = nullptr;      // caller-owned until completion
+  // Output buffer: owned by the core, copied out by the caller after wait.
+  std::vector<uint8_t> output;
+  int32_t handle = -1;
+
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  int64_t byte_size() const {
+    return num_elements() * static_cast<int64_t>(DataTypeSize(dtype));
+  }
+};
+
+inline int64_t NumElements(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+inline std::string ShapeStr(const std::vector<int64_t>& shape) {
+  std::string s = "[";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(shape[i]);
+  }
+  return s + "]";
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,484 @@
+#include "data_plane.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <thread>
+
+#include "socket_util.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// --- fp16 / bf16 conversion (reference: horovod/common/half.{h,cc}) ---------
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {
+      // subnormal: normalize
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400u) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ffu;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  memcpy(&f, &bits, sizeof(f));
+  return f;
+}
+
+inline uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, sizeof(bits));
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);  // inf/overflow
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint16_t h = static_cast<uint16_t>(sign | (mant >> shift));
+    // round-to-nearest
+    if ((mant >> (shift - 1)) & 1u) h++;
+    return h;
+  }
+  uint16_t h = static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+  if (mant & 0x1000u) h++;  // round
+  return h;
+}
+
+inline float Bf16ToFloat(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &bits, sizeof(f));
+  return f;
+}
+
+inline uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, sizeof(bits));
+  // round-to-nearest-even
+  uint32_t rounded = bits + 0x7fffu + ((bits >> 16) & 1u);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+template <typename T>
+inline T Combine(T a, T b, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+      return a + b;
+    case ReduceOp::MIN:
+      return std::min(a, b);
+    case ReduceOp::MAX:
+      return std::max(a, b);
+    case ReduceOp::PRODUCT:
+      return a * b;
+  }
+  return a;
+}
+
+template <typename T>
+void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+      for (int64_t i = 0; i < count; ++i) dst[i] += src[i];
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < count; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < count; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < count; ++i) dst[i] *= src[i];
+      break;
+  }
+}
+
+}  // namespace
+
+float HalfToFloatPublic(uint16_t h) { return HalfToFloat(h); }
+uint16_t FloatToHalfPublic(float f) { return FloatToHalf(f); }
+float Bf16ToFloatPublic(uint16_t h) { return Bf16ToFloat(h); }
+uint16_t FloatToBf16Public(float f) { return FloatToBf16(f); }
+
+void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
+                  ReduceOp op) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src),
+                  count, op);
+      break;
+    case DataType::FLOAT64:
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(src),
+                  count, op);
+      break;
+    case DataType::INT32:
+      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src),
+                  count, op);
+      break;
+    case DataType::INT64:
+      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src),
+                  count, op);
+      break;
+    case DataType::UINT8:
+      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+                  count, op);
+      break;
+    case DataType::INT8:
+      ReduceTyped(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+                  count, op);
+      break;
+    case DataType::BOOL: {
+      uint8_t* d = static_cast<uint8_t*>(dst);
+      const uint8_t* s = static_cast<const uint8_t*>(src);
+      // bool: SUM/MAX == OR, MIN/PRODUCT == AND
+      if (op == ReduceOp::MIN || op == ReduceOp::PRODUCT) {
+        for (int64_t i = 0; i < count; ++i) d[i] = d[i] && s[i];
+      } else {
+        for (int64_t i = 0; i < count; ++i) d[i] = d[i] || s[i];
+      }
+      break;
+    }
+    case DataType::FLOAT16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = FloatToHalf(
+            Combine(HalfToFloat(d[i]), HalfToFloat(s[i]), op));
+      }
+      break;
+    }
+    case DataType::BFLOAT16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = FloatToBf16(
+            Combine(Bf16ToFloat(d[i]), Bf16ToFloat(s[i]), op));
+      }
+      break;
+    }
+  }
+}
+
+DataPlane::DataPlane(int rank, int size)
+    : rank_(rank), size_(size), fds_(size, -1) {}
+
+DataPlane::~DataPlane() { Shutdown(); }
+
+Status DataPlane::Listen() {
+  listen_fd_ = TcpListen(0, size_ + 4, &port_);
+  if (listen_fd_ < 0) {
+    return Status::Error(StatusCode::ABORTED, "data plane: listen failed");
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Connect(const std::vector<PeerAddr>& peers) {
+  // Deterministic, deadlock-free establishment: connect to lower ranks (they
+  // are already listening), accept from higher ranks. Rank is identified by a
+  // 4-byte hello.
+  for (int peer = 0; peer < rank_; ++peer) {
+    int fd = TcpConnectRetry(peers[peer].host, peers[peer].port, 30000);
+    if (fd < 0) {
+      return Status::Error(StatusCode::ABORTED,
+                           "data plane: connect to rank " +
+                               std::to_string(peer) + " failed");
+    }
+    int32_t me = rank_;
+    if (SendAll(fd, &me, sizeof(me)) != 0) {
+      CloseFd(fd);
+      return Status::Error(StatusCode::ABORTED, "data plane: hello failed");
+    }
+    fds_[peer] = fd;
+  }
+  for (int expected = 0; expected < size_ - rank_ - 1; ++expected) {
+    int fd = TcpAccept(listen_fd_);
+    if (fd < 0) {
+      return Status::Error(StatusCode::ABORTED, "data plane: accept failed");
+    }
+    int32_t who = -1;
+    if (RecvAll(fd, &who, sizeof(who)) != 0 || who <= rank_ || who >= size_) {
+      CloseFd(fd);
+      return Status::Error(StatusCode::ABORTED, "data plane: bad hello");
+    }
+    fds_[who] = fd;
+  }
+  return Status::OK();
+}
+
+void DataPlane::Shutdown() {
+  for (int& fd : fds_) {
+    CloseFd(fd);
+    fd = -1;
+  }
+  CloseFd(listen_fd_);
+  listen_fd_ = -1;
+}
+
+Status DataPlane::SendRecv(int send_fd, const void* send_buf,
+                           int64_t send_bytes, int recv_fd, void* recv_buf,
+                           int64_t recv_bytes) {
+  // Concurrent send+recv so large payloads can't deadlock on socket buffers.
+  int send_rc = 0;
+  std::thread sender([&] {
+    if (send_bytes > 0) {
+      send_rc = SendAll(send_fd, send_buf, static_cast<size_t>(send_bytes));
+    }
+  });
+  int recv_rc = 0;
+  if (recv_bytes > 0) {
+    recv_rc = RecvAll(recv_fd, recv_buf, static_cast<size_t>(recv_bytes));
+  }
+  sender.join();
+  if (send_rc != 0 || recv_rc != 0) {
+    return Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
+                            ReduceOp op) {
+  if (size_ == 1 || count == 0) return Status::OK();
+  const size_t elem = DataTypeSize(dtype);
+  uint8_t* buf = static_cast<uint8_t*>(data);
+  const int right = (rank_ + 1) % size_;
+  const int left = (rank_ - 1 + size_) % size_;
+
+  // Chunk boundaries (chunk c covers [starts[c], starts[c+1])).
+  std::vector<int64_t> starts(size_ + 1, 0);
+  int64_t base = count / size_, rem = count % size_;
+  for (int c = 0; c < size_; ++c) {
+    starts[c + 1] = starts[c] + base + (c < rem ? 1 : 0);
+  }
+  auto chunk_ptr = [&](int c) { return buf + starts[c] * elem; };
+  auto chunk_count = [&](int c) { return starts[c + 1] - starts[c]; };
+  int64_t max_chunk = base + (rem > 0 ? 1 : 0);
+  std::vector<uint8_t> recv_tmp(static_cast<size_t>(max_chunk) * elem);
+
+  // Phase 1: ring reduce-scatter. After step s, chunk (rank - s - 1) holds
+  // the partial sum of s + 2 ranks; after size-1 steps, chunk (rank + 1)
+  // holds the full reduction on this rank... (standard ring schedule: send
+  // chunk (rank - s), receive + reduce chunk (rank - s - 1)).
+  for (int s = 0; s < size_ - 1; ++s) {
+    int send_c = ((rank_ - s) % size_ + size_) % size_;
+    int recv_c = ((rank_ - s - 1) % size_ + size_) % size_;
+    Status st = SendRecv(fds_[right], chunk_ptr(send_c),
+                         chunk_count(send_c) * static_cast<int64_t>(elem),
+                         fds_[left], recv_tmp.data(),
+                         chunk_count(recv_c) * static_cast<int64_t>(elem));
+    if (!st.ok()) return st;
+    ReduceBuffer(chunk_ptr(recv_c), recv_tmp.data(), chunk_count(recv_c),
+                 dtype, op);
+  }
+
+  // Phase 2: ring allgather of the reduced chunks.
+  for (int s = 0; s < size_ - 1; ++s) {
+    int send_c = ((rank_ + 1 - s) % size_ + size_) % size_;
+    int recv_c = ((rank_ - s) % size_ + size_) % size_;
+    Status st = SendRecv(fds_[right], chunk_ptr(send_c),
+                         chunk_count(send_c) * static_cast<int64_t>(elem),
+                         fds_[left], chunk_ptr(recv_c),
+                         chunk_count(recv_c) * static_cast<int64_t>(elem));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
+                             const std::vector<int64_t>& block_bytes,
+                             std::vector<uint8_t>* out) {
+  std::vector<int64_t> offsets(size_ + 1, 0);
+  for (int r = 0; r < size_; ++r) offsets[r + 1] = offsets[r] + block_bytes[r];
+  out->resize(static_cast<size_t>(offsets[size_]));
+  memcpy(out->data() + offsets[rank_], in, static_cast<size_t>(in_bytes));
+  if (size_ == 1) return Status::OK();
+  // Pairwise rotation: step k sends my block to rank (rank+k), receives the
+  // block of rank (rank-k).
+  for (int k = 1; k < size_; ++k) {
+    int to = (rank_ + k) % size_;
+    int from = (rank_ - k + size_) % size_;
+    Status st = SendRecv(fds_[to], in, in_bytes, fds_[from],
+                         out->data() + offsets[from], block_bytes[from]);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
+  if (size_ == 1 || bytes == 0) return Status::OK();
+  if (rank_ == root) {
+    for (int r = 0; r < size_; ++r) {
+      if (r == rank_) continue;
+      if (SendAll(fds_[r], data, static_cast<size_t>(bytes)) != 0) {
+        return Status::Error(StatusCode::ABORTED, "broadcast send failed");
+      }
+    }
+  } else {
+    if (RecvAll(fds_[root], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "broadcast recv failed");
+    }
+  }
+  return Status::OK();
+}
+
+Status DataPlane::Alltoallv(const void* in,
+                            const std::vector<int64_t>& send_bytes,
+                            const std::vector<int64_t>& recv_bytes,
+                            std::vector<uint8_t>* out) {
+  std::vector<int64_t> send_off(size_ + 1, 0), recv_off(size_ + 1, 0);
+  for (int r = 0; r < size_; ++r) {
+    send_off[r + 1] = send_off[r] + send_bytes[r];
+    recv_off[r + 1] = recv_off[r] + recv_bytes[r];
+  }
+  out->resize(static_cast<size_t>(recv_off[size_]));
+  const uint8_t* src = static_cast<const uint8_t*>(in);
+  memcpy(out->data() + recv_off[rank_], src + send_off[rank_],
+         static_cast<size_t>(send_bytes[rank_]));
+  for (int k = 1; k < size_; ++k) {
+    int to = (rank_ + k) % size_;
+    int from = (rank_ - k + size_) % size_;
+    Status st = SendRecv(fds_[to], src + send_off[to], send_bytes[to],
+                         fds_[from], out->data() + recv_off[from],
+                         recv_bytes[from]);
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+namespace {
+
+template <typename T>
+void AdasumCombine(T* mine, const T* other, int64_t count, bool i_am_lower) {
+  double dot = 0, mine2 = 0, theirs2 = 0;
+  for (int64_t i = 0; i < count; ++i) {
+    dot += static_cast<double>(mine[i]) * static_cast<double>(other[i]);
+    mine2 += static_cast<double>(mine[i]) * static_cast<double>(mine[i]);
+    theirs2 += static_cast<double>(other[i]) * static_cast<double>(other[i]);
+  }
+  double na2 = i_am_lower ? mine2 : theirs2;
+  double nb2 = i_am_lower ? theirs2 : mine2;
+  double a_coeff = na2 == 0 ? 1.0 : 1.0 - dot / (2.0 * na2);
+  double b_coeff = nb2 == 0 ? 1.0 : 1.0 - dot / (2.0 * nb2);
+  double my_coeff = i_am_lower ? a_coeff : b_coeff;
+  double their_coeff = i_am_lower ? b_coeff : a_coeff;
+  for (int64_t i = 0; i < count; ++i) {
+    mine[i] = static_cast<T>(my_coeff * static_cast<double>(mine[i]) +
+                             their_coeff * static_cast<double>(other[i]));
+  }
+}
+
+template <typename T>
+void AddInto(T* dst, const T* src, int64_t count) {
+  for (int64_t i = 0; i < count; ++i) dst[i] += src[i];
+}
+
+}  // namespace
+
+Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
+  if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64) {
+    return Status::Error(StatusCode::INVALID_ARGUMENT,
+                         "Adasum supports float32/float64 only, got " +
+                             std::string(DataTypeName(dtype)));
+  }
+  if (size_ == 1 || count == 0) return Status::OK();
+  const size_t elem = DataTypeSize(dtype);
+  const int64_t bytes = count * static_cast<int64_t>(elem);
+  std::vector<uint8_t> other(static_cast<size_t>(bytes));
+
+  int p = 1;
+  while (p * 2 <= size_) p *= 2;
+  const int r = size_ - p;
+
+  auto exchange = [&](int peer) -> Status {
+    return SendRecv(fds_[peer], data, bytes, fds_[peer], other.data(), bytes);
+  };
+  auto combine = [&](bool lower) {
+    if (dtype == DataType::FLOAT32) {
+      AdasumCombine(static_cast<float*>(data),
+                    reinterpret_cast<const float*>(other.data()), count, lower);
+    } else {
+      AdasumCombine(static_cast<double*>(data),
+                    reinterpret_cast<const double*>(other.data()), count,
+                    lower);
+    }
+  };
+
+  // Fold extra ranks (>= p) into their partner by plain addition.
+  if (rank_ >= p) {
+    if (SendAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "adasum fold send failed");
+    }
+  } else if (rank_ < r) {
+    if (RecvAll(fds_[rank_ + p], other.data(), static_cast<size_t>(bytes)) !=
+        0) {
+      return Status::Error(StatusCode::ABORTED, "adasum fold recv failed");
+    }
+    if (dtype == DataType::FLOAT32) {
+      AddInto(static_cast<float*>(data),
+              reinterpret_cast<const float*>(other.data()), count);
+    } else {
+      AddInto(static_cast<double*>(data),
+              reinterpret_cast<const double*>(other.data()), count);
+    }
+  }
+
+  if (rank_ < p) {
+    for (int distance = 1; distance < p; distance *= 2) {
+      int peer = rank_ ^ distance;
+      Status st = exchange(peer);
+      if (!st.ok()) return st;
+      combine((rank_ & distance) == 0);
+    }
+  }
+
+  // Broadcast the result to the folded ranks.
+  if (rank_ < r) {
+    if (SendAll(fds_[rank_ + p], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "adasum unfold send failed");
+    }
+  } else if (rank_ >= p) {
+    if (RecvAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "adasum unfold recv failed");
+    }
+  }
+  return Status::OK();
+}
+
+Status DataPlane::ReduceScatter(const void* in, int64_t count, DataType dtype,
+                                ReduceOp op, std::vector<uint8_t>* out) {
+  // Simple implementation on top of ring allreduce: reduce a copy, keep my
+  // chunk. (A dedicated reduce-scatter would halve traffic; the coordinator
+  // only dispatches small eager tensors here — the compiled path owns the hot
+  // loop.)
+  const size_t elem = DataTypeSize(dtype);
+  std::vector<uint8_t> tmp(static_cast<size_t>(count) * elem);
+  memcpy(tmp.data(), in, tmp.size());
+  Status st = Allreduce(tmp.data(), count, dtype, op);
+  if (!st.ok()) return st;
+  int64_t chunk = count / size_;
+  out->assign(tmp.begin() + rank_ * chunk * static_cast<int64_t>(elem),
+              tmp.begin() + (rank_ + 1) * chunk * static_cast<int64_t>(elem));
+  return Status::OK();
+}
+
+}  // namespace hvdtpu

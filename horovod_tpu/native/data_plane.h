@@ -1,0 +1,87 @@
+// Host data plane: TCP mesh between ranks + collective algorithms.
+//
+// Fills the role of the reference's Gloo/MPI CPU data plane
+// (horovod/common/ops/gloo_operations.cc, mpi_operations.cc): ring allreduce
+// (reduce-scatter + allgather, like MPI/NCCL ring), rotation-based allgatherv,
+// direct-send broadcast, and pairwise alltoallv — over plain TCP, no MPI.
+// fp16/bf16 are accumulated in float (reference: half.{h,cc}).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+struct PeerAddr {
+  std::string host;
+  int port = 0;
+};
+
+class DataPlane {
+ public:
+  DataPlane(int rank, int size);
+  ~DataPlane();
+
+  // Start listening; returns the bound (ephemeral) port to advertise.
+  Status Listen();
+  int port() const { return port_; }
+
+  // Establish the mesh: connect to lower ranks, accept from higher ranks.
+  Status Connect(const std::vector<PeerAddr>& peers);
+
+  void Shutdown();
+
+  // In-place ring allreduce over `count` elements (SUM/MIN/MAX/PRODUCT;
+  // AVERAGE is SUM + caller-side postscale, reference operations.cc:928).
+  Status Allreduce(void* data, int64_t count, DataType dtype, ReduceOp op);
+
+  // Gather variable-length byte blocks from every rank; out = concatenated in
+  // rank order. block_bytes[r] gives each rank's contribution size.
+  Status Allgatherv(const void* in, int64_t in_bytes,
+                    const std::vector<int64_t>& block_bytes,
+                    std::vector<uint8_t>* out);
+
+  Status Broadcast(void* data, int64_t bytes, int root);
+
+  // Pairwise alltoallv: send_bytes[r] from my buffer to rank r (contiguous,
+  // in rank order); recv_bytes[r] received from rank r into out (rank order).
+  Status Alltoallv(const void* in, const std::vector<int64_t>& send_bytes,
+                   const std::vector<int64_t>& recv_bytes,
+                   std::vector<uint8_t>* out);
+
+  // Reduce then keep this rank's contiguous chunk (count must divide evenly;
+  // validated by the coordinator before dispatch).
+  Status ReduceScatter(const void* in, int64_t count, DataType dtype,
+                       ReduceOp op, std::vector<uint8_t>* out);
+
+  // In-place Adasum reduction (float32/float64): hypercube pairwise exchange
+  // with the adaptive combine a*(1 - dot/2|a|^2) + b*(1 - dot/2|b|^2)
+  // (reference: horovod/common/ops/adasum/adasum.h:38). Non-power-of-two
+  // worlds fold extra ranks in by addition first, like the Python/XLA path.
+  Status AdasumAllreduce(void* data, int64_t count, DataType dtype);
+
+ private:
+  Status SendRecv(int send_fd, const void* send_buf, int64_t send_bytes,
+                  int recv_fd, void* recv_buf, int64_t recv_bytes);
+
+  int rank_;
+  int size_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::vector<int> fds_;  // per-peer connection; -1 for self
+};
+
+// dst[i] = dst[i] OP src[i], accumulating fp16/bf16 in float.
+void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
+                  ReduceOp op);
+
+// Half-precision conversions (reference: horovod/common/half.{h,cc}).
+float HalfToFloatPublic(uint16_t h);
+uint16_t FloatToHalfPublic(float f);
+float Bf16ToFloatPublic(uint16_t h);
+uint16_t FloatToBf16Public(float f);
+
+}  // namespace hvdtpu

@@ -1,0 +1,40 @@
+// Minimal TCP plumbing: listeners, retrying connects, length-prefixed frames.
+//
+// Fills the role of the reference's gloo TCP device + HTTPStore rendezvous
+// (horovod/common/gloo/) with plain POSIX sockets — the control plane and the
+// loopback/CPU data plane both ride these.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// All functions return >= 0 on success, -1 on error (errno preserved).
+
+// Create a listening socket bound to 0.0.0.0:port (port 0 = ephemeral).
+// On success stores the actual port in *out_port.
+int TcpListen(int port, int backlog, int* out_port);
+
+// Accept one connection (blocking). Returns connected fd.
+int TcpAccept(int listen_fd);
+
+// Connect to host:port, retrying for up to timeout_ms (covers peer startup
+// races during rendezvous). Returns connected fd.
+int TcpConnectRetry(const std::string& host, int port, int timeout_ms);
+
+// Exact-length send/recv (loop over partial transfers). 0 on success.
+int SendAll(int fd, const void* buf, size_t len);
+int RecvAll(int fd, void* buf, size_t len);
+
+// Length-prefixed frame: [u64 length][payload].
+int SendFrame(int fd, const std::vector<uint8_t>& payload);
+int RecvFrame(int fd, std::vector<uint8_t>* payload);
+
+// True if the fd has readable data (poll with timeout_ms; 0 = nonblocking).
+bool Readable(int fd, int timeout_ms);
+
+void CloseFd(int fd);
+
+}  // namespace hvdtpu

@@ -1,0 +1,133 @@
+#include "timeline.h"
+
+namespace hvdtpu {
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Initialize(const std::string& path, int rank) {
+  if (initialized_ || path.empty()) return;
+  file_ = fopen(path.c_str(), "w");
+  if (file_ == nullptr) return;
+  rank_ = rank;
+  start_ = std::chrono::steady_clock::now();
+  fputs("[\n", file_);
+  first_ = true;
+  stop_ = false;
+  initialized_ = true;
+  writer_ = std::thread([this] { WriterLoop(); });
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  fputs("\n]\n", file_);
+  fclose(file_);
+  file_ = nullptr;
+  initialized_ = false;
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+namespace {
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += c;
+    } else if (static_cast<unsigned char>(c) < 0x20) {
+      out += ' ';
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+void Timeline::Emit(const std::string& name, char ph,
+                    const std::string& args_json, const std::string& cat) {
+  if (!initialized_) return;
+  // One row ("pid") per tensor name, one thread row per rank — mirrors the
+  // reference's tensor-as-process layout (timeline.cc:254-276). Built with
+  // std::string so long tensor names can't truncate into invalid JSON.
+  std::string e = "{\"name\": \"";
+  e += JsonEscape(cat.empty() ? name : cat);
+  e += "\", \"ph\": \"";
+  e += ph;
+  e += "\", \"ts\": " + std::to_string(NowUs());
+  e += ", \"pid\": \"" + JsonEscape(name) + "\", \"tid\": " +
+       std::to_string(rank_);
+  if (!args_json.empty()) e += ", \"args\": " + args_json;
+  if (!cat.empty()) e += ", \"cat\": \"" + JsonEscape(cat) + "\"";
+  e += "}";
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push(Event{std::move(e)});
+  }
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (true) {
+    cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+    while (!queue_.empty()) {
+      Event e = std::move(queue_.front());
+      queue_.pop();
+      lk.unlock();
+      if (!first_) fputs(",\n", file_);
+      first_ = false;
+      fputs(e.json.c_str(), file_);
+      lk.lock();
+    }
+    if (stop_ && queue_.empty()) break;
+  }
+  fflush(file_);
+}
+
+void Timeline::NegotiateStart(const std::string& name) {
+  Emit(name, 'B', "", "NEGOTIATE");
+}
+
+void Timeline::NegotiateEnd(const std::string& name) { Emit(name, 'E', ""); }
+
+void Timeline::QueueStart(const std::string& name) {
+  Emit(name, 'B', "", "QUEUE");
+}
+
+void Timeline::ActivityStart(const std::string& name,
+                             const std::string& activity) {
+  Emit(name, 'B', "", activity);
+}
+
+void Timeline::ActivityEnd(const std::string& name) { Emit(name, 'E', ""); }
+
+void Timeline::OpDone(const std::string& name, const std::string& result) {
+  Emit(name, 'E', "{\"result\": \"" + result + "\"}");
+}
+
+void Timeline::MarkCycle() {
+  char buf[160];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"CYCLE %d\", \"ph\": \"i\", \"ts\": %lld, "
+           "\"pid\": \"cycle\", \"tid\": %d, \"s\": \"g\"}",
+           cycle_++, static_cast<long long>(NowUs()), rank_);
+  std::lock_guard<std::mutex> lk(mu_);
+  queue_.push(Event{std::string(buf)});
+  cv_.notify_one();
+}
+
+}  // namespace hvdtpu

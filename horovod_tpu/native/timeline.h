@@ -1,0 +1,61 @@
+// Chrome-tracing timeline writer.
+//
+// Reference: horovod/common/timeline.{h,cc} — per-op JSON events viewable in
+// chrome://tracing / Perfetto, with NEGOTIATE / QUEUE / operation phases
+// (phase names from horovod/common/common.h:32-66). Here events are written
+// by a dedicated writer thread fed through a lock-free-enough queue, like the
+// reference's async writer (timeline.cc:185-380).
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  ~Timeline();
+
+  // No-op unless initialized. file comes from HVDTPU_TIMELINE.
+  void Initialize(const std::string& path, int rank);
+  void Shutdown();
+  bool Initialized() const { return initialized_; }
+
+  // Phase events for a named tensor (tensor name becomes the trace "pid" row,
+  // like the reference, timeline.cc:254-276).
+  void NegotiateStart(const std::string& name);
+  void NegotiateEnd(const std::string& name);
+  void QueueStart(const std::string& name);
+  void ActivityStart(const std::string& name, const std::string& activity);
+  void ActivityEnd(const std::string& name);
+  void OpDone(const std::string& name, const std::string& result);
+  void MarkCycle();  // HVDTPU_TIMELINE_MARK_CYCLES
+
+ private:
+  struct Event {
+    std::string json;
+  };
+  void Emit(const std::string& name, char ph, const std::string& args_json,
+            const std::string& cat = "");
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  bool initialized_ = false;
+  int rank_ = 0;
+  FILE* file_ = nullptr;
+  bool first_ = true;
+  std::chrono::steady_clock::time_point start_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<Event> queue_;
+  bool stop_ = false;
+  std::thread writer_;
+  int cycle_ = 0;
+};
+
+}  // namespace hvdtpu

@@ -1,1 +1,72 @@
-"""horovod_tpu.runner subpackage (hvdrun launcher)."""
+"""Launcher / runner (reference: horovod/runner/).
+
+``hvdrun`` CLI (launch.py) plus the programmatic ``run()`` API
+(reference: ``horovod.run``, horovod/runner/__init__.py:99).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+try:
+    import cloudpickle as _fn_pickler  # function serialization by value
+except ImportError:  # pragma: no cover
+    _fn_pickler = pickle
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+        np: int = 2, hosts: Optional[str] = None, verbose: bool = False,
+        use_gloo: bool = True, use_mpi: bool = False,
+        **launcher_kwargs) -> List[Any]:
+    """Run ``fn`` on ``np`` worker processes and return per-rank results
+    (reference signature: ``horovod.run``, horovod/runner/__init__.py:99;
+    ``use_gloo``/``use_mpi`` accepted for parity — the native TCP controller
+    always fills the gloo role, there is no MPI).
+    """
+    from .launch import parse_args, run_launcher
+    from . import hosts as hosts_mod
+
+    if hosts:
+        import socket as _socket
+        local_names = {"localhost", "127.0.0.1", _socket.gethostname()}
+        remote = [h for h, _ in hosts_mod.parse_hosts(hosts)
+                  if h not in local_names]
+        if remote:
+            # The pickled fn and per-rank result files live in a
+            # launcher-local temp dir, which remote workers can't see.
+            raise NotImplementedError(
+                f"programmatic run() is local-only (remote hosts {remote} "
+                "would need a shared filesystem); use the hvdrun CLI for "
+                "multi-host jobs")
+
+    kwargs = kwargs or {}
+    with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as tmp:
+        fn_path = os.path.join(tmp, "fn.pkl")
+        out_path = os.path.join(tmp, "out")
+        with open(fn_path, "wb") as f:
+            _fn_pickler.dump((fn, args, kwargs), f)
+        argv = ["-np", str(np)]
+        if hosts:
+            argv += ["-H", hosts]
+        if verbose:
+            argv += ["--verbose"]
+        for k, v in launcher_kwargs.items():
+            flag = "--" + k.replace("_", "-")
+            if v is True:
+                argv.append(flag)
+            elif v is not False and v is not None:
+                argv += [flag, str(v)]
+        argv += [sys.executable, "-m", "horovod_tpu.runner.task_runner",
+                 fn_path, out_path]
+        rc = run_launcher(parse_args(argv))
+        if rc != 0:
+            raise RuntimeError(f"hvdrun job failed with exit code {rc}")
+        results = []
+        for rank in range(np):
+            with open(f"{out_path}.{rank}", "rb") as f:
+                results.append(pickle.load(f))
+        return results

@@ -1,0 +1,98 @@
+"""Host/slot parsing and rank assignment.
+
+Reference: ``horovod/runner/common/util/hosts.py`` (``SlotInfo`` :34,
+``parse_hosts``, ``get_host_assignments`` :100 — rank / local_rank /
+cross_rank assignment ordered by host list).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    size: int
+
+
+def parse_hosts(hosts_str: str) -> List[Tuple[str, int]]:
+    """Parse ``"host1:2,host2:4"`` into [(host, slots)]
+    (reference: ``hosts.py`` parse_hosts)."""
+    out = []
+    for part in hosts_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.rsplit(":", 1)
+            out.append((host, int(slots)))
+        else:
+            out.append((part, 1))
+    return out
+
+
+def parse_hostfile(path: str) -> List[Tuple[str, int]]:
+    """Parse an mpirun-style hostfile: ``host slots=N`` per line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=", 1)[1])
+            out.append((host, slots))
+    return out
+
+
+def get_host_assignments(hosts: List[Tuple[str, int]],
+                         np_: int) -> List[SlotInfo]:
+    """Assign global/local/cross ranks to ``np_`` slots across hosts
+    (reference: ``hosts.py:100`` — fill hosts in order; cross_rank is the
+    index of the host among hosts that have a worker at that local_rank)."""
+    # Merge duplicate hostnames (summing slots) so repeated entries like
+    # "h:1,h:1" can't produce colliding cross_rank coordinates.
+    merged: List[Tuple[str, int]] = []
+    index = {}
+    for host, cap in hosts:
+        if host in index:
+            merged[index[host]] = (host, merged[index[host]][1] + cap)
+        else:
+            index[host] = len(merged)
+            merged.append((host, cap))
+    hosts = merged
+    total = sum(s for _, s in hosts)
+    if total < np_:
+        raise ValueError(
+            f"requested -np {np_} but only {total} slots available: {hosts}")
+    slots: List[SlotInfo] = []
+    rank = 0
+    host_indices = []  # (host, local_size_used)
+    for host, cap in hosts:
+        if rank >= np_:
+            break
+        use = min(cap, np_ - rank)
+        host_indices.append((host, use))
+        for lr in range(use):
+            slots.append(SlotInfo(hostname=host, rank=rank, local_rank=lr,
+                                  local_size=use, cross_rank=0, cross_size=0,
+                                  size=np_))
+            rank += 1
+    # cross_rank: position of this host among hosts having this local_rank;
+    # cross_size: number of such hosts.
+    for s in slots:
+        hosts_with_lr = [h for h, use in host_indices if use > s.local_rank]
+        s.cross_rank = hosts_with_lr.index(s.hostname)
+        s.cross_size = len(hosts_with_lr)
+    return slots

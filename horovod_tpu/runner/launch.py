@@ -1,0 +1,149 @@
+"""``hvdrun`` — the launcher CLI.
+
+Reference: ``horovod/runner/launch.py`` (``parse_args`` :212, ``_run_static``
+:531, ``run_controller`` :679) + ``gloo_run.py`` (env injection :70-95, worker
+exec :213-258). Launches N worker processes (locally or over SSH), injects the
+``HVDTPU_*`` topology env (the reference injects ``HOROVOD_*``), picks the
+controller endpoint (rank 0's host), and supervises the job.
+
+    hvdrun -np 4 python train.py
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+from typing import List
+
+from . import hosts as hosts_mod
+from . import safe_exec
+from ..utils import envvars as ev
+
+
+def parse_args(argv: List[str] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu process-mode job "
+                    "(Horovod-parity runner; reference: horovodrun)")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="total number of worker processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='host list "h1:slots,h2:slots" (default: localhost)')
+    p.add_argument("--hostfile", default=None,
+                   help="mpirun-style hostfile (host slots=N per line)")
+    p.add_argument("-p", "--start-port", type=int, default=0,
+                   help="controller port (default: free ephemeral port)")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--timeline", default=None,
+                   help="write per-rank Chrome-trace timelines to "
+                        "FILE.rank.json (reference: --timeline-filename)")
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--fusion-threshold-mb", type=float, default=64.0,
+                   help="tensor fusion threshold (reference: "
+                        "HOROVOD_FUSION_THRESHOLD)")
+    p.add_argument("--cycle-time-ms", type=float, default=1.0,
+                   help="background cycle time (reference: HOROVOD_CYCLE_TIME)")
+    p.add_argument("--stall-check-disable", action="store_true")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   default=60.0)
+    p.add_argument("--autotune", action="store_true",
+                   help="enable fusion/cycle autotuning")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="worker command, e.g. python train.py")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no worker command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _build_env(slot: hosts_mod.SlotInfo, args, controller_host: str,
+               controller_port: int) -> dict:
+    env = dict(os.environ)
+    env[ev.HVDTPU_RANK] = str(slot.rank)
+    env[ev.HVDTPU_SIZE] = str(slot.size)
+    env[ev.HVDTPU_LOCAL_RANK] = str(slot.local_rank)
+    env[ev.HVDTPU_LOCAL_SIZE] = str(slot.local_size)
+    env[ev.HVDTPU_CROSS_RANK] = str(slot.cross_rank)
+    env[ev.HVDTPU_CROSS_SIZE] = str(slot.cross_size)
+    env[ev.HVDTPU_HOSTNAME] = slot.hostname
+    env[ev.HVDTPU_CONTROLLER_ADDR] = controller_host
+    env[ev.HVDTPU_CONTROLLER_PORT] = str(controller_port)
+    env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
+    env[ev.HVDTPU_FUSION_THRESHOLD] = str(
+        int(args.fusion_threshold_mb * 1024 * 1024))
+    if args.timeline:
+        env[ev.HVDTPU_TIMELINE] = f"{args.timeline}.{slot.rank}.json"
+    if args.timeline_mark_cycles:
+        env[ev.HVDTPU_TIMELINE_MARK_CYCLES] = "1"
+    if args.stall_check_disable:
+        env[ev.HVDTPU_STALL_CHECK_DISABLE] = "1"
+    env[ev.HVDTPU_STALL_CHECK_TIME_SECONDS] = str(
+        args.stall_check_warning_time_seconds)
+    if args.autotune:
+        env[ev.HVDTPU_AUTOTUNE] = "1"
+        if args.autotune_log_file:
+            env[ev.HVDTPU_AUTOTUNE_LOG] = args.autotune_log_file
+    return env
+
+
+def _is_local(host: str) -> bool:
+    return host in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def _ssh_wrap(host: str, ssh_port: int, env: dict, command: List[str]) -> List[str]:
+    """Build the SSH remote command with env forwarding
+    (reference: gloo_run.py get_remote_command)."""
+    exports = " ".join(
+        f"{k}={v!r}" for k, v in env.items() if k.startswith("HVDTPU_"))
+    remote = f"cd {os.getcwd()!r} 2>/dev/null; env {exports} " + \
+        " ".join(command)
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port),
+            host, remote]
+
+
+def run_launcher(args: argparse.Namespace) -> int:
+    host_list = (hosts_mod.parse_hostfile(args.hostfile) if args.hostfile
+                 else hosts_mod.parse_hosts(args.hosts or
+                                            f"localhost:{args.num_proc}"))
+    slots = hosts_mod.get_host_assignments(host_list, args.num_proc)
+    controller_host = slots[0].hostname
+    controller_port = args.start_port or _free_port()
+
+    commands, envs, names = [], [], []
+    for slot in slots:
+        env = _build_env(slot, args, controller_host, controller_port)
+        if _is_local(slot.hostname):
+            commands.append(list(args.command))
+            envs.append(env)
+        else:
+            commands.append(_ssh_wrap(slot.hostname, args.ssh_port, env,
+                                      args.command))
+            envs.append(dict(os.environ))
+        names.append(f"rank{slot.rank}@{slot.hostname}")
+        if args.verbose:
+            print(f"hvdrun: {names[-1]}: {' '.join(commands[-1])}",
+                  file=sys.stderr)
+    return safe_exec.run_workers(commands, envs, names, verbose=args.verbose)
+
+
+def main(argv: List[str] = None) -> int:
+    return run_launcher(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Process-group-safe subprocess execution.
+
+Reference: ``horovod/runner/common/util/safe_shell_exec.py`` (227 LoC) — spawn
+workers in their own process group, forward termination, and kill the whole
+group on failure so no orphans survive a crashed run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class WorkerProcess:
+    def __init__(self, cmd: List[str], env: Dict[str, str], name: str,
+                 stdout=None, stderr=None):
+        self.name = name
+        self.proc = subprocess.Popen(
+            cmd, env=env, stdout=stdout, stderr=stderr,
+            start_new_session=True)  # own process group
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def wait(self) -> int:
+        return self.proc.wait()
+
+    def terminate(self, grace_s: float = 3.0) -> None:
+        if self.proc.poll() is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def run_workers(commands: List[List[str]], envs: List[Dict[str, str]],
+                names: List[str], verbose: bool = False) -> int:
+    """Run all workers; if any exits non-zero, terminate the rest
+    (reference: gloo_run.py launch_gloo thread-per-worker exec)."""
+    workers = [WorkerProcess(cmd, env, name)
+               for cmd, env, name in zip(commands, envs, names)]
+    first_failure: List[int] = []
+
+    def watch(w: WorkerProcess):
+        rc = w.wait()
+        if rc != 0 and not first_failure:
+            first_failure.append(rc)
+            sys.stderr.write(
+                f"hvdrun: worker {w.name} exited with code {rc}; "
+                "terminating remaining workers\n")
+            for other in workers:
+                if other is not w:
+                    other.terminate()
+
+    threads = [threading.Thread(target=watch, args=(w,), daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join()
+    except KeyboardInterrupt:
+        for w in workers:
+            w.terminate()
+        return 130
+    return first_failure[0] if first_failure else 0

@@ -1,0 +1,31 @@
+"""Worker-side entry for the programmatic ``run()`` API: unpickle the function,
+run it under the initialized runtime, pickle the result back.
+
+Reference: the remote-exec side of ``horovod.run`` (``horovod/runner/__init__.py:99``
++ ``run/__init__.py`` wrapped-function temp-file protocol).
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+
+
+def main() -> int:
+    fn_path, out_path = sys.argv[1], sys.argv[2]
+    with open(fn_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        result = fn(*args, **kwargs)
+    finally:
+        rank = hvd.rank()
+        hvd.shutdown()
+    with open(f"{out_path}.{rank}", "wb") as f:
+        pickle.dump(result, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Runner unit tests (no processes spawned).
+
+Reference: ``test/test_run.py`` (944 LoC, 44 tests) — arg parsing, host
+parsing, ``get_host_assignments``.
+"""
+
+import pytest
+
+from horovod_tpu.runner import hosts
+from horovod_tpu.runner.launch import parse_args
+
+
+class TestHostParsing:
+    def test_parse_hosts(self):
+        assert hosts.parse_hosts("a:2,b:4") == [("a", 2), ("b", 4)]
+        assert hosts.parse_hosts("a") == [("a", 1)]
+        assert hosts.parse_hosts("a:1, b:2 ,") == [("a", 1), ("b", 2)]
+
+    def test_parse_hostfile(self, tmp_path):
+        f = tmp_path / "hostfile"
+        f.write_text("h1 slots=4\n# comment\nh2 slots=2\nh3\n")
+        assert hosts.parse_hostfile(str(f)) == [("h1", 4), ("h2", 2),
+                                                ("h3", 1)]
+
+
+class TestAssignments:
+    def test_single_host(self):
+        slots = hosts.get_host_assignments([("localhost", 4)], 4)
+        assert [s.rank for s in slots] == [0, 1, 2, 3]
+        assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+        assert all(s.local_size == 4 and s.cross_size == 1 and
+                   s.cross_rank == 0 for s in slots)
+
+    def test_two_hosts(self):
+        """Reference: hosts.py:100 — rank-major across hosts in order."""
+        slots = hosts.get_host_assignments([("a", 2), ("b", 2)], 4)
+        assert [(s.hostname, s.rank, s.local_rank) for s in slots] == [
+            ("a", 0, 0), ("a", 1, 1), ("b", 2, 0), ("b", 3, 1)]
+        assert all(s.cross_size == 2 for s in slots)
+        assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+
+    def test_partial_use(self):
+        slots = hosts.get_host_assignments([("a", 4), ("b", 4)], 5)
+        assert [s.hostname for s in slots] == ["a"] * 4 + ["b"]
+        assert slots[4].local_size == 1
+
+    def test_uneven_cross_ranks(self):
+        slots = hosts.get_host_assignments([("a", 2), ("b", 1)], 3)
+        # local_rank 0 exists on both hosts; local_rank 1 only on a.
+        by = {(s.hostname, s.local_rank): s for s in slots}
+        assert by[("a", 0)].cross_size == 2
+        assert by[("b", 0)].cross_rank == 1
+        assert by[("a", 1)].cross_size == 1
+
+    def test_insufficient_slots(self):
+        with pytest.raises(ValueError):
+            hosts.get_host_assignments([("a", 2)], 4)
+
+
+class TestArgParsing:
+    def test_basic(self):
+        args = parse_args(["-np", "4", "python", "train.py", "--lr", "0.1"])
+        assert args.num_proc == 4
+        assert args.command == ["python", "train.py", "--lr", "0.1"]
+
+    def test_flags(self):
+        args = parse_args(["-np", "2", "-H", "h1:2", "--cycle-time-ms", "5",
+                           "--fusion-threshold-mb", "16", "--timeline", "/t",
+                           "python", "x.py"])
+        assert args.hosts == "h1:2"
+        assert args.cycle_time_ms == 5.0
+        assert args.fusion_threshold_mb == 16.0
+
+    def test_missing_command(self):
+        with pytest.raises(SystemExit):
+            parse_args(["-np", "2"])
+
+
+class TestDuplicateHosts:
+    def test_repeated_hostname_merged(self):
+        slots = hosts.get_host_assignments([("h", 1), ("h", 1)], 2)
+        assert [(s.rank, s.local_rank) for s in slots] == [(0, 0), (1, 1)]
+        assert all(s.cross_size == 1 and s.cross_rank == 0 for s in slots)
