@@ -27,7 +27,7 @@ from .ops.collectives import (
     release_handle,
     # In-step primitives (inside shard_map / run_step).
     allreduce_p, allgather_p, broadcast_p, alltoall_p, reducescatter_p,
-    ppermute_p, rank_in_step, size_in_step, in_named_trace,
+    ppermute_p, rank_in_step, size_in_step, in_named_trace, pvary,
 )
 
 # Optimizer / gradient API (reference: horovod/torch/optimizer.py,
@@ -50,7 +50,18 @@ from .exceptions import (HvdTpuInternalError, HostsUpdatedInterrupt,
                          TensorShapeMismatchError, TensorDtypeMismatchError,
                          DuplicateNameError, NotInitializedError)
 
+from .callbacks import (average_metrics, warmup_schedule,  # noqa: E402
+                        BestModelCheckpoint)
 from . import elastic  # noqa: E402  (reference: horovod/torch/elastic.py)
+
+
+def __getattr__(name):
+    # SyncBatchNorm is the only top-level symbol needing flax; load lazily so
+    # `import horovod_tpu` works in flax-less environments.
+    if name == "SyncBatchNorm":
+        from .parallel.sync_batch_norm import SyncBatchNorm
+        return SyncBatchNorm
+    raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")
 
 
 def mpi_threads_supported() -> bool:

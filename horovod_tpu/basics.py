@@ -108,15 +108,19 @@ class NativeCore:
 
     def __init__(self, rank: int, size: int, local_rank: int = 0,
                  local_size: int = 1, cross_rank: Optional[int] = None,
-                 cross_size: Optional[int] = None):
+                 cross_size: Optional[int] = None,
+                 coord_host: Optional[str] = None,
+                 coord_port: Optional[int] = None):
         global _lib
         if _lib is None:
             _lib = _load_lib()
         self._lib = _lib
         self.rank = rank
         self.size = size
-        coord_host = ev.get_str(ev.HVDTPU_CONTROLLER_ADDR, "127.0.0.1")
-        coord_port = ev.get_int(ev.HVDTPU_CONTROLLER_PORT, 29500)
+        if coord_host is None:
+            coord_host = ev.get_str(ev.HVDTPU_CONTROLLER_ADDR, "127.0.0.1")
+        if coord_port is None:
+            coord_port = ev.get_int(ev.HVDTPU_CONTROLLER_PORT, 29500)
         my_host = ev.get_str(ev.HVDTPU_HOSTNAME, "127.0.0.1")
         cycle_ms = ev.get_float(ev.HVDTPU_CYCLE_TIME, 1.0)
         fusion = ev.get_int(ev.HVDTPU_FUSION_THRESHOLD, 64 * 1024 * 1024)

@@ -1,0 +1,108 @@
+"""Training callbacks: metric averaging, LR warmup/schedule, best-checkpoint.
+
+Reference: ``horovod/_keras/callbacks.py`` —
+``BroadcastGlobalVariablesCallbackImpl`` (:22), ``MetricAverageCallback``
+(:48), ``LearningRateScheduleCallbackImpl`` / warmup (:66+), and
+``BestModelCheckpoint`` (``horovod/keras/callbacks.py:157``).
+
+TPU-native redesign: no Keras here — these are functional helpers for JAX
+training loops (metric averaging as a collective, LR warmup as an optax
+schedule, best-checkpoint via orbax when available, pickle otherwise).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import runtime
+from .ops import collectives as C
+
+
+def average_metrics(metrics: Dict[str, Any],
+                    axis: Optional[str] = None) -> Dict[str, Any]:
+    """Average scalar metrics across ranks
+    (reference: MetricAverageCallback, _keras/callbacks.py:48)."""
+    return {k: C.allreduce(jnp.asarray(v), name=f"metric.{k}",
+                           op=C.ReduceOp.AVERAGE, axis=axis)
+            for k, v in metrics.items()}
+
+
+def warmup_schedule(base_lr: float, warmup_steps: int,
+                    scale_to_world: bool = True,
+                    after: Optional[Callable[[int], float]] = None):
+    """LR warmup from ``base_lr`` to ``base_lr * size`` over ``warmup_steps``
+    (reference: LearningRateWarmupCallbackImpl, _keras/callbacks.py:66+ —
+    the linear-scaling rule from the Horovod paper). Returns an optax-style
+    ``schedule(step) -> lr``.
+    """
+    world = runtime.size() if (scale_to_world and runtime.is_initialized()) \
+        else 1
+    target = base_lr * world
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / max(warmup_steps, 1), 0.0, 1.0)
+        lr = base_lr + (target - base_lr) * frac
+        if after is not None:
+            lr = jnp.where(step >= warmup_steps,
+                           jnp.asarray(after(step), jnp.float32), lr)
+        return lr
+
+    return schedule
+
+
+class BestModelCheckpoint:
+    """Keep the best checkpoint by a monitored metric, saving on rank 0 only
+    (reference: ``horovod/keras/callbacks.py:157``). Uses orbax when
+    available; falls back to pickle."""
+
+    def __init__(self, path: str, monitor: str = "val_loss",
+                 mode: str = "min"):
+        self.path = path
+        self.monitor = monitor
+        self.mode = mode
+        self.best: Optional[float] = None
+
+    def __call__(self, metrics: Dict[str, Any], state: Any) -> bool:
+        """Record ``state`` if ``metrics[self.monitor]`` improved; returns
+        True when a checkpoint was written."""
+        value = float(np.asarray(metrics[self.monitor]))
+        improved = (self.best is None or
+                    (value < self.best if self.mode == "min"
+                     else value > self.best))
+        if not improved:
+            return False
+        self.best = value
+        if runtime.is_initialized() and runtime.rank() != 0:
+            return False  # only rank 0 writes (reference: keras/callbacks.py)
+        self._save(state)
+        return True
+
+    def _save(self, state: Any) -> None:
+        host_state = jax.device_get(state)
+        try:
+            import orbax.checkpoint as ocp
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.abspath(self.path), host_state, force=True)
+        except Exception:
+            with open(self.path if self.path.endswith(".pkl")
+                      else self.path + ".pkl", "wb") as f:
+                pickle.dump(host_state, f)
+
+    def load(self) -> Any:
+        try:
+            import orbax.checkpoint as ocp
+            if os.path.isdir(self.path):
+                return ocp.PyTreeCheckpointer().restore(
+                    os.path.abspath(self.path))
+        except Exception:
+            pass
+        pkl = self.path if self.path.endswith(".pkl") else self.path + ".pkl"
+        with open(pkl, "rb") as f:
+            return pickle.load(f)

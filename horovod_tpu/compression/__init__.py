@@ -75,3 +75,14 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+
+# Quantization subsystem (IST fork parity) — imported lazily at the bottom to
+# avoid a circular import (quantize/reducers need jax-level helpers only).
+from .quantize import (MaxMinQuantizer, NormalizedQuantizer,  # noqa: E402
+                       TopKCompressor, set_quantization_levels,
+                       DEFAULT_BUCKET_SIZE)
+from .error_feedback import (init_error_feedback,  # noqa: E402
+                             compress_with_feedback)
+from .reducers import compressed_allreduce  # noqa: E402
+from .config import CompressionConfig, make_compressor, from_env  # noqa: E402

@@ -1,0 +1,40 @@
+"""Error feedback: carry quantization error into the next step.
+
+Reference: ``horovod/common/ops/compressed/compression/error_feedback.{h,cc}``
+(h:10-31) + ``feedback_buffer_manager.{h,cc}`` — per-tensor residual buffers,
+enabled by ``HOROVOD_COMPRESSION_ERROR_FEEDBACK``: the compressor sees
+``x + residual`` and the new residual is what compression lost.
+
+TPU-native redesign: residuals are explicit functional state (a pytree the
+caller threads through the step, like optimizer state) instead of hidden
+per-tensor buffers — so the whole thing jits and shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_error_feedback(tree: Any) -> Any:
+    """Zero residuals shaped like the gradient pytree."""
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def compress_with_feedback(compressor, x: jnp.ndarray,
+                           residual: Optional[jnp.ndarray],
+                           key: Optional[jax.Array] = None
+                           ) -> Tuple[Any, Any, jnp.ndarray]:
+    """Compress ``x + residual``; return (payload, ctx, new_residual).
+
+    new_residual = (x + residual) - decompress(payload) — exactly the
+    information the lossy step dropped (reference: error_feedback.h:10-31).
+    """
+    comp_in = x if residual is None else x + residual.astype(x.dtype)
+    payload, ctx = compressor.compress(comp_in, key)
+    reconstructed = compressor.decompress(payload, ctx)
+    new_residual = (comp_in - reconstructed).astype(
+        residual.dtype if residual is not None else x.dtype)
+    return payload, ctx, new_residual

@@ -62,6 +62,7 @@ class State:
         """Drain host-update messages; raise ``HostsUpdatedInterrupt`` once all
         ranks agree an update happened (reference :93-107 — the max-timestamp
         allreduce keeps ranks in lockstep)."""
+        notification_manager.poll()
         last_updated_timestamp = prev_timestamp = self._last_updated_timestamp
         all_update = 0
         while not self._host_messages.empty():
@@ -176,6 +177,7 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
                     return func(state, *args, **kwargs)
                 except HvdTpuInternalError:
                     log.warning("elastic: internal error — restoring last commit")
+                    notification_manager.post_failure_hint()
                     state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:
@@ -203,25 +205,63 @@ def run(func: Callable) -> Callable:
 
 
 class _NotificationManager:
-    """Listener registry fed by the worker notification service
-    (reference: ``horovod/runner/elastic/worker.py`` WorkerNotificationManager).
-    The HTTP service that feeds it lands with the elastic driver; in-process
-    use (tests, SPMD mode) pushes updates directly via :meth:`handle_hosts_updated`.
+    """Listener registry fed by the elastic driver's KV store.
+
+    Reference: ``horovod/runner/elastic/worker.py`` — the reference *pushes*
+    updates into an HTTP service inside each worker; here workers *poll* the
+    driver's ``/rendezvous/updates`` key at each ``state.commit()`` (same
+    latency class — commits are the only interruption points anyway — and no
+    per-worker server). In-process tests push via
+    :meth:`handle_hosts_updated` directly.
     """
 
     def __init__(self):
         self._listeners: List[State] = []
         self._initialized = False
+        self._client = None
+        self._seen_epoch = 0
 
     def init(self) -> None:
         if self._initialized:
             return
         self._initialized = True
+        import os
+
+        from ..utils import envvars as ev
+        addr = os.environ.get(ev.HVDTPU_RENDEZVOUS_ADDR)
+        if addr:
+            from ..runner.http_kv import KVStoreClient
+            from .. import runtime as _rt
+            self._client = KVStoreClient(
+                addr, int(os.environ.get(ev.HVDTPU_RENDEZVOUS_PORT, "0")))
+            self._seen_epoch = _rt._elastic_last_epoch
+
+    def poll(self) -> None:
+        """Check the driver for membership changes (no-op outside elastic)."""
+        if self._client is None:
+            return
         try:
-            from ..runner.elastic_worker import start_notification_service
-            start_notification_service(self)
+            raw = self._client.get("/rendezvous/updates")
         except Exception:
-            # No driver / not launched elastically: local-only notifications.
+            return
+        if not raw:
+            return
+        epoch = int(raw)
+        from .. import runtime as _rt
+        if epoch > max(self._seen_epoch, _rt._elastic_last_epoch):
+            self._seen_epoch = epoch
+            self.handle_hosts_updated(epoch, 1)
+
+    def post_failure_hint(self) -> None:
+        """Tell the driver a peer looks dead (speeds up re-rendezvous;
+        reference analog: worker exit detection in driver.py:291)."""
+        if self._client is None:
+            return
+        import os
+        try:
+            self._client.put("/rendezvous/hint",
+                             os.environ.get("HVDTPU_WORKER_ID", "?").encode())
+        except Exception:
             pass
 
     def register_listener(self, state: State) -> None:

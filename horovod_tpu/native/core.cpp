@@ -152,7 +152,10 @@ class Core {
 
   std::thread background_;
   std::atomic<bool> shutdown_{false};
+  std::atomic<bool> world_broken_{false};
   bool started_ = false;
+
+  void FailAllOutstanding(const std::string& reason);
 };
 
 Status Core::Start() {
@@ -498,10 +501,18 @@ void Core::CoordinatorIngest() {
       std::vector<uint8_t> frame;
       if (RecvFrame(fd, &frame) != 0) {
         if (!shutdown_) {
-          // A worker vanished: quiet at job end, loud mid-negotiation
-          // (reference: HorovodInternalError semantics).
-          if (!message_table_.empty()) {
+          // A worker vanished. With ops pending anywhere this breaks the
+          // world: fail everything coherently on every rank so elastic mode
+          // can catch HvdTpuInternalError and re-rendezvous (reference:
+          // HorovodInternalError semantics, horovod/common/exceptions.py).
+          bool have_outstanding;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            have_outstanding = !outstanding_.empty();
+          }
+          if (!message_table_.empty() || have_outstanding) {
             LogWarn(0, "worker rank %d disconnected with ops pending", rank);
+            world_broken_ = true;
           }
           worker_fds_[rank] = -1;
           CloseFd(fd);
@@ -713,7 +724,43 @@ Response Core::BuildResponse(const std::string& name) {
   return resp;
 }
 
+void Core::FailAllOutstanding(const std::string& reason) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : handles_) {
+    if (done_.count(kv.first) == 0) {
+      done_[kv.first] = Status::Error(StatusCode::ABORTED, reason);
+      outstanding_.erase(kv.second->name);
+    }
+  }
+  pending_.clear();
+  cv_.notify_all();
+}
+
 void Core::CoordinatorEmitResponses() {
+  if (world_broken_.exchange(false)) {
+    // Tell every surviving rank the world is broken, then fail locally.
+    Response dead;
+    dead.type = ResponseType::SHUTDOWN;
+    dead.error_message = "a peer process failed during a collective";
+    Writer w;
+    w.I32(static_cast<int32_t>(CtrlMsg::RESPONSES));
+    w.I64(1);
+    SerializeResponse(dead, &w);
+    std::vector<uint8_t> payload = w.Take();
+    for (int rank = 1; rank < cfg_.size; ++rank) {
+      if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
+    }
+    message_table_.clear();
+    ready_names_.clear();
+    FailAllOutstanding("a peer process failed during a collective");
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+    return;
+  }
+
   std::vector<Response> list;
 
   // Fuse ready allreduces with matching (dtype, reduce_op) under the fusion
@@ -794,6 +841,18 @@ void Core::CompleteEntry(TensorEntry* e, const Status& st) {
 }
 
 void Core::ExecuteResponse(const Response& resp) {
+  if (resp.type == ResponseType::SHUTDOWN) {
+    // Coordinator declared the world broken (a peer died mid-collective).
+    FailAllOutstanding(resp.error_message.empty()
+                           ? "a peer process failed during a collective"
+                           : resp.error_message);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+    return;
+  }
   if (resp.type == ResponseType::JOIN_DONE) {
     {
       // Flag writes must happen under mu_ or a waiter that just evaluated its

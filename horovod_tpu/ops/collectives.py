@@ -115,6 +115,27 @@ def rank_in_step(axis: Optional[str] = None):
     return lax.axis_index(_resolve_axis(axis))
 
 
+def pvary(tree, axis: Optional[str] = None):
+    """Mark a (replicated) pytree as device-varying along the mesh axis.
+
+    Use on parameters before ``jax.grad`` when you want *per-rank* gradients —
+    e.g. to feed the compressed reducers or Adasum — instead of the
+    automatically-psummed gradient autodiff produces for invariant params
+    under ``check_vma`` shard_map.
+    """
+    ax = _resolve_axis(axis)
+
+    def _cast(x):
+        if not _dp_invariant(x, ax):
+            return x  # already varying (idempotent)
+        try:
+            return lax.pcast(x, ax, to="varying")
+        except TypeError:  # older signature
+            return lax.pvary(x, (ax,))
+
+    return jax.tree.map(_cast, tree)
+
+
 def size_in_step(axis: Optional[str] = None):
     return lax.axis_size(_resolve_axis(axis))
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from .. import runtime
@@ -62,7 +63,15 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     * ``gradient_predivide_factor`` splits the averaging between pre- and
       post-reduction scaling (reference ``optimizer.py:383`` factory docs):
       prescale = 1/(size/f), postscale = 1/f.
-    * ``compression``: e.g. ``hvd.Compression.fp16`` — wire-dtype compression.
+    * ``compression``: ``hvd.Compression.fp16``/``bf16`` (wire dtype), a
+      quantizer (``MaxMinQuantizer``/``NormalizedQuantizer``/``TopKCompressor``)
+      or a per-layer :class:`~horovod_tpu.compression.CompressionConfig` —
+      quantized gradients route through the compressed reducers. Quantized
+      compression engages on *per-rank* gradients (differentiate against
+      ``hvd.pvary(params)``); gradients of replicated params arrive pre-summed
+      and skip compression. With ``error_feedback=True`` the optimizer state
+      carries per-rank residuals — inside a compiled step those are varying
+      state and need per-leaf sharded out_specs (or use the eager path).
     * ``named_parameters`` is accepted for signature parity and ignored (optax is
       functional; parameter identity comes from the pytree).
 
@@ -81,6 +90,19 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         pre = prescale_factor
         post = postscale_factor
 
+    # Quantized compression (IST-fork parity) routes through the compressed
+    # reducers with per-layer config + optional error feedback; simple wire
+    # compressors (fp16/bf16/none) ride the plain allreduce.
+    from ..compression import CompressionConfig
+    from ..compression.quantize import (MaxMinQuantizer, NormalizedQuantizer,
+                                        TopKCompressor)
+    quantized = isinstance(compression, (CompressionConfig, MaxMinQuantizer,
+                                         NormalizedQuantizer, TopKCompressor))
+    comp_cfg = None
+    if quantized:
+        comp_cfg = compression if isinstance(compression, CompressionConfig) \
+            else CompressionConfig(default_compressor=compression)
+
     def _reduce(grads):
         eff_op = op
         pre_f = 1.0 if pre is None else pre
@@ -94,12 +116,105 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                                    prescale_factor=pre_f,
                                    postscale_factor=post_f, axis=axis)
 
-    def init_fn(params):
-        return optimizer.init(params)
+    def _leaf_name(path) -> str:
+        import jax.tree_util as jtu
+        parts = []
+        for k in path:
+            if isinstance(k, jtu.DictKey):
+                parts.append(str(k.key))
+            elif isinstance(k, jtu.SequenceKey):
+                parts.append(str(k.idx))
+            elif isinstance(k, jtu.GetAttrKey):
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
 
-    def update_fn(grads, state, params=None, **extra):
-        reduced = _reduce(grads)
-        return optimizer.update(reduced, state, params, **extra)
+    def _compressed_reduce(grads, residuals):
+        from ..compression import Compressor
+        from ..compression.reducers import compressed_allreduce
+        if op == C.ReduceOp.ADASUM:
+            raise ValueError(
+                "op=Adasum is not supported with quantized compression "
+                "(the compressed reducers are sum-based, like the "
+                "reference's); use Adasum without compression")
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        res_leaves = (jax.tree.leaves(residuals) if residuals is not None
+                      else [None] * len(flat))
+        outs, new_res = [], []
+        ax = axis if axis is not None else runtime.dp_axis()
+        # Same scaling semantics as the dense path (_reduce).
+        eff_op = op
+        pre_f = 1.0 if pre is None else pre
+        post_f = 1.0 if post is None else post
+        if gradient_predivide_factor != 1.0:
+            n = C.size_in_step(axis) if C.in_named_trace(axis) \
+                else runtime.size()
+            pre_f = gradient_predivide_factor / n
+            post_f = 1.0 / gradient_predivide_factor
+            eff_op = C.ReduceOp.SUM
+        for (path, g), r in zip(flat, res_leaves):
+            comp = comp_cfg.for_name(_leaf_name(path))
+            if comp is not None and C.in_named_trace(axis) and \
+                    C._dp_invariant(g, ax):
+                # Invariant gradients are already reduced (autodiff psum for
+                # replicated params) — there is nothing to exchange, so
+                # quantizing would only add noise. Compression applies to
+                # per-rank (varying) gradients: compute them against
+                # hvd.pvary(params) to engage the compressed reducers.
+                comp = None
+            wire_comp = isinstance(comp, type) and issubclass(comp, Compressor)
+            if comp is None or wire_comp:
+                # Dense (or dtype-cast wire compression): plain allreduce.
+                outs.append(C.allreduce(g, name=f"g/{_leaf_name(path)}",
+                                        op=eff_op, prescale_factor=pre_f,
+                                        postscale_factor=post_f,
+                                        compression=comp, axis=axis))
+                new_res.append(r if r is not None else None)
+            else:
+                g_in = g if pre_f == 1.0 else g * jnp.asarray(pre_f, g.dtype)
+                result = compressed_allreduce(g_in, comp,
+                                              reduction=comp_cfg.reduction,
+                                              op=eff_op, axis=axis, residual=r)
+                if r is not None:
+                    out, nr = result
+                else:
+                    out, nr = result, None
+                if post_f != 1.0:
+                    out = out * jnp.asarray(post_f, out.dtype)
+                outs.append(out)
+                new_res.append(nr)
+        unflatten = jax.tree_util.tree_unflatten
+        grads_out = unflatten(jax.tree.structure(grads), outs)
+        res_out = (unflatten(jax.tree.structure(grads), new_res)
+                   if residuals is not None else None)
+        return grads_out, res_out
+
+    if quantized and comp_cfg.error_feedback:
+        # State = (inner optax state, residual pytree) — residuals thread
+        # through the compiled step like any optimizer state (reference:
+        # feedback_buffer_manager.{h,cc} persistent buffers).
+        from ..compression.error_feedback import init_error_feedback
+
+        def init_fn(params):
+            return (optimizer.init(params), init_error_feedback(params))
+
+        def update_fn(grads, state, params=None, **extra):
+            inner_state, residuals = state
+            reduced, new_residuals = _compressed_reduce(grads, residuals)
+            updates, inner_state = optimizer.update(reduced, inner_state,
+                                                    params, **extra)
+            return updates, (inner_state, new_residuals)
+    else:
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(grads, state, params=None, **extra):
+            if quantized:
+                reduced, _ = _compressed_reduce(grads, None)
+            else:
+                reduced = _reduce(grads)
+            return optimizer.update(reduced, state, params, **extra)
 
     wrapped = optax.GradientTransformation(init_fn, update_fn)
     if backward_passes_per_step > 1:

@@ -1,0 +1,86 @@
+"""Cross-rank synchronized batch normalization.
+
+Reference: ``horovod/torch/sync_batch_norm.py`` — hand-rolled SyncBN that
+allgathers per-rank sums/counts and normalizes with global statistics (the TF
+twin is ``horovod/tensorflow/sync_batch_norm.py``).
+
+TPU-native redesign: a flax module whose mean/variance are ``psum``-reduced
+over the data-parallel mesh axis inside the compiled step — one fused pair of
+scalars-per-channel collectives instead of the reference's gathered tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import runtime
+from ..ops import collectives as C
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm that synchronizes statistics across the DP axis.
+
+    Use inside a shard_map'd training step (``hvd.run_step``); outside a
+    named-axis trace it degrades to local statistics (size-1 semantics).
+    """
+    use_running_average: Optional[bool] = None
+    axis: Optional[str] = None          # mesh axis (default: dp axis)
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param("use_running_average",
+                                self.use_running_average,
+                                use_running_average)
+        features = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((features,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((features,), jnp.float32))
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            reduce_axes = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            local_sum = jnp.sum(xf, axis=reduce_axes)
+            local_sq = jnp.sum(xf * xf, axis=reduce_axes)
+            local_count = jnp.asarray(
+                xf.size / features, jnp.float32)
+            if C.in_named_trace(self.axis):
+                # One fused cross-rank reduction of (sum, sum_sq, count) —
+                # reference gathers these via allgather (sync_batch_norm.py).
+                stats = jnp.concatenate(
+                    [local_sum, local_sq, local_count[None]])
+                stats = C.allreduce_p(stats, op=C.ReduceOp.SUM,
+                                      axis=self.axis)
+                total_sum = stats[:features]
+                total_sq = stats[features:2 * features]
+                count = stats[-1]
+            else:
+                total_sum, total_sq, count = local_sum, local_sq, local_count
+            mean = total_sum / count
+            var = total_sq / count - mean * mean
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value +
+                                 (1 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value +
+                                (1 - self.momentum) * var)
+        y = (x.astype(jnp.float32) - mean) / jnp.sqrt(var + self.epsilon)
+        if self.use_scale:
+            scale = self.param("scale", nn.initializers.ones_init(),
+                               (features,), self.param_dtype)
+            y = y * scale
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (features,), self.param_dtype)
+            y = y + bias
+        return y.astype(self.dtype or x.dtype)

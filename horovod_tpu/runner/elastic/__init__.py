@@ -1,0 +1,6 @@
+"""Elastic orchestration (reference: horovod/runner/elastic/)."""
+
+from .discovery import (FixedHosts, HostDiscovery, HostDiscoveryScript,  # noqa: F401
+                        HostManager)
+from .driver import ElasticDriver, ElasticSettings, run_elastic  # noqa: F401
+from .registration import WorkerStateRegistry  # noqa: F401

@@ -1,0 +1,237 @@
+"""Elastic driver: discovery polling, rendezvous rounds, worker supervision.
+
+Reference: ``horovod/runner/elastic/driver.py`` (``ElasticDriver`` :68 —
+discovery thread :176, host-assignment update :227, worker spawn :271-289,
+exit handling :291 with host blacklisting and respawn).
+
+Protocol (KV keys on the driver's :class:`~horovod_tpu.runner.http_kv.KVStoreServer`):
+
+* ``/rendezvous/epoch`` — current rendezvous round (int, monotonically grows)
+* ``/rendezvous/{epoch}/assignment/{worker_id}`` — JSON topology assignment
+  (rank/size/local/cross + controller endpoint) for a stable worker identity
+  ``host:slot``
+* ``/rendezvous/updates`` — latest epoch with a membership change; workers
+  poll it at ``state.commit()`` (fills the role of the reference's
+  WorkerNotificationService push, elastic/worker.py)
+* ``/rendezvous/hint`` — worker-posted failure hints (speeds up detection)
+
+Workers re-enter rendezvous by polling for an epoch newer than the one they
+last initialized with, which removes the failed-peer/old-epoch race.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from ...utils import logging as log
+from .. import safe_exec
+from ..hosts import get_host_assignments
+from ..http_kv import KVStoreServer
+from .discovery import HostDiscovery, HostManager
+from .registration import FAILURE, SUCCESS, WorkerStateRegistry
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclasses.dataclass
+class ElasticSettings:
+    min_np: int
+    max_np: int
+    discovery_interval_s: float = 1.0
+    elastic_timeout_s: float = 600.0
+    reset_limit: Optional[int] = None
+
+
+class ElasticDriver:
+    """Supervises an elastic job (reference: ElasticDriver, driver.py:68)."""
+
+    def __init__(self, discovery: HostDiscovery, settings: ElasticSettings,
+                 command: List[str], env: Dict[str, str], verbose: bool = False):
+        self._host_manager = HostManager(discovery)
+        self._settings = settings
+        self._command = command
+        self._base_env = dict(env)
+        self._verbose = verbose
+        self._kv = KVStoreServer()
+        self._registry = WorkerStateRegistry()
+        self._epoch = 0
+        self._procs: Dict[str, safe_exec.WorkerProcess] = {}
+        self._expected: Set[str] = set()
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._result: Optional[int] = None
+        self._result_event = threading.Event()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._kv.start()
+        self._wait_for_available_slots()
+        self._rendezvous("initial")
+        self._discovery_thread = threading.Thread(target=self._discovery_loop,
+                                                  daemon=True)
+        self._discovery_thread.start()
+
+    def wait_for_completion(self) -> int:
+        self._result_event.wait()
+        self._shutdown.set()
+        for p in list(self._procs.values()):
+            p.terminate()
+        self._kv.stop()
+        return self._result if self._result is not None else 1
+
+    @property
+    def kv_port(self) -> int:
+        return self._kv.port
+
+    # ------------------------------------------------------------------
+    def _wait_for_available_slots(self) -> None:
+        deadline = time.time() + self._settings.elastic_timeout_s
+        while time.time() < deadline:
+            self._host_manager.update_available_hosts()
+            total = sum(self._host_manager.current_hosts.values())
+            if total >= self._settings.min_np:
+                return
+            time.sleep(self._settings.discovery_interval_s)
+        raise TimeoutError(
+            f"timed out waiting for at least {self._settings.min_np} slots")
+
+    def _discovery_loop(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(self._settings.discovery_interval_s)
+            try:
+                changed = self._host_manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup
+                log.warning("elastic: discovery failed: %s", e)
+                continue
+            hint = self._kv.get("/rendezvous/hint")
+            if hint:
+                self._kv.put("/rendezvous/hint", b"")
+                changed = True
+            if changed:
+                with self._lock:
+                    if not self._shutdown.is_set():
+                        self._rendezvous("host set changed")
+
+    def _rendezvous(self, reason: str) -> None:
+        """Start a new epoch: assign ranks, publish, (re)spawn workers
+        (reference: _update_host_assignments driver.py:227 — including the
+        'at least one host from the previous assignment must remain' rule)."""
+        with self._lock:
+            if (self._settings.reset_limit is not None and
+                    self._epoch >= self._settings.reset_limit + 1):
+                log.warning("elastic: reset limit reached; aborting")
+                self._result = 1
+                self._result_event.set()
+                return
+            hosts = self._host_manager.current_hosts
+            total = sum(hosts.values())
+            if total < self._settings.min_np:
+                log.warning("elastic: only %d slots (< min_np %d); waiting",
+                            total, self._settings.min_np)
+                return
+            np_ = min(total, self._settings.max_np)
+            host_list = sorted(hosts.items())
+            slots = get_host_assignments(host_list, np_)
+            self._epoch += 1
+            epoch = self._epoch
+            controller_host = slots[0].hostname
+            controller_port = _free_port()
+            expected = set()
+            for s in slots:
+                worker_id = f"{s.hostname}:{s.local_rank}"
+                expected.add(worker_id)
+                assignment = {
+                    "rank": s.rank, "size": s.size,
+                    "local_rank": s.local_rank, "local_size": s.local_size,
+                    "cross_rank": s.cross_rank, "cross_size": s.cross_size,
+                    "controller_addr": controller_host,
+                    "controller_port": controller_port,
+                    "epoch": epoch,
+                }
+                self._kv.put(f"/rendezvous/{epoch}/assignment/{worker_id}",
+                             json.dumps(assignment).encode())
+            self._expected = expected
+            self._kv.put("/rendezvous/epoch", str(epoch).encode())
+            self._kv.put("/rendezvous/updates", str(epoch).encode())
+            log.info("elastic: rendezvous epoch %d (%s): %d workers on %s",
+                     epoch, reason, np_, sorted(hosts))
+            for s in slots:
+                worker_id = f"{s.hostname}:{s.local_rank}"
+                proc = self._procs.get(worker_id)
+                if proc is None or proc.poll() is not None:
+                    self._spawn(worker_id, s.hostname)
+
+    def _spawn(self, worker_id: str, hostname: str) -> None:
+        env = dict(self._base_env)
+        env["HVDTPU_RENDEZVOUS_ADDR"] = "127.0.0.1" if hostname in (
+            "localhost", "127.0.0.1") else socket.gethostname()
+        env["HVDTPU_RENDEZVOUS_PORT"] = str(self._kv.port)
+        env["HVDTPU_WORKER_ID"] = worker_id
+        env["HVDTPU_HOSTNAME"] = "127.0.0.1" if hostname in (
+            "localhost", "127.0.0.1") else hostname
+        if self._verbose:
+            log.info("elastic: spawning %s", worker_id)
+        if safe_exec.is_local_host(hostname):
+            command = self._command
+        else:
+            # Remote slot: exec over SSH like the static launcher. The
+            # controller port was allocated on the driver host — collisions on
+            # the remote rank-0 host are possible but unlikely (ephemeral
+            # range); rank 0 fails fast and re-rendezvouses if so.
+            env["HVDTPU_RENDEZVOUS_ADDR"] = socket.gethostname()
+            command = safe_exec.ssh_wrap(hostname, 22, env, self._command)
+        proc = safe_exec.WorkerProcess(command, env, worker_id)
+        self._procs[worker_id] = proc
+        threading.Thread(target=self._watch, args=(worker_id, proc),
+                         daemon=True).start()
+
+    def _watch(self, worker_id: str, proc: safe_exec.WorkerProcess) -> None:
+        rc = proc.wait()
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            if self._procs.get(worker_id) is not proc:
+                return  # superseded by a respawn
+            epoch = self._epoch
+            host = worker_id.rsplit(":", 1)[0]
+            if rc == 0:
+                self._registry.record(epoch, worker_id, SUCCESS)
+                if self._registry.all_succeeded(epoch, self._expected):
+                    self._result = 0
+                    self._result_event.set()
+            else:
+                # Reference: blacklist the host after a failure
+                # (driver.py:291-307, discovery.py:41-47) and re-rendezvous.
+                log.warning("elastic: worker %s failed (rc=%d); "
+                            "blacklisting host %s", worker_id, rc, host)
+                self._registry.record(epoch, worker_id, FAILURE)
+                self._procs.pop(worker_id, None)
+                self._host_manager.blacklist(host)
+                self._host_manager.update_available_hosts()
+                total = sum(self._host_manager.current_hosts.values())
+                if total < self._settings.min_np:
+                    log.warning("elastic: below min_np after blacklist; "
+                                "aborting")
+                    self._result = rc
+                    self._result_event.set()
+                else:
+                    self._rendezvous(f"worker {worker_id} failed")
+
+
+def run_elastic(discovery: HostDiscovery, settings: ElasticSettings,
+                command: List[str], env: Dict[str, str],
+                verbose: bool = False) -> int:
+    driver = ElasticDriver(discovery, settings, command, env, verbose)
+    driver.start()
+    return driver.wait_for_completion()

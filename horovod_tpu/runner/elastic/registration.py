@@ -1,0 +1,44 @@
+"""Worker state registry for the elastic driver.
+
+Reference: ``horovod/runner/elastic/registration.py`` — ``WorkerStateRegistry``
+tracks which workers of the current rendezvous round succeeded/failed, gates
+the next round, and feeds host blacklisting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Set
+
+SUCCESS = "success"
+FAILURE = "failure"
+
+
+class WorkerStateRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: Dict[int, Dict[str, str]] = {}  # epoch -> id -> state
+
+    def record(self, epoch: int, worker_id: str, state: str) -> None:
+        with self._lock:
+            self._states.setdefault(epoch, {})[worker_id] = state
+
+    def state_of(self, epoch: int, worker_id: str):
+        with self._lock:
+            return self._states.get(epoch, {}).get(worker_id)
+
+    def count(self, epoch: int, state: str) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.get(epoch, {}).values()
+                       if s == state)
+
+    def failures(self, epoch: int) -> Set[str]:
+        with self._lock:
+            return {w for w, s in self._states.get(epoch, {}).items()
+                    if s == FAILURE}
+
+    def all_succeeded(self, epoch: int, expected: Set[str]) -> bool:
+        with self._lock:
+            states = self._states.get(epoch, {})
+            return expected.issubset(
+                {w for w, s in states.items() if s == SUCCESS})

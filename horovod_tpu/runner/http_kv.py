@@ -1,0 +1,112 @@
+"""HTTP key-value store: rendezvous + elastic coordination transport.
+
+Reference: ``horovod/runner/http/http_server.py`` (``KVStoreHandler`` :35 —
+GET/PUT byte values under scoped paths; ``RendezvousServer`` :112) and
+``http_client.py``. The gloo C++ ``HTTPStore`` reads it for rendezvous; here
+the elastic driver publishes assignments and update notifications through it
+and workers poll with plain HTTP.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    store: Dict[str, bytes]
+    lock: threading.Lock
+
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    def do_GET(self):
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            val = self.server.kv_store.get(self.path)  # type: ignore
+        if val is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self.server.kv_store[self.path] = body  # type: ignore
+        hook = getattr(self.server, "kv_put_hook", None)
+        if hook is not None:
+            hook(self.path, body)
+        self.send_response(200)
+        self.end_headers()
+
+    def do_DELETE(self):
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self.server.kv_store.pop(self.path, None)  # type: ignore
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVStoreServer:
+    """Threaded HTTP KV server (reference: RendezvousServer,
+    http_server.py:112). ``put_hook(path, value)`` fires on every PUT —
+    the reference uses the same mechanism to collect worker addresses
+    (elastic/rendezvous.py:52)."""
+
+    def __init__(self, port: int = 0, put_hook=None):
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._server.kv_store = {}  # type: ignore[attr-defined]
+        self._server.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._server.kv_put_hook = put_hook  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # Local (in-process) access for the driver.
+    def put(self, key: str, value: bytes) -> None:
+        with self._server.kv_lock:  # type: ignore[attr-defined]
+            self._server.kv_store[key] = value  # type: ignore[attr-defined]
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._server.kv_lock:  # type: ignore[attr-defined]
+            return self._server.kv_store.get(key)  # type: ignore[attr-defined]
+
+
+class KVStoreClient:
+    """HTTP client for the KV store (reference: http_client.py)."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 10.0):
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def put(self, key: str, value: bytes) -> None:
+        req = urllib.request.Request(self._base + key, data=value,
+                                     method="PUT")
+        urllib.request.urlopen(req, timeout=self._timeout).read()
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            return urllib.request.urlopen(self._base + key,
+                                          timeout=self._timeout).read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise

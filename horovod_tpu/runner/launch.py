@@ -52,6 +52,19 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--autotune", action="store_true",
                    help="enable fusion/cycle autotuning")
     p.add_argument("--autotune-log-file", default=None)
+    # Elastic (reference: launch.py --min-np/--max-np/--host-discovery-script).
+    p.add_argument("--min-np", type=int, default=None,
+                   help="minimum workers for an elastic job")
+    p.add_argument("--max-np", type=int, default=None,
+                   help="maximum workers for an elastic job")
+    p.add_argument("--host-discovery-script", default=None,
+                   help="script printing 'host:slots' per line; enables "
+                        "elastic mode")
+    p.add_argument("--elastic-timeout", type=float, default=600.0)
+    p.add_argument("--reset-limit", type=int, default=None,
+                   help="max rendezvous rounds before aborting")
+    p.add_argument("--slots", type=int, default=1,
+                   help="default slots per discovered host")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command, e.g. python train.py")
@@ -101,22 +114,37 @@ def _build_env(slot: hosts_mod.SlotInfo, args, controller_host: str,
     return env
 
 
-def _is_local(host: str) -> bool:
-    return host in ("localhost", "127.0.0.1", socket.gethostname())
+_is_local = safe_exec.is_local_host
+_ssh_wrap = safe_exec.ssh_wrap
 
 
-def _ssh_wrap(host: str, ssh_port: int, env: dict, command: List[str]) -> List[str]:
-    """Build the SSH remote command with env forwarding
-    (reference: gloo_run.py get_remote_command)."""
-    exports = " ".join(
-        f"{k}={v!r}" for k, v in env.items() if k.startswith("HVDTPU_"))
-    remote = f"cd {os.getcwd()!r} 2>/dev/null; env {exports} " + \
-        " ".join(command)
-    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port),
-            host, remote]
+def run_elastic_launcher(args: argparse.Namespace) -> int:
+    """Elastic path (reference: _run_elastic, launch.py:624)."""
+    from .elastic import ElasticSettings, HostDiscoveryScript, run_elastic
+
+    settings = ElasticSettings(
+        min_np=args.min_np or args.num_proc,
+        max_np=args.max_np or args.num_proc,
+        elastic_timeout_s=args.elastic_timeout,
+        reset_limit=args.reset_limit)
+    discovery = HostDiscoveryScript(args.host_discovery_script,
+                                    slots=args.slots)
+    # Worker topology comes from the rendezvous KV store, not static env;
+    # only tuning knobs are forwarded.
+    env = dict(os.environ)
+    env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
+    env[ev.HVDTPU_FUSION_THRESHOLD] = str(
+        int(args.fusion_threshold_mb * 1024 * 1024))
+    env[ev.HVDTPU_ELASTIC_TIMEOUT] = str(args.elastic_timeout)
+    if args.stall_check_disable:
+        env[ev.HVDTPU_STALL_CHECK_DISABLE] = "1"
+    return run_elastic(discovery, settings, list(args.command), env,
+                       verbose=args.verbose)
 
 
 def run_launcher(args: argparse.Namespace) -> int:
+    if args.host_discovery_script:
+        return run_elastic_launcher(args)
     host_list = (hosts_mod.parse_hostfile(args.hostfile) if args.hostfile
                  else hosts_mod.parse_hosts(args.hosts or
                                             f"localhost:{args.num_proc}"))

@@ -16,6 +16,23 @@ import time
 from typing import Dict, List, Optional
 
 
+def ssh_wrap(host: str, ssh_port: int, env: Dict[str, str],
+             command: List[str]) -> List[str]:
+    """Build an SSH remote command with HVDTPU_* env forwarding
+    (reference: gloo_run.py get_remote_command)."""
+    exports = " ".join(
+        f"{k}={v!r}" for k, v in env.items() if k.startswith("HVDTPU_"))
+    remote = f"cd {os.getcwd()!r} 2>/dev/null; env {exports} " + \
+        " ".join(command)
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port),
+            host, remote]
+
+
+def is_local_host(host: str) -> bool:
+    import socket
+    return host in ("localhost", "127.0.0.1", socket.gethostname())
+
+
 class WorkerProcess:
     def __init__(self, cmd: List[str], env: Dict[str, str], name: str,
                  stdout=None, stderr=None):

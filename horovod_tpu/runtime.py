@@ -70,9 +70,65 @@ _init_kwargs: dict = {}
 
 
 def _detect_mode() -> str:
-    if os.environ.get(ev.HVDTPU_SIZE):
+    if os.environ.get(ev.HVDTPU_SIZE) or os.environ.get(
+            ev.HVDTPU_RENDEZVOUS_ADDR):
         return "process"
     return "spmd"
+
+
+# Last rendezvous epoch this process initialized with (elastic mode): re-init
+# only accepts a NEWER epoch, which removes the failed-peer/stale-epoch race.
+_elastic_last_epoch = 0
+
+
+def _elastic_assignment() -> Optional[dict]:
+    """Poll the elastic driver's KV store for this worker's assignment
+    (keys documented in horovod_tpu/runner/elastic/driver.py; fills the role
+    of the reference's rendezvous GET, elastic/rendezvous.py)."""
+    global _elastic_last_epoch
+    addr = ev.get_str(ev.HVDTPU_RENDEZVOUS_ADDR)
+    if not addr:
+        return None
+    import json
+    import sys
+    import time as _time
+
+    from .runner.http_kv import KVStoreClient
+    port = ev.get_int(ev.HVDTPU_RENDEZVOUS_PORT, 0)
+    worker_id = ev.get_str("HVDTPU_WORKER_ID")
+    client = KVStoreClient(addr, port)
+    timeout = ev.get_float(ev.HVDTPU_ELASTIC_TIMEOUT, 600.0)
+    deadline = _time.monotonic() + timeout
+    missing_since = None
+    while _time.monotonic() < deadline:
+        try:
+            raw = client.get("/rendezvous/epoch")
+        except Exception:
+            # Transient KV hiccup (driver mid-restart / connection reset):
+            # retry until the elastic timeout rather than dying — a non-zero
+            # exit would get this worker's healthy host blacklisted.
+            raw = None
+        if raw:
+            epoch = int(raw)
+            if epoch > _elastic_last_epoch:
+                try:
+                    a = client.get(
+                        f"/rendezvous/{epoch}/assignment/{worker_id}")
+                except Exception:
+                    a = None
+                if a:
+                    _elastic_last_epoch = epoch
+                    return json.loads(a)
+                # Epoch advanced without us: scaled away. Give the driver a
+                # short grace window in case a newer epoch re-adds us.
+                if missing_since is None:
+                    missing_since = _time.monotonic()
+                elif _time.monotonic() - missing_since > 5.0:
+                    log.info("elastic: worker %s removed from epoch %d; "
+                             "exiting cleanly", worker_id, epoch)
+                    sys.exit(0)
+        _time.sleep(0.25)
+    raise TimeoutError("elastic rendezvous timed out")
 
 
 def _build_mesh(mesh_shape, axis_names, devices):
@@ -141,12 +197,24 @@ def init(comm: Optional[Sequence[int]] = None,
         mode = mode or _detect_mode()
         st = _RuntimeState(mode=mode, epoch=_state.epoch + 1)
         if mode == "process":
-            st.rank = ev.get_int(ev.HVDTPU_RANK, 0)
-            st.size = ev.get_int(ev.HVDTPU_SIZE, 1)
-            st.local_rank = ev.get_int(ev.HVDTPU_LOCAL_RANK, 0)
-            st.local_size = ev.get_int(ev.HVDTPU_LOCAL_SIZE, 1)
-            st.cross_rank = ev.get_int(ev.HVDTPU_CROSS_RANK, st.rank)
-            st.cross_size = ev.get_int(ev.HVDTPU_CROSS_SIZE, st.size)
+            assignment = _elastic_assignment()
+            controller = (None, None)
+            if assignment is not None:
+                st.rank = assignment["rank"]
+                st.size = assignment["size"]
+                st.local_rank = assignment["local_rank"]
+                st.local_size = assignment["local_size"]
+                st.cross_rank = assignment["cross_rank"]
+                st.cross_size = assignment["cross_size"]
+                controller = (assignment["controller_addr"],
+                              assignment["controller_port"])
+            else:
+                st.rank = ev.get_int(ev.HVDTPU_RANK, 0)
+                st.size = ev.get_int(ev.HVDTPU_SIZE, 1)
+                st.local_rank = ev.get_int(ev.HVDTPU_LOCAL_RANK, 0)
+                st.local_size = ev.get_int(ev.HVDTPU_LOCAL_SIZE, 1)
+                st.cross_rank = ev.get_int(ev.HVDTPU_CROSS_RANK, st.rank)
+                st.cross_size = ev.get_int(ev.HVDTPU_CROSS_SIZE, st.size)
             if st.size > 1:
                 try:
                     from . import basics
@@ -159,7 +227,8 @@ def init(comm: Optional[Sequence[int]] = None,
                 st.core = basics.NativeCore(
                     rank=st.rank, size=st.size,
                     local_rank=st.local_rank, local_size=st.local_size,
-                    cross_rank=st.cross_rank, cross_size=st.cross_size)
+                    cross_rank=st.cross_rank, cross_size=st.cross_size,
+                    coord_host=controller[0], coord_port=controller[1])
                 st.core.start()
             log.debug("init: process mode rank=%d size=%d local=%d/%d",
                       st.rank, st.size, st.local_rank, st.local_size)

@@ -1,0 +1,45 @@
+"""Elastic integration worker: trains a counter with commits, records the
+world size it finishes with (reference: test/integration/data/ training
+scripts driven by elastic_common.py)."""
+
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+RESULT_FILE = os.environ["ELASTIC_RESULT_FILE"]
+TARGET = int(os.environ.get("ELASTIC_TARGET_BATCHES", "12"))
+CRASH_AT = os.environ.get("ELASTIC_CRASH_AT")  # "worker_id:batch"
+CRASH_MARKER = os.environ.get("ELASTIC_CRASH_MARKER", "")
+
+hvd.init()
+
+state = hvd.elastic.ObjectState(batches=0, total=0.0)
+
+
+@hvd.elastic.run
+def train(state):
+    while state.batches < TARGET:
+        wid = os.environ.get("HVDTPU_WORKER_ID", "")
+        if CRASH_AT and not os.path.exists(CRASH_MARKER):
+            crash_wid, crash_batch = CRASH_AT.rsplit(":", 1)
+            if wid == crash_wid and state.batches == int(crash_batch):
+                with open(CRASH_MARKER, "w") as f:
+                    f.write("crashed\n")
+                os._exit(7)
+        out = hvd.allreduce(np.ones(8, np.float32),
+                            name=f"step{state.batches}", op=hvd.Sum)
+        state.total += float(np.asarray(out)[0])  # == size at that step
+        state.batches += 1
+        state.commit()
+    return hvd.size()
+
+
+final_size = train(state)
+with open(RESULT_FILE, "a") as f:
+    f.write(f"{os.environ.get('HVDTPU_WORKER_ID')} rank={hvd.rank()} "
+            f"final_size={final_size} batches={state.batches} "
+            f"total={state.total}\n")
+hvd.shutdown()

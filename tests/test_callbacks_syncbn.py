@@ -1,0 +1,63 @@
+"""SyncBatchNorm + callbacks tests (reference: horovod/torch/sync_batch_norm
+usage in test_torch.py; _keras/callbacks.py behaviors)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+
+class TestSyncBatchNorm:
+    def test_matches_global_batchnorm(self, spmd8):
+        """SyncBN over 8 shards == BatchNorm over the whole batch."""
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 6).astype(np.float32) * 3 + 1.5
+        bn = hvd.SyncBatchNorm(use_running_average=False)
+        variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:4]))
+
+        @hvd.run_step(in_specs=(P(), P("dp")), out_specs=(P("dp"), P()))
+        def step(vars_, shard):
+            y, mutated = bn.apply(vars_, shard, mutable=["batch_stats"])
+            return y, mutated["batch_stats"]
+
+        y, stats = step(variables, jnp.asarray(x))
+        # Global statistics: y should be (x - mean)/std over the FULL batch.
+        mean = x.mean(axis=0)
+        var = x.var(axis=0)
+        expect = (x - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_local_fallback_outside_step(self, spmd8):
+        x = jnp.asarray(np.random.RandomState(1).randn(16, 4), jnp.float32)
+        bn = hvd.SyncBatchNorm(use_running_average=False)
+        variables = bn.init(jax.random.PRNGKey(0), x)
+        y, _ = bn.apply(variables, x, mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y).mean(axis=0), 0, atol=1e-5)
+
+
+class TestCallbacks:
+    def test_average_metrics(self, spmd8):
+        vals = hvd.shard_batch(jnp.arange(8.0))
+        out = hvd.average_metrics({"loss": vals})
+        np.testing.assert_allclose(np.asarray(out["loss"]), [3.5])
+
+    def test_warmup_schedule(self, spmd8):
+        sched = hvd.warmup_schedule(0.1, warmup_steps=10)
+        assert float(sched(0)) == pytest.approx(0.1)
+        # hvd.size()==8 -> target lr 0.8 (linear scaling rule)
+        assert float(sched(10)) == pytest.approx(0.8)
+        assert float(sched(5)) == pytest.approx(0.45)
+
+    def test_best_model_checkpoint(self, spmd8, tmp_path):
+        ckpt = hvd.BestModelCheckpoint(str(tmp_path / "best"), monitor="loss")
+        state = {"w": jnp.ones(3)}
+        assert ckpt(dict(loss=1.0), state) is True
+        assert ckpt(dict(loss=2.0), state) is False     # worse: not saved
+        state2 = {"w": jnp.full(3, 7.0)}
+        assert ckpt(dict(loss=0.5), state2) is True
+        loaded = ckpt.load()
+        np.testing.assert_allclose(np.asarray(loaded["w"]), 7.0)

@@ -1,0 +1,164 @@
+"""Elastic orchestration tests.
+
+Reference: ``test/test_elastic_driver.py`` (driver unit tests with FixedHosts)
+and ``test/integration/elastic_common.py`` (real multi-process elastic runs on
+localhost with templated discovery scripts and injected failures).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.runner.elastic import (ElasticSettings, FixedHosts,
+                                        HostDiscoveryScript, HostManager,
+                                        run_elastic)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "data", "elastic_worker.py")
+
+
+class TestDiscovery:
+    def test_fixed_hosts_and_manager(self):
+        fh = FixedHosts({"a": 2, "b": 2})
+        mgr = HostManager(fh)
+        assert mgr.update_available_hosts() is True
+        assert mgr.current_hosts == {"a": 2, "b": 2}
+        assert mgr.update_available_hosts() is False  # unchanged
+        mgr.blacklist("a")
+        assert mgr.update_available_hosts() is True
+        assert mgr.current_hosts == {"b": 2}
+        fh.set({"a": 2, "b": 2, "c": 1})
+        mgr.update_available_hosts()
+        assert "a" not in mgr.current_hosts  # blacklist sticks
+
+    def test_discovery_script(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho host1:4\necho host2\n")
+        script.chmod(0o755)
+        d = HostDiscoveryScript(str(script), slots=2)
+        assert d.find_available_hosts_and_slots() == {"host1": 4, "host2": 2}
+
+    def test_discovery_script_failure(self, tmp_path):
+        script = tmp_path / "bad.sh"
+        script.write_text("#!/bin/sh\nexit 3\n")
+        script.chmod(0o755)
+        with pytest.raises(RuntimeError):
+            HostDiscoveryScript(str(script)).find_available_hosts_and_slots()
+
+
+def _write_discovery(tmp_path, content: str):
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text(content)
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(0o755)
+    return script, hosts_file
+
+
+def _base_env(tmp_path, **extra):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["ELASTIC_RESULT_FILE"] = str(tmp_path / "results.txt")
+    env["HVDTPU_STALL_CHECK_DISABLE"] = "1"
+    env.update(extra)
+    return env
+
+
+class TestElasticIntegration:
+    def test_static_world_completes(self, tmp_path):
+        """min==max==2, no membership changes: plain elastic run to completion."""
+        script, _ = _write_discovery(tmp_path, "localhost:2\n")
+        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="6")
+        settings = ElasticSettings(min_np=2, max_np=2,
+                                   discovery_interval_s=0.3,
+                                   elastic_timeout_s=60)
+        rc = run_elastic(HostDiscoveryScript(str(script)), settings,
+                         [sys.executable, WORKER], env)
+        assert rc == 0
+        lines = open(tmp_path / "results.txt").read().splitlines()
+        assert len(lines) == 2
+        assert all("final_size=2" in ln for ln in lines)
+        # Every step summed `size` ones: total == 6 * 2 on every rank.
+        assert all("total=12.0" in ln for ln in lines)
+
+    def test_scale_up(self, tmp_path):
+        """Host added mid-run: workers reset at commit and finish at size 3
+        (reference: elastic_common.py:118 hosts added/removed)."""
+        script, hosts_file = _write_discovery(tmp_path, "localhost:2\n")
+        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="40")
+        settings = ElasticSettings(min_np=2, max_np=3,
+                                   discovery_interval_s=0.3,
+                                   elastic_timeout_s=60)
+        import threading
+
+        def grow():
+            time.sleep(4)
+            hosts_file.write_text("localhost:3\n")
+
+        t = threading.Thread(target=grow)
+        t.start()
+        rc = run_elastic(HostDiscoveryScript(str(script)), settings,
+                         [sys.executable, WORKER], env)
+        t.join()
+        assert rc == 0
+        lines = open(tmp_path / "results.txt").read().splitlines()
+        assert len(lines) == 3, lines
+        assert all("final_size=3" in ln for ln in lines), lines
+
+    def test_worker_failure_blacklists_and_recovers(self, tmp_path):
+        """A crashing worker blacklists its host; the job re-rendezvouses on
+        the remaining host and completes (reference: elastic_common.py:145
+        single-rank failure + blacklist)."""
+        # Two "hosts" that both resolve to the local machine.
+        script, _ = _write_discovery(tmp_path, "localhost:2\n127.0.0.1:2\n")
+        env = _base_env(
+            tmp_path, ELASTIC_TARGET_BATCHES="30",
+            ELASTIC_CRASH_AT="127.0.0.1:1:5",
+            ELASTIC_CRASH_MARKER=str(tmp_path / "crashed.marker"))
+        settings = ElasticSettings(min_np=2, max_np=2,
+                                   discovery_interval_s=0.3,
+                                   elastic_timeout_s=120)
+        rc = run_elastic(HostDiscoveryScript(str(script)), settings,
+                         [sys.executable, WORKER], env)
+        assert rc == 0
+        assert os.path.exists(tmp_path / "crashed.marker")
+        lines = open(tmp_path / "results.txt").read().splitlines()
+        finishers = [ln for ln in lines if "final_size=2" in ln]
+        assert len(finishers) == 2, lines
+        # Survivors must have re-homed onto the non-blacklisted host.
+        assert all(ln.startswith("localhost:") for ln in finishers), lines
+
+    def test_reset_limit_aborts(self, tmp_path):
+        """reset_limit bounds rendezvous rounds (reference:
+        elastic_common.py:246)."""
+        script, hosts_file = _write_discovery(tmp_path, "localhost:2\n")
+        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="10000")
+        settings = ElasticSettings(min_np=1, max_np=3,
+                                   discovery_interval_s=0.2,
+                                   elastic_timeout_s=30, reset_limit=2)
+        import threading
+
+        stop = threading.Event()
+
+        def churn():
+            n = 2
+            while not stop.is_set():
+                time.sleep(1.0)
+                n = 3 if n == 2 else 2
+                hosts_file.write_text(f"localhost:{n}\n")
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            rc = run_elastic(HostDiscoveryScript(str(script)), settings,
+                             [sys.executable, WORKER], env)
+        finally:
+            stop.set()
+            t.join()
+        assert rc != 0
