@@ -33,6 +33,7 @@ def _launch_world(n: int, script: str, extra_env=None, timeout=120):
     for r in range(n):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env.update({
             "HVDTPU_RANK": str(r), "HVDTPU_SIZE": str(n),
             "HVDTPU_LOCAL_RANK": str(r), "HVDTPU_LOCAL_SIZE": str(n),
@@ -63,10 +64,12 @@ def test_full_collective_menu(n):
 def test_hvdrun_cli(tmp_path):
     """hvdrun end-to-end (reference: test_static_run.py)."""
     timeline = tmp_path / "tl"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     rc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
          "--timeline", str(timeline), sys.executable, WORKER],
-        capture_output=True, text=True, timeout=180)
+        env=env, capture_output=True, text=True, timeout=180)
     assert rc.returncode == 0, rc.stderr
     import json
     events = json.load(open(f"{timeline}.0.json"))
