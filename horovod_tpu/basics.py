@@ -224,4 +224,8 @@ class NativeCore:
         return out
 
     def join(self) -> int:
-        return int(self._lib.hvdtpu_join(self._core))
+        ret = int(self._lib.hvdtpu_join(self._core))
+        if ret == -2:
+            raise HvdTpuInternalError(
+                "join barrier broken: a peer process failed before joining")
+        return ret

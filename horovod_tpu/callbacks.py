@@ -88,21 +88,24 @@ class BestModelCheckpoint:
         host_state = jax.device_get(state)
         try:
             import orbax.checkpoint as ocp
-            ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.abspath(self.path), host_state, force=True)
-        except Exception:
+        except ImportError:
+            # No orbax: pickle is the primary format. A failed orbax *save*,
+            # by contrast, must propagate — silently pickling instead would
+            # leave a stale orbax dir that load() prefers over the new state.
             with open(self.path if self.path.endswith(".pkl")
                       else self.path + ".pkl", "wb") as f:
                 pickle.dump(host_state, f)
+            return
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(self.path), host_state, force=True)
 
     def load(self) -> Any:
         try:
             import orbax.checkpoint as ocp
-            if os.path.isdir(self.path):
-                return ocp.PyTreeCheckpointer().restore(
-                    os.path.abspath(self.path))
-        except Exception:
-            pass
+        except ImportError:
+            ocp = None
+        if ocp is not None and os.path.isdir(self.path):
+            return ocp.PyTreeCheckpointer().restore(os.path.abspath(self.path))
         pkl = self.path if self.path.endswith(".pkl") else self.path + ".pkl"
         with open(pkl, "rb") as f:
             return pickle.load(f)

@@ -35,10 +35,13 @@ DEFAULT_BUCKET_SIZE = 512  # reference: compressor.h:11
 
 def pack_bits(q: jnp.ndarray, bits: int) -> jnp.ndarray:
     """Pack uint8 values (< 2**bits) into a dense uint8 array; ``bits`` must
-    divide 8. Length must be a multiple of 8//bits (callers pad)."""
+    divide 8. Zero-pads to a multiple of 8//bits values per byte group."""
     if bits == 8:
         return q.astype(jnp.uint8)
     per = 8 // bits
+    rem = q.shape[0] % per
+    if rem:
+        q = jnp.concatenate([q, jnp.zeros((per - rem,), q.dtype)])
     q = q.reshape(-1, per).astype(jnp.uint32)
     shifts = jnp.arange(per, dtype=jnp.uint32) * bits
     packed = jnp.sum(q << shifts[None, :], axis=1)

@@ -124,7 +124,9 @@ def ring_reducer_p(x, compressor, axis: Optional[str] = None,
     chunks = padded.reshape(n, chunk).astype(jnp.float32)
 
     if residual is not None:
-        chunks = chunks + residual.reshape(-1)[:chunk * n].reshape(n, chunk)
+        res_padded = jnp.zeros((chunk * n,), jnp.float32).at[:count].set(
+            residual.reshape(-1).astype(jnp.float32))
+        chunks = chunks + res_padded.reshape(n, chunk)
 
     perm_fwd = [(i, (i + 1) % n) for i in range(n)]
     _, ctx = compressor.compress(chunks[0])

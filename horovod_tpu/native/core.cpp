@@ -145,6 +145,7 @@ class Core {
   std::map<std::string, PendingName> message_table_;  // ordered for determinism
   std::deque<std::string> ready_names_;               // count reached
   std::set<int32_t> joined_ranks_;
+  std::set<int32_t> dead_ranks_;  // disconnected workers (never come back)
   bool join_pending_local_ = false;
   int64_t join_handle_ = -1;
   std::atomic<int32_t> last_joined_rank_{-1};
@@ -372,6 +373,7 @@ int64_t Core::Join() {
   cv_.notify_all();
   std::unique_lock<std::mutex> lk(mu_);
   cv_.wait(lk, [&] { return join_done_.load() || shutdown_.load(); });
+  if (!join_done_.load()) return -2;  // woken by a broken world, not a join
   return last_joined_rank_.load();
 }
 
@@ -514,6 +516,10 @@ void Core::CoordinatorIngest() {
             LogWarn(0, "worker rank %d disconnected with ops pending", rank);
             world_broken_ = true;
           }
+          // Even with nothing in flight, the rank is gone for good (unless it
+          // Joined first): any collective announced later can never complete,
+          // so it must fail over, not hang (HandleReadyRequests checks this).
+          if (!joined_ranks_.count(rank)) dead_ranks_.insert(rank);
           worker_fds_[rank] = -1;
           CloseFd(fd);
         }
@@ -536,6 +542,12 @@ void Core::CoordinatorIngest() {
 }
 
 void Core::HandleReadyRequests(std::vector<Request> reqs) {
+  // A request arriving after a (non-joined) peer died can never reach world
+  // count — break the world now instead of hanging until the stall timeout.
+  if (!reqs.empty() && !dead_ranks_.empty()) {
+    LogWarn(0, "collective announced after a peer died; failing over");
+    world_broken_ = true;
+  }
   // Reference: IncrementTensorCount (controller.cc:838).
   for (auto& q : reqs) {
     auto& slot = message_table_[q.name];
@@ -737,6 +749,12 @@ void Core::FailAllOutstanding(const std::string& reason) {
 }
 
 void Core::CoordinatorEmitResponses() {
+  // A join barrier in progress can never reach world count once a non-joined
+  // peer died: JOIN announcements bypass HandleReadyRequests, so check here.
+  if (!joined_ranks_.empty() && !dead_ranks_.empty()) {
+    LogWarn(0, "join barrier cannot complete after a peer died; failing over");
+    world_broken_ = true;
+  }
   if (world_broken_.exchange(false)) {
     // Tell every surviving rank the world is broken, then fail locally.
     Response dead;

@@ -180,6 +180,11 @@ class ElasticDriver:
         env["HVDTPU_WORKER_ID"] = worker_id
         env["HVDTPU_HOSTNAME"] = "127.0.0.1" if hostname in (
             "localhost", "127.0.0.1") else hostname
+        if env.get("HVDTPU_TIMELINE"):
+            # The launcher forwards the timeline base path; ranks change
+            # across rendezvous rounds, so suffix with the stable worker id.
+            env["HVDTPU_TIMELINE"] = (
+                f"{env['HVDTPU_TIMELINE']}.{worker_id.replace(':', '_')}.json")
         if self._verbose:
             log.info("elastic: spawning %s", worker_id)
         if safe_exec.is_local_host(hostname):

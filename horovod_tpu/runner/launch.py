@@ -84,23 +84,16 @@ def _free_port() -> int:
     return port
 
 
-def _build_env(slot: hosts_mod.SlotInfo, args, controller_host: str,
-               controller_port: int) -> dict:
-    env = dict(os.environ)
-    env[ev.HVDTPU_RANK] = str(slot.rank)
-    env[ev.HVDTPU_SIZE] = str(slot.size)
-    env[ev.HVDTPU_LOCAL_RANK] = str(slot.local_rank)
-    env[ev.HVDTPU_LOCAL_SIZE] = str(slot.local_size)
-    env[ev.HVDTPU_CROSS_RANK] = str(slot.cross_rank)
-    env[ev.HVDTPU_CROSS_SIZE] = str(slot.cross_size)
-    env[ev.HVDTPU_HOSTNAME] = slot.hostname
-    env[ev.HVDTPU_CONTROLLER_ADDR] = controller_host
-    env[ev.HVDTPU_CONTROLLER_PORT] = str(controller_port)
+def _apply_tuning_env(env: dict, args) -> dict:
+    """Forward the runtime tuning knobs shared by the static and elastic
+    paths (reference: config_parser.py mapping CLI flags → HOROVOD_* env)."""
     env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
     env[ev.HVDTPU_FUSION_THRESHOLD] = str(
         int(args.fusion_threshold_mb * 1024 * 1024))
     if args.timeline:
-        env[ev.HVDTPU_TIMELINE] = f"{args.timeline}.{slot.rank}.json"
+        # Base path; per-worker suffixing happens where the worker identity
+        # is known (static: per rank here in _build_env; elastic: the driver).
+        env[ev.HVDTPU_TIMELINE] = args.timeline
     if args.timeline_mark_cycles:
         env[ev.HVDTPU_TIMELINE_MARK_CYCLES] = "1"
     if args.stall_check_disable:
@@ -111,6 +104,23 @@ def _build_env(slot: hosts_mod.SlotInfo, args, controller_host: str,
         env[ev.HVDTPU_AUTOTUNE] = "1"
         if args.autotune_log_file:
             env[ev.HVDTPU_AUTOTUNE_LOG] = args.autotune_log_file
+    return env
+
+
+def _build_env(slot: hosts_mod.SlotInfo, args, controller_host: str,
+               controller_port: int) -> dict:
+    env = _apply_tuning_env(dict(os.environ), args)
+    env[ev.HVDTPU_RANK] = str(slot.rank)
+    env[ev.HVDTPU_SIZE] = str(slot.size)
+    env[ev.HVDTPU_LOCAL_RANK] = str(slot.local_rank)
+    env[ev.HVDTPU_LOCAL_SIZE] = str(slot.local_size)
+    env[ev.HVDTPU_CROSS_RANK] = str(slot.cross_rank)
+    env[ev.HVDTPU_CROSS_SIZE] = str(slot.cross_size)
+    env[ev.HVDTPU_HOSTNAME] = slot.hostname
+    env[ev.HVDTPU_CONTROLLER_ADDR] = controller_host
+    env[ev.HVDTPU_CONTROLLER_PORT] = str(controller_port)
+    if args.timeline:
+        env[ev.HVDTPU_TIMELINE] = f"{args.timeline}.{slot.rank}.json"
     return env
 
 
@@ -130,14 +140,10 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
     discovery = HostDiscoveryScript(args.host_discovery_script,
                                     slots=args.slots)
     # Worker topology comes from the rendezvous KV store, not static env;
-    # only tuning knobs are forwarded.
-    env = dict(os.environ)
-    env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
-    env[ev.HVDTPU_FUSION_THRESHOLD] = str(
-        int(args.fusion_threshold_mb * 1024 * 1024))
+    # only tuning knobs are forwarded (the driver suffixes the timeline path
+    # per worker, since ranks change across rendezvous rounds).
+    env = _apply_tuning_env(dict(os.environ), args)
     env[ev.HVDTPU_ELASTIC_TIMEOUT] = str(args.elastic_timeout)
-    if args.stall_check_disable:
-        env[ev.HVDTPU_STALL_CHECK_DISABLE] = "1"
     return run_elastic(discovery, settings, list(args.command), env,
                        verbose=args.verbose)
 

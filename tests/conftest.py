@@ -24,6 +24,18 @@ import pytest  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def subprocess_env() -> dict:
+    """Env for worker subprocesses: repo importable from anywhere (workers run
+    as ``python <script>``, so sys.path[0] is the script dir, not the repo)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    prev = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prev if prev else "")
+    return env
+
 
 @pytest.fixture
 def spmd8():

@@ -34,6 +34,24 @@ class TestPacking:
         out = unpack_bits(packed, bits, n)
         np.testing.assert_array_equal(np.asarray(out), vals)
 
+    @pytest.mark.parametrize("bits", [1, 2, 4])
+    def test_unaligned_length_pads(self, bits):
+        """Lengths not divisible by 8//bits pack by zero-padding (regression:
+        pack_bits crashed, e.g. MaxMinQuantizer(bits=4, bucket_size=3))."""
+        vals = np.arange(5).astype(np.uint8) % (1 << bits)
+        packed = pack_bits(jnp.asarray(vals), bits)
+        out = unpack_bits(packed, bits, 5)
+        np.testing.assert_array_equal(np.asarray(out), vals)
+
+    def test_odd_bucket_size_quantizer(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(9).astype(np.float32))
+        q = MaxMinQuantizer(bits=4, bucket_size=3, use_pallas=False)
+        payload, ctx = q.compress(x)
+        out = q.decompress(payload, ctx)
+        assert np.asarray(out).shape == (9,)
+        unit = np.asarray(payload["unit"]).max()
+        assert np.max(np.abs(np.asarray(out) - np.asarray(x))) <= unit / 2 + 1e-6
+
 
 class TestMaxMin:
     @pytest.mark.parametrize("bits", [2, 4, 8])
@@ -231,6 +249,27 @@ class TestReducers:
         out, res = step(jnp.asarray(data), res)
         assert np.asarray(res).shape == (8, 256)
         assert np.any(np.asarray(res) != 0)  # something was lost and kept
+
+    @pytest.mark.parametrize("reduction",
+                             ["allgather", "scatter_allgather", "ring"])
+    def test_error_feedback_nondivisible_count(self, spmd8, reduction):
+        """Element count not divisible by world size (regression: the ring
+        reducer crashed reshaping an unpadded residual)."""
+        rng = np.random.RandomState(9)
+        data = rng.randn(8, 10).astype(np.float32)
+        q = MaxMinQuantizer(bits=8, bucket_size=8, use_pallas=False)
+
+        @hvd.run_step(in_specs=(P("dp"), P("dp")), out_specs=(P(), P("dp")))
+        def step(x, res):
+            out, new_res = compressed_allreduce(
+                x[0], q, reduction=reduction, op=hvd.Sum, residual=res[0])
+            return out, new_res[None]
+
+        res = jnp.zeros((8, 10), jnp.float32)
+        out, res = step(jnp.asarray(data), res)
+        expect = data.sum(axis=0)
+        assert np.abs(np.asarray(out) - expect).max() < \
+            0.05 * np.abs(expect).max() + 0.3
 
 
 class TestConfig:

@@ -62,9 +62,8 @@ def _write_discovery(tmp_path, content: str):
 
 
 def _base_env(tmp_path, **extra):
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    from conftest import subprocess_env
+    env = subprocess_env()
     env["ELASTIC_RESULT_FILE"] = str(tmp_path / "results.txt")
     env["HVDTPU_STALL_CHECK_DISABLE"] = "1"
     env.update(extra)

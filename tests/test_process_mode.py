@@ -27,13 +27,14 @@ def _free_port() -> int:
     return port
 
 
+from conftest import subprocess_env as _subprocess_env  # noqa: E402
+
+
 def _launch_world(n: int, script: str, extra_env=None, timeout=120):
     port = _free_port()
     procs = []
     for r in range(n):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env = _subprocess_env()
         env.update({
             "HVDTPU_RANK": str(r), "HVDTPU_SIZE": str(n),
             "HVDTPU_LOCAL_RANK": str(r), "HVDTPU_LOCAL_SIZE": str(n),
@@ -64,12 +65,10 @@ def test_full_collective_menu(n):
 def test_hvdrun_cli(tmp_path):
     """hvdrun end-to-end (reference: test_static_run.py)."""
     timeline = tmp_path / "tl"
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     rc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
          "--timeline", str(timeline), sys.executable, WORKER],
-        env=env, capture_output=True, text=True, timeout=180)
+        env=_subprocess_env(), capture_output=True, text=True, timeout=180)
     assert rc.returncode == 0, rc.stderr
     import json
     events = json.load(open(f"{timeline}.0.json"))
@@ -112,5 +111,62 @@ def test_worker_failure_terminates_job(tmp_path):
     rc = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
          sys.executable, str(script)],
-        capture_output=True, text=True, timeout=120)
+        env=_subprocess_env(), capture_output=True, text=True, timeout=120)
     assert rc.returncode != 0
+
+
+def test_peer_death_between_steps_fails_over(tmp_path):
+    """A worker that dies with NO ops in flight must still break the next
+    collective on the survivors instead of hanging (regression: the
+    coordinator only set world_broken_ when tables were non-empty)."""
+    script = tmp_path / "quitter.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "import numpy as np\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import horovod_tpu as hvd\n"
+        "from horovod_tpu.exceptions import HvdTpuInternalError\n"
+        "hvd.init()\n"
+        "hvd.allreduce(np.ones(4, np.float32), name='warm')\n"
+        "if hvd.rank() == 1:\n"
+        "    os._exit(0)\n"  # vanish between steps, no join, no shutdown
+        "time.sleep(1.0)\n"  # let the coordinator observe the EOF
+        "try:\n"
+        "    hvd.allreduce(np.ones(4, np.float32), name='after')\n"
+        "except HvdTpuInternalError:\n"
+        "    print('FAILED OVER')\n"
+        "    sys.exit(0)\n"
+        "print('HUNG OR SUCCEEDED', file=sys.stderr)\n"
+        "sys.exit(9)\n")
+    results = _launch_world(2, str(script), timeout=60)
+    rc0, out0, err0 = results[0]
+    assert rc0 == 0, f"rank 0: rc={rc0}\n{err0}\n{out0}"
+    assert "FAILED OVER" in out0
+
+
+def test_join_after_peer_death_fails_over(tmp_path):
+    """hvd.join() by survivors after a non-joined peer died must error, not
+    hang (JOIN announcements bypass the ready-request dead-peer guard)."""
+    script = tmp_path / "join_quitter.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "import numpy as np\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import horovod_tpu as hvd\n"
+        "from horovod_tpu.exceptions import HvdTpuInternalError\n"
+        "hvd.init()\n"
+        "hvd.allreduce(np.ones(4, np.float32), name='warm')\n"
+        "if hvd.rank() == 1:\n"
+        "    os._exit(0)\n"
+        "time.sleep(1.0)\n"
+        "try:\n"
+        "    hvd.join()\n"
+        "except HvdTpuInternalError:\n"
+        "    print('JOIN FAILED OVER')\n"
+        "    sys.exit(0)\n"
+        "sys.exit(9)\n")
+    results = _launch_world(3, str(script), timeout=60)
+    for r in (0, 2):
+        rc, out, err = results[r]
+        assert rc == 0, f"rank {r}: rc={rc}\n{err}\n{out}"
+        assert "JOIN FAILED OVER" in out
