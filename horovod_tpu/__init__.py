@@ -36,6 +36,12 @@ from .parallel.optimizer import (DistributedOptimizer, DistributedGradientTape,
                                  allreduce_gradients, broadcast_parameters,
                                  broadcast_optimizer_state)
 
+# Sequence/context parallelism (TPU-first; no reference analog — SURVEY.md §2.7).
+from .parallel.ring_attention import (ring_attention, ring_attention_p,
+                                      make_ring_attention)
+from .parallel.ulysses import (ulysses_attention, ulysses_attention_p,
+                               make_ulysses_attention)
+
 # Compression (reference: horovod/torch/compression.py + IST fork subsystem).
 from .compression import Compression
 
