@@ -1,0 +1,267 @@
+"""GPT: the flagship explicitly-parallel decoder-only LM (pure JAX, shard_map).
+
+No reference analog (Horovod is model-agnostic, data-parallel only — SURVEY.md
+§2.7); this model exists so the framework's tensor / sequence / data-parallel
+mechanisms compose in one first-class consumer, and as the long-context
+benchmark family. Parallelism is *explicit* shard_map-style (the TPU-idiomatic
+regime): parameters are plain nested dicts with global shapes plus a matching
+``PartitionSpec`` pytree (:func:`param_specs`); inside ``run_step`` every rank
+computes on its local shard and the model inserts exactly the collectives the
+math needs:
+
+* **tp** — attention heads and MLP hidden are column-parallel; o-proj / down-proj
+  are row-parallel followed by one ``psum`` each (Megatron pattern, but via
+  shard_map + XLA collectives over ICI, not hand-written NCCL).
+* **sp** — activations are sequence-sharded; attention is ring attention
+  (``ppermute`` ring) or Ulysses (all-to-all), per config.
+* **ep** — optional MoE blocks route tokens to experts over the ep axis
+  (:mod:`horovod_tpu.parallel.moe`).
+* **dp** — gradient averaging comes from autodiff under shard_map(check_vma):
+  dp-invariant params get their grad psum inserted automatically;
+  ``DistributedOptimizer`` then only normalizes.
+
+bfloat16 activations / fp32 params+accumulators, RoPE, pre-norm RMSNorm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops import collectives as C
+from .transformer import default_attention, rope
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None      # GQA; default == num_heads
+    head_dim: int = 64
+    embed_dim: int = 512
+    mlp_dim: int = 2048
+    dtype: Any = jnp.bfloat16
+    # Mesh axis names; None disables that parallelism dimension.
+    tp_axis: Optional[str] = "tp"
+    sp_axis: Optional[str] = "sp"
+    ep_axis: Optional[str] = None
+    attention: str = "ring"                  # "ring" | "ulysses" | "dense"
+    # MoE (active when moe_every > 0): every moe_every-th block is a switch
+    # layer with num_experts experts.
+    moe_every: int = 0
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+def _axis_size(ax: Optional[str]) -> int:
+    if ax is None:
+        return 1
+    try:
+        return lax.axis_size(ax)
+    except Exception:
+        return 1  # axis not bound: unsharded execution (single-device parity)
+
+
+def _axis_bound(ax: Optional[str]) -> bool:
+    """Axis present in the enclosing shard_map trace. Size-1 axes still need
+    their collectives (identity math, but they clear the varying-axes tag that
+    in_specs naming the axis puts on every shard)."""
+    if ax is None:
+        return False
+    try:
+        lax.axis_size(ax)
+        return True
+    except Exception:
+        return False
+
+
+def _is_moe(cfg: GPTConfig, layer: int) -> bool:
+    return cfg.moe_every > 0 and (layer + 1) % cfg.moe_every == 0
+
+
+def init_params(rng, cfg: GPTConfig) -> dict:
+    """Global-shape parameter pytree (plain dicts; fp32).
+
+    Shard with :func:`param_specs` + ``jax.device_put`` (or pass the specs as
+    ``run_step`` in_specs) before feeding a shard_mapped step.
+    """
+    H, Hkv, D, E, M = (cfg.num_heads, cfg.kv_heads, cfg.head_dim,
+                       cfg.embed_dim, cfg.mlp_dim)
+
+    def dense(key, shape, fan_in):
+        # float() keeps the scale weakly-typed so params stay fp32 under x64.
+        return (jax.random.normal(key, shape, jnp.float32) /
+                float(np.sqrt(fan_in)))
+
+    keys = jax.random.split(rng, 2 + cfg.num_layers)
+    params: dict = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab_size, E),
+                                   jnp.float32) * 0.02,
+        "out_norm": jnp.ones((E,), jnp.float32),
+        "lm_head": dense(keys[1], (E, cfg.vocab_size), E),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        ks = jax.random.split(keys[2 + i], 8)
+        layer = {
+            "attn_norm": jnp.ones((E,), jnp.float32),
+            "wq": dense(ks[0], (E, H, D), E),
+            "wk": dense(ks[1], (E, Hkv, D), E),
+            "wv": dense(ks[2], (E, Hkv, D), E),
+            "wo": dense(ks[3], (H, D, E), H * D),
+            "mlp_norm": jnp.ones((E,), jnp.float32),
+        }
+        if _is_moe(cfg, i):
+            n_exp = cfg.num_experts
+            layer["moe"] = {
+                "gate": dense(ks[4], (E, n_exp), E),
+                "w_up": dense(ks[5], (n_exp, E, M), E),
+                "w_down": dense(ks[6], (n_exp, M, E), M),
+            }
+        else:
+            layer["w_up"] = dense(ks[5], (E, M), E)
+            layer["w_down"] = dense(ks[6], (M, E), M)
+        params["layers"].append(layer)
+    return params
+
+
+def param_specs(cfg: GPTConfig) -> dict:
+    """PartitionSpec pytree matching :func:`init_params` — tp shards heads and
+    MLP hidden; ep shards experts; everything else replicated."""
+    tp, ep = cfg.tp_axis, cfg.ep_axis
+    specs: dict = {
+        "embed": P(),
+        "out_norm": P(),
+        "lm_head": P(),
+        "layers": [],
+    }
+    for i in range(cfg.num_layers):
+        layer = {
+            "attn_norm": P(),
+            "wq": P(None, tp, None),
+            "wk": P(None, tp, None),
+            "wv": P(None, tp, None),
+            "wo": P(tp, None, None),
+            "mlp_norm": P(),
+        }
+        if _is_moe(cfg, i):
+            layer["moe"] = {
+                "gate": P(),
+                "w_up": P(ep, None, tp),
+                "w_down": P(ep, tp, None),
+            }
+        else:
+            layer["w_up"] = P(None, tp)
+            layer["w_down"] = P(tp, None)
+        specs["layers"].append(layer)
+    return specs
+
+
+def _rmsnorm(x, w, dtype):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + 1e-6) * w).astype(dtype)
+
+
+def _tp_psum(x, cfg: GPTConfig):
+    if _axis_bound(cfg.tp_axis):
+        return lax.psum(x, cfg.tp_axis)
+    return x
+
+
+def _attention(cfg: GPTConfig, q, k, v):
+    """Dispatch to the configured context-parallel attention. Falls back to
+    dense attention when the sp axis is not bound (single-device parity)."""
+    sp = cfg.sp_axis
+    if not _axis_bound(sp) or cfg.attention == "dense":
+        return default_attention(q, k, v, causal=True)
+    if cfg.attention == "ring":
+        from ..parallel.ring_attention import ring_attention_p
+        return ring_attention_p(q, k, v, causal=True, axis=sp)
+    if cfg.attention == "ulysses":
+        from ..parallel.ulysses import ulysses_attention_p
+        return ulysses_attention_p(q, k, v, causal=True, axis=sp)
+    raise ValueError(f"unknown attention {cfg.attention!r}")
+
+
+def _block(cfg: GPTConfig, layer_params, x, positions):
+    lp = layer_params
+    h = _rmsnorm(x, lp["attn_norm"], cfg.dtype)
+    q = jnp.einsum("bse,ehd->bshd", h, lp["wq"].astype(cfg.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, lp["wk"].astype(cfg.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, lp["wv"].astype(cfg.dtype))
+    q = rope(q, positions)
+    k = rope(k, positions)
+    attn = _attention(cfg, q, k, v)
+    o = jnp.einsum("bshd,hde->bse", attn, lp["wo"].astype(cfg.dtype))
+    x = x + _tp_psum(o, cfg)
+
+    h = _rmsnorm(x, lp["mlp_norm"], cfg.dtype)
+    if "moe" in lp:
+        from ..parallel.moe import switch_moe
+        out, _aux = switch_moe(
+            h, lp["moe"]["gate"], lp["moe"]["w_up"], lp["moe"]["w_down"],
+            axis=cfg.ep_axis, tp_axis=cfg.tp_axis,
+            capacity_factor=cfg.capacity_factor, dtype=cfg.dtype)
+        return x + out
+    up = jnp.einsum("bse,em->bsm", h, lp["w_up"].astype(cfg.dtype))
+    up = jax.nn.gelu(up)
+    down = jnp.einsum("bsm,me->bse", up, lp["w_down"].astype(cfg.dtype))
+    return x + _tp_psum(down, cfg)
+
+
+def forward(params, tokens, positions, cfg: GPTConfig):
+    """Logits ``[B, S_local, vocab]`` (fp32). ``tokens``/``positions`` are this
+    rank's sequence shard (global positions) when sp is active."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    for lp in params["layers"]:
+        x = _block(cfg, lp, x, positions)
+    x = _rmsnorm(x, params["out_norm"], cfg.dtype)
+    return jnp.einsum("bse,ev->bsv", x,
+                      params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, targets, positions, cfg: GPTConfig,
+            ignore_index: int = -1):
+    """Mean next-token cross-entropy over all *global* target tokens.
+
+    ``targets`` is sequence-sharded like ``tokens`` (shift done globally by the
+    caller, so shard boundaries need no neighbor exchange); positions with
+    ``ignore_index`` are masked out. Averages over sp so every rank returns the
+    identical global-mean loss.
+    """
+    logits = forward(params, tokens, positions, cfg)
+    mask = (targets != ignore_index)
+    safe_targets = jnp.where(mask, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tok_loss = -jnp.take_along_axis(logp, safe_targets[..., None],
+                                    axis=-1)[..., 0]
+    tok_loss = jnp.where(mask, tok_loss, 0.0)
+    num = jnp.sum(tok_loss)
+    den = jnp.sum(mask.astype(jnp.float32))
+    # The token population is sharded over sp (sequence) and, when experts are
+    # parallel, over ep (batch rides (dp, ep)); reduce over both so every rank
+    # returns the same global-mean — dp averaging is the caller's (optimizer's).
+    for ax in (cfg.sp_axis, cfg.ep_axis):
+        if _axis_bound(ax):
+            num = lax.psum(num, ax)
+            den = lax.psum(den, ax)
+    return num / jnp.maximum(den, 1.0)
+
+
+def data_specs(cfg: GPTConfig) -> Tuple[P, P]:
+    """(tokens/targets spec, positions spec): batch over dp, sequence over sp."""
+    from .. import runtime
+    dp = runtime.dp_axis()
+    return P(dp, cfg.sp_axis), P(dp, cfg.sp_axis)

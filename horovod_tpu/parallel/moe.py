@@ -1,0 +1,110 @@
+"""Expert parallelism: top-1 (switch) mixture-of-experts over a mesh axis.
+
+No reference analog — Horovod ships no expert parallelism; SURVEY.md §2.7 notes
+``hvd.alltoall`` (``operations.cc:1055-1116``) is the enabling primitive users
+would build expert routing on. This module is that composition, TPU-native:
+capacity-bounded one-hot dispatch (static shapes, MXU-friendly einsums — the
+Mesh-TensorFlow/Switch pattern, *not* data-dependent gather loops), a tiled
+``lax.all_to_all`` to move token slots to their expert's owning device, local
+expert FFNs (optionally tensor-parallel on the hidden dim), and the reverse
+all-to-all + weighted combine.
+
+Layout: activations arrive with the batch sharded over (dp, ep) — each ep rank
+routes *its* tokens; experts are sharded over ep (each rank owns
+``num_experts / ep_size`` experts). Gradients: the dispatch mask is
+non-differentiable (stop-grad semantics of one-hot-of-argmax); the gate
+gradient flows through the combine-weight multiplier, the standard switch
+estimator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _axis_size(ax: Optional[str]) -> int:
+    if ax is None:
+        return 1
+    try:
+        return lax.axis_size(ax)
+    except Exception:
+        return 1
+
+
+def switch_moe(x, gate_w, w_up, w_down, axis: Optional[str] = None,
+               tp_axis: Optional[str] = None, capacity_factor: float = 1.25,
+               dtype: Any = jnp.bfloat16) -> Tuple[jnp.ndarray, dict]:
+    """Top-1 switch MoE layer.
+
+    Args:
+      x: ``[B, S, d]`` activations (this rank's batch/sequence shard).
+      gate_w: ``[d, num_experts]`` router weights (replicated, fp32).
+      w_up: ``[experts_local, d, m_local]`` expert up-projections — the ep-axis
+        shard of the global ``[num_experts, d, m]`` tensor (and tp shard of m).
+      w_down: ``[experts_local, m_local, d]``.
+      axis: expert-parallel mesh axis (None/unbound ⇒ all experts local).
+      tp_axis: tensor-parallel axis sharding the expert hidden dim, if any.
+      capacity_factor: per-expert slot budget multiplier; tokens over capacity
+        are dropped (standard switch semantics).
+
+    Returns ``(out [B, S, d], aux)`` with ``aux['load_balance_loss']`` (the
+    Switch-Transformer auxiliary) and ``aux['dropped_fraction']``.
+    """
+    B, S, d = x.shape
+    n_ep = _axis_size(axis)
+    experts_local = w_up.shape[0]
+    num_experts = experts_local * n_ep
+
+    T = B * S
+    xt = x.reshape(T, d)
+    logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                            # [T]
+    gate_prob = jnp.max(probs, axis=-1)                            # [T]
+
+    capacity = int(np.ceil(T * capacity_factor / num_experts))
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)  # [T, E]
+    # Slot index of each token within its expert's capacity buffer.
+    pos = jnp.cumsum(onehot, axis=0) - onehot                        # [T, E]
+    keep = onehot * (pos < capacity)                                 # [T, E]
+    slot = jax.nn.one_hot(
+        jnp.sum(pos * onehot, axis=-1).astype(jnp.int32), capacity,
+        dtype=jnp.float32)                                           # [T, C]
+    dispatch = jnp.einsum("te,tc->tec", keep, slot)                  # [T, E, C]
+    combine = dispatch * gate_prob[:, None, None]
+
+    # [E, C, d]: expert-major token slots, still on the source rank.
+    slots = jnp.einsum("tec,td->ecd", dispatch.astype(dtype),
+                       xt.astype(dtype))
+    if n_ep > 1:
+        # Scatter experts to their owners, gathering every peer's slots for
+        # our local experts: [E, C, d] -> [E/n_ep, n_ep*C, d].
+        slots = lax.all_to_all(slots, axis, split_axis=0, concat_axis=1,
+                               tiled=True)
+
+    up = jnp.einsum("ecd,edm->ecm", slots, w_up.astype(dtype))
+    up = jax.nn.gelu(up)
+    out_slots = jnp.einsum("ecm,emd->ecd", up, w_down.astype(dtype))
+    if tp_axis is not None and _axis_size(tp_axis) > 1:
+        out_slots = lax.psum(out_slots, tp_axis)  # row-parallel hidden dim
+
+    if n_ep > 1:
+        # Return each peer's processed slots: [E/n_ep, n_ep*C, d] -> [E, C, d].
+        out_slots = lax.all_to_all(out_slots, axis, split_axis=1,
+                                   concat_axis=0, tiled=True)
+
+    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), out_slots)
+
+    # Switch aux: num_experts * sum_e mean_prob_e * fraction_routed_e
+    # (local-batch estimate; replicated params make it consistent under grad).
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    lb_loss = num_experts * jnp.sum(frac * mean_prob)
+    dropped = 1.0 - jnp.sum(keep) / jnp.maximum(jnp.sum(onehot), 1.0)
+    return out.reshape(B, S, d), {"load_balance_loss": lb_loss,
+                                  "dropped_fraction": dropped}

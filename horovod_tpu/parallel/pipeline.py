@@ -1,0 +1,85 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a mesh axis.
+
+No reference analog (SURVEY.md §2.7: pipeline parallelism ABSENT from Horovod).
+TPU-native design: the pipeline is a single SPMD program — every pp rank holds
+one stage's parameters (leading stage dimension sharded over the pp axis), and
+a ``lax.scan`` over schedule ticks moves activations one hop along the ring
+with ``lax.ppermute`` (neighbor transfers ride ICI). The backward pass needs no
+hand-written schedule: autodiff of scan+ppermute yields the reverse (1F1B-free,
+GPipe-style) pipeline automatically.
+
+For ``P`` stages and ``M`` microbatches the schedule runs ``M + P - 1`` ticks
+with the usual GPipe bubble; all ranks execute every tick (SPMD), with bubble
+ticks computing on placeholder data that is masked out of the result.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, axis: str = "pp",
+                   broadcast_out: bool = True):
+    """Run shape-preserving ``stage_fn`` as a P-stage GPipe pipeline (in-step).
+
+    Args:
+      stage_fn: ``(params, microbatch) -> microbatch`` — this rank's stage.
+        Must preserve the microbatch shape/dtype (residual-block style).
+      stage_params: this rank's stage parameters. Leaves carry the shard_map'd
+        leading stage dim of size 1 (global ``[P, ...]`` sharded over ``axis``);
+        it is squeezed off before ``stage_fn`` sees them.
+      x: ``[M, mb, ...]`` microbatched input (replicated or dp-sharded on mb).
+      axis: the pp mesh axis.
+      broadcast_out: return the result on every pp rank (one extra collective);
+        if False the output is only valid on the last stage's rank.
+
+    Returns ``[M, mb, ...]`` outputs of the final stage.
+    """
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    M = x.shape[0]
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def tick(carry, t):
+        act, outs = carry
+        m_in = jnp.clip(t, 0, M - 1)
+        first = jnp.take(x, m_in, axis=0)
+        inp = jnp.where(r == 0, first, act)
+        y = stage_fn(params, inp)
+        recv = lax.ppermute(y, axis, perm=perm) if n > 1 else y
+        m_out = t - (n - 1)
+        store = jnp.logical_and(r == n - 1, m_out >= 0)
+        updated = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(m_out, 0, M - 1), axis=0)
+        outs = jnp.where(store, updated, outs)
+        return (recv, outs), None
+
+    act0 = jnp.zeros_like(jnp.take(x, 0, axis=0))
+    outs0 = jnp.zeros_like(x)
+    # The loop makes the carry pp-varying (each rank computes its own stage);
+    # the initial zeros must match or scan rejects the carry types.
+    from ..ops.collectives import pvary
+    act0, outs0 = pvary((act0, outs0), axis=axis)
+    (_, outs), _ = lax.scan(
+        tick, (act0, outs0), jnp.arange(M + n - 1, dtype=jnp.int32))
+    if broadcast_out and n > 1:
+        from ..ops.collectives import broadcast_p
+        outs = broadcast_p(outs, root_rank=n - 1, axis=axis)
+    return outs
+
+
+def stage_partition(n_layers: int, axis_size: int, rank: Optional[int] = None):
+    """Contiguous layer ranges per stage: returns ``(start, count)`` per rank
+    (helper for slicing stacked layer params into pipeline stages)."""
+    if n_layers % axis_size:
+        raise ValueError(f"{n_layers} layers not divisible into "
+                         f"{axis_size} pipeline stages")
+    per = n_layers // axis_size
+    if rank is None:
+        return [(i * per, per) for i in range(axis_size)]
+    return rank * per, per

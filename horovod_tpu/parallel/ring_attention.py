@@ -80,8 +80,9 @@ def ring_attention_p(q, k, v, causal: bool = True,
       k, v: ``[B, Sk_shard, Hkv, D]`` key/value blocks; ``Hkv`` may divide ``H``
         (GQA).
       causal: apply causal masking using global positions.
-      axis: mesh axis name to ring over (default: the "sp" axis if the mesh has
-        one, else the data-parallel axis).
+      axis: mesh axis name to ring over (default: the mesh's "sp" axis; raises
+        if the mesh has none — there is deliberately no dp fallback, see
+        :func:`_default_axis`).
       q_positions / kv_positions: optional ``[Sq_shard]`` / ``[Sk_shard]``
         global position vectors; default assumes contiguous sharding.
 
@@ -157,28 +158,19 @@ def ring_attention(q, k, v, causal: bool = True, axis: Optional[str] = None,
                                 kv_positions=kv_positions)
     from jax.sharding import PartitionSpec as P
     mesh = runtime.mesh()
+    # Global sequence length is known here, so default positions materialize
+    # outside the shard_map and arrive pre-sliced per shard.
+    if q_positions is None:
+        q_positions = jnp.arange(q.shape[1])
+    if kv_positions is None:
+        kv_positions = jnp.arange(k.shape[1])
     seq_spec = P(None, ax)
-    pos_spec = P(ax)
-    in_specs = [seq_spec, seq_spec, seq_spec]
-    args = [q, k, v]
-    if q_positions is not None:
-        in_specs.append(pos_spec)
-    if kv_positions is not None:
-        in_specs.append(pos_spec)
-
-    def body(q, k, v, *pos):
-        qp = pos[0] if q_positions is not None else None
-        kp = (pos[-1] if kv_positions is not None else None)
-        return ring_attention_p(q, k, v, causal=causal, axis=ax,
-                                q_positions=qp, kv_positions=kp)
-
-    if q_positions is not None:
-        args.append(q_positions)
-    if kv_positions is not None:
-        args.append(kv_positions)
-    mapped = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                           out_specs=seq_spec)
-    return mapped(*args)
+    mapped = jax.shard_map(
+        lambda q, k, v, qp, kp: ring_attention_p(
+            q, k, v, causal=causal, axis=ax, q_positions=qp, kv_positions=kp),
+        mesh=mesh, in_specs=(seq_spec,) * 3 + (P(ax),) * 2,
+        out_specs=seq_spec)
+    return mapped(q, k, v, q_positions, kv_positions)
 
 
 def make_ring_attention(axis: Optional[str] = None) -> Callable:
