@@ -74,6 +74,20 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
     lib.hvdtpu_join.restype = ctypes.c_longlong
     lib.hvdtpu_join.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_set_cache_capacity.restype = ctypes.c_int
+    lib.hvdtpu_set_cache_capacity.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_longlong]
+    lib.hvdtpu_set_autotune.restype = ctypes.c_int
+    lib.hvdtpu_set_autotune.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_double]
+    lib.hvdtpu_start_timeline.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_int]
+    lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
+    lib.hvdtpu_cycle_time_ms.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
+    lib.hvdtpu_fusion_threshold.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -135,6 +149,19 @@ class NativeCore:
             cross_size if cross_size is not None else size,
             coord_host.encode(), coord_port, my_host.encode(), cycle_ms,
             fusion, timeline.encode(), int(mark_cycles), stall)
+        # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
+        self._lib.hvdtpu_set_cache_capacity(
+            self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
+        # Autotune (reference: HOROVOD_AUTOTUNE + HOROVOD_AUTOTUNE_* knobs,
+        # operations.cc:474-532).
+        if ev.get_bool(ev.HVDTPU_AUTOTUNE):
+            self._lib.hvdtpu_set_autotune(
+                self._core, 1,
+                (ev.get_str(ev.HVDTPU_AUTOTUNE_LOG, "") or "").encode(),
+                ev.get_int(ev.HVDTPU_AUTOTUNE_WARMUP_SAMPLES, 3),
+                ev.get_int(ev.HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE, 50),
+                ev.get_int(ev.HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 30),
+                ev.get_float(ev.HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.2))
         self._started = False
         # Inputs pinned until their async op completes (the native core reads
         # the caller's buffer zero-copy).
@@ -222,6 +249,27 @@ class NativeCore:
         if kind in ("allreduce", "broadcast"):
             out = out.reshape(arr.shape)
         return out
+
+    # -- timeline / introspection -----------------------------------------
+
+    def start_timeline(self, path: str, mark_cycles: bool = False) -> None:
+        """Begin writing a Chrome-trace timeline at runtime (reference:
+        ``horovod_start_timeline``, operations.cc:735)."""
+        self._lib.hvdtpu_start_timeline(self._core, path.encode(),
+                                        int(mark_cycles))
+
+    def stop_timeline(self) -> None:
+        """Stop a running timeline (reference: ``horovod_stop_timeline``,
+        operations.cc:780)."""
+        self._lib.hvdtpu_stop_timeline(self._core)
+
+    def cycle_time_ms(self) -> float:
+        """Current (possibly autotuned) background cycle time."""
+        return float(self._lib.hvdtpu_cycle_time_ms(self._core))
+
+    def fusion_threshold(self) -> int:
+        """Current (possibly autotuned) fusion threshold in bytes."""
+        return int(self._lib.hvdtpu_fusion_threshold(self._core))
 
     def join(self) -> int:
         ret = int(self._lib.hvdtpu_join(self._core))

@@ -64,26 +64,7 @@ class GPTConfig:
         return self.num_kv_heads or self.num_heads
 
 
-def _axis_size(ax: Optional[str]) -> int:
-    if ax is None:
-        return 1
-    try:
-        return lax.axis_size(ax)
-    except Exception:
-        return 1  # axis not bound: unsharded execution (single-device parity)
-
-
-def _axis_bound(ax: Optional[str]) -> bool:
-    """Axis present in the enclosing shard_map trace. Size-1 axes still need
-    their collectives (identity math, but they clear the varying-axes tag that
-    in_specs naming the axis puts on every shard)."""
-    if ax is None:
-        return False
-    try:
-        lax.axis_size(ax)
-        return True
-    except Exception:
-        return False
+from ..parallel.axes import axis_size as _axis_size, axis_bound as _axis_bound
 
 
 def _is_moe(cfg: GPTConfig, layer: int) -> bool:
@@ -261,7 +242,10 @@ def loss_fn(params, tokens, targets, positions, cfg: GPTConfig,
 
 
 def data_specs(cfg: GPTConfig) -> Tuple[P, P]:
-    """(tokens/targets spec, positions spec): batch over dp, sequence over sp."""
+    """(tokens/targets spec, positions spec): batch over dp — and over ep when
+    expert parallelism is on (the MoE batch rides (dp, ep), see moe.py) —
+    sequence over sp."""
     from .. import runtime
     dp = runtime.dp_axis()
-    return P(dp, cfg.sp_axis), P(dp, cfg.sp_axis)
+    batch_axes = (dp, cfg.ep_axis) if cfg.ep_axis else dp
+    return P(batch_axes, cfg.sp_axis), P(batch_axes, cfg.sp_axis)

@@ -19,6 +19,7 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <list>
 #include <cmath>
 #include <condition_variable>
 #include <cstdarg>
@@ -32,6 +33,7 @@
 #include <unordered_map>
 #include <unordered_set>
 
+#include "autotune.h"
 #include "common.h"
 #include "data_plane.h"
 #include "message.h"
@@ -45,9 +47,11 @@ namespace {
 enum class CtrlMsg : int32_t {
   HELLO = 1,
   PEERS = 2,
-  READY = 3,
+  READY = 3,      // full requests + cache-hit names
   RESPONSES = 4,
   JOIN = 5,
+  NEED_FULL = 6,  // coordinator -> worker: cache miss, resend full requests
+  PARAMS = 7,     // coordinator -> worker: autotuned cycle time / fusion
 };
 
 void LogWarn(int rank, const char* fmt, ...) {
@@ -65,7 +69,104 @@ double NowSeconds() {
       .count();
 }
 
+// Compare everything that must match for a cached announcement to be valid
+// (reference: ResponseCache keys on name + params, response_cache.cc).
+bool SameRequest(const Request& a, const Request& b) {
+  return a.op_type == b.op_type && a.reduce_op == b.reduce_op &&
+         a.dtype == b.dtype && a.shape == b.shape &&
+         a.prescale == b.prescale && a.postscale == b.postscale &&
+         a.root_rank == b.root_rank && a.splits == b.splits;
+}
+
 }  // namespace
+
+// LRU response cache (reference: horovod/common/response_cache.{h,cc}).
+// The reference synchronizes bit-indexed cache entries with two bitvector
+// allreduces per cycle; with a TCP star control plane the race-free analog is
+// name-keyed: workers announce just the tensor NAME when their request is
+// byte-identical to the last one, the coordinator re-materializes the full
+// request from its own cache, and a miss (eviction divergence) is repaired by
+// a NEED_FULL round trip instead of a protocol error.
+class RequestCache {
+ public:
+  void SetCapacity(int64_t cap) { capacity_ = cap; }
+  bool enabled() const { return capacity_ > 0; }
+
+  // Worker side: true if `q` matches the cached entry for its name (-> the
+  // bare name suffices on the wire). Updates/inserts the entry otherwise.
+  bool CheckAndPut(const Request& q) {
+    auto it = map_.find(q.name);
+    if (it != map_.end()) {
+      Touch(it);
+      if (SameRequest(it->second.req, q)) return true;
+      it->second.req = q;
+      return false;
+    }
+    Insert(q.name).req = q;
+    return false;
+  }
+
+  // Coordinator side: remember rank r's full request for this name.
+  void PutRank(const Request& q) {
+    auto it = map_.find(q.name);
+    Entry& e = it != map_.end() ? (Touch(it), it->second) : Insert(q.name);
+    if (static_cast<size_t>(q.rank) >= e.by_rank.size()) {
+      e.by_rank.resize(q.rank + 1);
+      e.valid.resize(q.rank + 1, false);
+    }
+    e.by_rank[q.rank] = q;
+    e.valid[q.rank] = true;
+  }
+
+  // Coordinator side: recover rank r's request from a bare-name hit.
+  bool GetRank(const std::string& name, int rank, Request* out) {
+    auto it = map_.find(name);
+    if (it == map_.end()) return false;
+    Touch(it);
+    Entry& e = it->second;
+    if (static_cast<size_t>(rank) >= e.valid.size() || !e.valid[rank]) {
+      return false;
+    }
+    *out = e.by_rank[rank];
+    return true;
+  }
+
+  void Erase(const std::string& name) {
+    auto it = map_.find(name);
+    if (it == map_.end()) return;
+    lru_.erase(it->second.pos);
+    map_.erase(it);
+  }
+
+ private:
+  struct Entry {
+    Request req;                    // worker side: my last-sent request
+    std::vector<Request> by_rank;   // coordinator side
+    std::vector<bool> valid;
+    std::list<std::string>::iterator pos;
+  };
+  using Map = std::unordered_map<std::string, Entry>;
+
+  void Touch(Map::iterator it) {
+    lru_.erase(it->second.pos);
+    lru_.push_front(it->first);
+    it->second.pos = lru_.begin();
+  }
+  Entry& Insert(const std::string& name) {
+    while (static_cast<int64_t>(map_.size()) >= capacity_ && !lru_.empty()) {
+      map_.erase(lru_.back());
+      lru_.pop_back();
+    }
+    lru_.push_front(name);
+    Entry& e = map_[name];
+    e.pos = lru_.begin();
+    return e;
+  }
+
+  int64_t capacity_ = 1024;  // reference default: HOROVOD_CACHE_CAPACITY
+  Map map_;
+  std::list<std::string> lru_;
+};
 
 struct CoreConfig {
   int rank = 0;
@@ -82,6 +183,14 @@ struct CoreConfig {
   std::string timeline_path;
   bool timeline_mark_cycles = false;
   double stall_warn_secs = 60.0;  // reference HOROVOD_STALL_CHECK_TIME
+  int64_t cache_capacity = 1024;  // reference HOROVOD_CACHE_CAPACITY
+  // Autotune (reference HOROVOD_AUTOTUNE_* knobs, operations.cc:474-532).
+  bool autotune = false;
+  std::string autotune_log;
+  int autotune_warmup_samples = 3;
+  int autotune_cycles_per_sample = 50;
+  int autotune_max_samples = 30;
+  double autotune_gp_noise = 0.2;
 };
 
 class Core {
@@ -103,12 +212,23 @@ class Core {
   Status CopyResult(int64_t handle, void* dst, int64_t capacity);
   int64_t Join();  // blocks until all ranks joined; returns last rank
 
+  // Runtime timeline control (reference: horovod_start_timeline /
+  // horovod_stop_timeline, operations.cc:735-790). Thread-safe: the request
+  // is applied by the background thread at the top of its next cycle so the
+  // Timeline object stays single-owner.
+  void RequestTimeline(bool start, const std::string& path, bool mark_cycles);
+  // Current (possibly autotuned) loop parameters, for tests/introspection.
+  double CurrentCycleTimeMs();
+  int64_t CurrentFusionThreshold();
+  CoreConfig* mutable_config() { return &cfg_; }  // pre-Start() only
+
  private:
   void BackgroundLoop();
   void PumpControlPlane();           // role-dependent per-cycle work
   void CoordinatorIngest();          // rank 0: read worker frames
   void CoordinatorEmitResponses();   // rank 0: match + fuse + broadcast
-  void WorkerSendReady(std::vector<Request> reqs);
+  void WorkerSendReady(std::vector<Request> reqs,
+                       std::vector<std::string> cached);
   void HandleReadyRequests(std::vector<Request> reqs);  // coordinator table
   Response BuildResponse(const std::string& name);
   void ExecuteResponseList(const std::vector<Response>& list);
@@ -156,13 +276,69 @@ class Core {
   std::atomic<bool> world_broken_{false};
   bool started_ = false;
 
+  // Response cache (see RequestCache above). Worker role uses req/enabled;
+  // coordinator role uses the per-rank table.
+  RequestCache cache_;
+
+  // Autotune: coordinator-only decisions, broadcast via CtrlMsg::PARAMS.
+  ParameterManager param_manager_;
+
+  // Pending timeline start/stop, applied by the background thread.
+  std::mutex timeline_req_mu_;
+  bool timeline_req_pending_ = false;
+  bool timeline_req_start_ = false;
+  std::string timeline_req_path_;
+  bool timeline_req_mark_ = false;
+
+  void ApplyTimelineRequest();
   void FailAllOutstanding(const std::string& reason);
 };
+
+void Core::RequestTimeline(bool start, const std::string& path,
+                           bool mark_cycles) {
+  std::lock_guard<std::mutex> lk(timeline_req_mu_);
+  timeline_req_pending_ = true;
+  timeline_req_start_ = start;
+  timeline_req_path_ = path;
+  timeline_req_mark_ = mark_cycles;
+}
+
+void Core::ApplyTimelineRequest() {
+  std::lock_guard<std::mutex> lk(timeline_req_mu_);
+  if (!timeline_req_pending_) return;
+  timeline_req_pending_ = false;
+  if (timeline_req_start_) {
+    timeline_.Shutdown();
+    timeline_.Initialize(timeline_req_path_, cfg_.rank);
+    cfg_.timeline_mark_cycles = timeline_req_mark_;
+  } else {
+    timeline_.Shutdown();
+    cfg_.timeline_mark_cycles = false;
+  }
+}
+
+double Core::CurrentCycleTimeMs() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return cfg_.cycle_time_ms;
+}
+
+int64_t Core::CurrentFusionThreshold() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return cfg_.fusion_threshold;
+}
 
 Status Core::Start() {
   if (started_) return Status::OK();
   if (!cfg_.timeline_path.empty()) {
     timeline_.Initialize(cfg_.timeline_path, cfg_.rank);
+  }
+  cache_.SetCapacity(cfg_.cache_capacity);
+  if (cfg_.autotune && cfg_.rank == 0) {
+    param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
+                              cfg_.autotune_log, cfg_.autotune_warmup_samples,
+                              cfg_.autotune_cycles_per_sample,
+                              cfg_.autotune_max_samples,
+                              cfg_.autotune_gp_noise);
   }
   Status st = data_plane_.Listen();
   if (!st.ok()) return st;
@@ -392,6 +568,7 @@ void Core::BackgroundLoop() {
                    });
     }
     if (shutdown_) break;
+    ApplyTimelineRequest();
     if (cfg_.timeline_mark_cycles) timeline_.MarkCycle();
     PumpControlPlane();
   }
@@ -447,7 +624,21 @@ void Core::PumpControlPlane() {
     CheckStalls();
     CoordinatorEmitResponses();
   } else {
-    if (!reqs.empty()) WorkerSendReady(std::move(reqs));
+    if (!reqs.empty()) {
+      // Response-cache fast path: a request identical to the last one for the
+      // same name travels as just its name (reference: ResponseCache hit
+      // skipping negotiation, response_cache.cc; see RequestCache above).
+      std::vector<Request> fulls;
+      std::vector<std::string> cached;
+      for (auto& q : reqs) {
+        if (cache_.enabled() && cache_.CheckAndPut(q)) {
+          cached.push_back(q.name);
+        } else {
+          fulls.push_back(std::move(q));
+        }
+      }
+      WorkerSendReady(std::move(fulls), std::move(cached));
+    }
     if (announce_join) {
       Writer w;
       w.I32(static_cast<int32_t>(CtrlMsg::JOIN));
@@ -476,6 +667,44 @@ void Core::PumpControlPlane() {
       }
       Reader r(frame);
       CtrlMsg type = static_cast<CtrlMsg>(r.I32());
+      if (type == CtrlMsg::NEED_FULL) {
+        // Coordinator evicted a cache entry we hit on — resend in full from
+        // the still-outstanding entry (race-free repair path).
+        int64_t n = r.I64();
+        std::vector<Request> fulls;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          for (int64_t i = 0; i < n; ++i) {
+            std::string name = r.Str();
+            auto it = outstanding_.find(name);
+            if (it == outstanding_.end()) continue;
+            TensorEntry* e = it->second;
+            Request q;
+            q.rank = cfg_.rank;
+            q.op_type = e->op_type;
+            q.reduce_op = e->reduce_op;
+            q.dtype = e->dtype;
+            q.name = e->name;
+            q.shape = e->shape;
+            q.prescale = e->prescale;
+            q.postscale = e->postscale;
+            q.root_rank = e->root_rank;
+            q.splits = e->splits;
+            fulls.push_back(std::move(q));
+          }
+        }
+        for (auto& q : fulls) cache_.CheckAndPut(q);  // refresh local entry
+        if (!fulls.empty()) WorkerSendReady(std::move(fulls), {});
+        continue;
+      }
+      if (type == CtrlMsg::PARAMS) {
+        double cycle = r.F64();
+        int64_t fusion = r.I64();
+        std::lock_guard<std::mutex> lk(mu_);
+        cfg_.cycle_time_ms = cycle;
+        cfg_.fusion_threshold = fusion;
+        continue;
+      }
       if (type != CtrlMsg::RESPONSES) continue;
       int64_t n = r.I64();
       std::vector<Response> list;
@@ -485,11 +714,14 @@ void Core::PumpControlPlane() {
   }
 }
 
-void Core::WorkerSendReady(std::vector<Request> reqs) {
+void Core::WorkerSendReady(std::vector<Request> reqs,
+                           std::vector<std::string> cached) {
   Writer w;
   w.I32(static_cast<int32_t>(CtrlMsg::READY));
   w.I64(static_cast<int64_t>(reqs.size()));
   for (const auto& q : reqs) SerializeRequest(q, &w);
+  w.I64(static_cast<int64_t>(cached.size()));
+  for (const auto& name : cached) w.Str(name);
   if (SendFrame(control_fd_, w.buffer()) != 0 && !shutdown_) {
     LogWarn(cfg_.rank, "failed to send ready list to coordinator");
   }
@@ -530,7 +762,31 @@ void Core::CoordinatorIngest() {
       if (type == CtrlMsg::READY) {
         int64_t n = r.I64();
         std::vector<Request> reqs;
-        for (int64_t i = 0; i < n; ++i) reqs.push_back(DeserializeRequest(&r));
+        for (int64_t i = 0; i < n; ++i) {
+          Request q = DeserializeRequest(&r);
+          if (cache_.enabled()) cache_.PutRank(q);
+          reqs.push_back(std::move(q));
+        }
+        // Cache-hit names: re-materialize the full request this rank last
+        // sent; on a miss (entry evicted here) ask the worker to resend.
+        int64_t ncached = r.I64();
+        std::vector<std::string> need_full;
+        for (int64_t i = 0; i < ncached; ++i) {
+          std::string name = r.Str();
+          Request q;
+          if (cache_.GetRank(name, rank, &q)) {
+            reqs.push_back(std::move(q));
+          } else {
+            need_full.push_back(std::move(name));
+          }
+        }
+        if (!need_full.empty()) {
+          Writer w;
+          w.I32(static_cast<int32_t>(CtrlMsg::NEED_FULL));
+          w.I64(static_cast<int64_t>(need_full.size()));
+          for (const auto& name : need_full) w.Str(name);
+          SendFrame(fd, w.buffer());
+        }
         HandleReadyRequests(std::move(reqs));
       } else if (type == CtrlMsg::JOIN) {
         int32_t who = r.I32();
@@ -788,6 +1044,10 @@ void Core::CoordinatorEmitResponses() {
     ready_names_.pop_front();
     Response resp = BuildResponse(name);
     message_table_.erase(name);
+    if (resp.type == ResponseType::ERROR) {
+      // Don't let future bare-name hits resurrect disagreeing requests.
+      cache_.Erase(name);
+    }
     if (resp.type == ResponseType::OK &&
         resp.op_type == OpType::ALLREDUCE) {
       int64_t fused_bytes =
@@ -842,6 +1102,37 @@ void Core::CoordinatorEmitResponses() {
     std::vector<uint8_t> payload = w.Take();
     for (int rank = 1; rank < cfg_.size; ++rank) {
       if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
+    }
+  }
+
+  if (param_manager_.active()) {
+    // Score this cycle by payload bytes moved; adopt + broadcast any new
+    // parameters (reference: ParameterManager::Update scored bytes/sec,
+    // SynchronizeParameters broadcast, controller.cc:34-48).
+    int64_t bytes = 0;
+    for (const auto& resp : list) {
+      if (resp.type != ResponseType::OK) continue;
+      for (const auto& s : resp.shapes) {
+        bytes += NumElements(s) * static_cast<int64_t>(DataTypeSize(resp.dtype));
+      }
+    }
+    if (param_manager_.Update(bytes, NowSeconds())) {
+      ParameterManager::Params p = param_manager_.Current();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        cfg_.cycle_time_ms = p.cycle_time_ms;
+        cfg_.fusion_threshold = p.fusion_threshold;
+      }
+      if (cfg_.size > 1) {
+        Writer w;
+        w.I32(static_cast<int32_t>(CtrlMsg::PARAMS));
+        w.F64(p.cycle_time_ms);
+        w.I64(p.fusion_threshold);
+        std::vector<uint8_t> payload = w.Take();
+        for (int rank = 1; rank < cfg_.size; ++rank) {
+          if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
+        }
+      }
     }
   }
   ExecuteResponseList(list);
@@ -1255,6 +1546,45 @@ int hvdtpu_copy_result(void* core, long long handle, void* dst,
 
 long long hvdtpu_join(void* core) {
   return static_cast<Core*>(core)->Join();
+}
+
+// Pre-Start() configuration (reference: env knobs parsed at init,
+// operations.cc:456-532 — here Python parses env and pushes values down).
+int hvdtpu_set_cache_capacity(void* core, long long capacity) {
+  static_cast<Core*>(core)->mutable_config()->cache_capacity = capacity;
+  return 0;
+}
+
+int hvdtpu_set_autotune(void* core, int enabled, const char* log_path,
+                        int warmup_samples, int cycles_per_sample,
+                        int max_samples, double gp_noise) {
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->autotune = enabled != 0;
+  cfg->autotune_log = log_path ? log_path : "";
+  if (warmup_samples > 0) cfg->autotune_warmup_samples = warmup_samples;
+  if (cycles_per_sample > 0) cfg->autotune_cycles_per_sample = cycles_per_sample;
+  if (max_samples > 0) cfg->autotune_max_samples = max_samples;
+  if (gp_noise > 0) cfg->autotune_gp_noise = gp_noise;
+  return 0;
+}
+
+// Runtime timeline control (reference: horovod_start_timeline /
+// horovod_stop_timeline, operations.cc:735-790).
+void hvdtpu_start_timeline(void* core, const char* path, int mark_cycles) {
+  static_cast<Core*>(core)->RequestTimeline(true, path ? path : "",
+                                            mark_cycles != 0);
+}
+
+void hvdtpu_stop_timeline(void* core) {
+  static_cast<Core*>(core)->RequestTimeline(false, "", false);
+}
+
+double hvdtpu_cycle_time_ms(void* core) {
+  return static_cast<Core*>(core)->CurrentCycleTimeMs();
+}
+
+long long hvdtpu_fusion_threshold(void* core) {
+  return static_cast<Core*>(core)->CurrentFusionThreshold();
 }
 
 }  // extern "C"

@@ -13,6 +13,11 @@ void Timeline::Initialize(const std::string& path, int rank) {
   fputs("[\n", file_);
   first_ = true;
   stop_ = false;
+  {
+    // Drop events raced in after a previous Shutdown drained the writer.
+    std::lock_guard<std::mutex> lk(mu_);
+    while (!queue_.empty()) queue_.pop();
+  }
   initialized_ = true;
   writer_ = std::thread([this] { WriterLoop(); });
 }

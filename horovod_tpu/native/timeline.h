@@ -7,6 +7,7 @@
 // reference's async writer (timeline.cc:185-380).
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstdio>
@@ -45,7 +46,7 @@ class Timeline {
   void WriterLoop();
   int64_t NowUs() const;
 
-  bool initialized_ = false;
+  std::atomic<bool> initialized_{false};
   int rank_ = 0;
   FILE* file_ = nullptr;
   bool first_ = true;

@@ -428,7 +428,7 @@ def allreduce(x, name: Optional[str] = None, op: ReduceOp = ReduceOp.AVERAGE,
             return allreduce_p(tensor, op=op, axis=axis,
                                prescale_factor=prescale_factor,
                                postscale_factor=postscale_factor)
-        if runtime.mode() == "process" and runtime.size() > 1:
+        if runtime.mode() == "process":
             return _core_collective(
                 "allreduce", tensor, name or _auto_name("allreduce"),
                 op=int(op), prescale=prescale_factor, postscale=postscale_factor)
@@ -472,7 +472,7 @@ def allgather(x, name: Optional[str] = None, axis: Optional[str] = None):
     """
     if in_named_trace(axis):
         return allgather_p(x, axis=axis)
-    if runtime.mode() == "process" and runtime.size() > 1:
+    if runtime.mode() == "process":
         return _core_collective("allgather", x, name or _auto_name("allgather"))
     ax = runtime.dp_axis()
     dim = _mesh_axis_dim(x, ax)
@@ -492,7 +492,7 @@ def broadcast(x, root_rank: int = 0, name: Optional[str] = None,
     ``horovod/torch/mpi_ops.py:387``)."""
     if in_named_trace(axis):
         return broadcast_p(x, root_rank=root_rank, axis=axis)
-    if runtime.mode() == "process" and runtime.size() > 1:
+    if runtime.mode() == "process":
         return _core_collective("broadcast", x, name or _auto_name("broadcast"),
                                 root_rank=root_rank)
     ax = runtime.dp_axis()
@@ -519,7 +519,7 @@ def alltoall(x, splits=None, name: Optional[str] = None,
                 "uneven splits are only supported on the eager path; pad to "
                 "equal splits for the compiled path")
         return alltoall_p(x, axis=axis)
-    if runtime.mode() == "process" and runtime.size() > 1:
+    if runtime.mode() == "process":
         return _core_collective("alltoall", x, name or _auto_name("alltoall"),
                                 splits=None if splits is None
                                 else np.asarray(splits, np.int32))
@@ -546,7 +546,7 @@ def reducescatter(x, op: ReduceOp = ReduceOp.SUM, name: Optional[str] = None,
     """Reduce-scatter along dim 0 (TPU-first primitive; see ``reducescatter_p``)."""
     if in_named_trace(axis):
         return reducescatter_p(x, op=op, axis=axis)
-    if runtime.mode() == "process" and runtime.size() > 1:
+    if runtime.mode() == "process":
         return _core_collective("reducescatter", x,
                                 name or _auto_name("reducescatter"), op=int(op))
     ax = runtime.dp_axis()
@@ -570,7 +570,7 @@ def join() -> int:
     outstanding collectives). Returns the last rank to join. In SPMD mode there is
     a single controller, so join is trivially rank 0.
     """
-    if runtime.mode() == "process" and runtime.size() > 1:
+    if runtime.mode() == "process":
         core = runtime.core()
         return int(core.join())
     return runtime.rank()

@@ -1,0 +1,31 @@
+"""Mesh-axis introspection helpers shared by the parallel layers and models."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax import lax
+
+
+def axis_size(ax: Optional[str]) -> int:
+    """Size of a named mesh axis inside a shard_map trace; 1 when the axis is
+    absent or unbound (unsharded execution, single-device parity)."""
+    if ax is None:
+        return 1
+    try:
+        return lax.axis_size(ax)
+    except Exception:
+        return 1
+
+
+def axis_bound(ax: Optional[str]) -> bool:
+    """Axis present in the enclosing shard_map trace. Size-1 axes still need
+    their collectives (identity math, but they clear the varying-axes tag that
+    in_specs naming the axis puts on every shard)."""
+    if ax is None:
+        return False
+    try:
+        lax.axis_size(ax)
+        return True
+    except Exception:
+        return False

@@ -27,13 +27,7 @@ import numpy as np
 from jax import lax
 
 
-def _axis_size(ax: Optional[str]) -> int:
-    if ax is None:
-        return 1
-    try:
-        return lax.axis_size(ax)
-    except Exception:
-        return 1
+from .axes import axis_size as _axis_size
 
 
 def switch_moe(x, gate_w, w_up, w_down, axis: Optional[str] = None,

@@ -49,9 +49,22 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
+    p.add_argument("--cache-capacity", type=int, default=1024,
+                   help="response cache capacity; 0 disables "
+                        "(reference: --cache-capacity / "
+                        "HOROVOD_CACHE_CAPACITY)")
     p.add_argument("--autotune", action="store_true",
                    help="enable fusion/cycle autotuning")
     p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--autotune-warmup-samples", type=int, default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    p.add_argument("--autotune-bayes-opt-max-samples", type=int, default=None)
+    p.add_argument("--autotune-gaussian-process-noise", type=float,
+                   default=None)
+    p.add_argument("--config-file", default=None,
+                   help="YAML config file with the same schema as the CLI "
+                        "flags (reference: --config-file, "
+                        "runner/common/util/config_parser.py)")
     # Elastic (reference: launch.py --min-np/--max-np/--host-discovery-script).
     p.add_argument("--min-np", type=int, default=None,
                    help="minimum workers for an elastic job")
@@ -69,11 +82,30 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command, e.g. python train.py")
     args = p.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(args, p)
     if not args.command:
         p.error("no worker command given")
     if args.command[0] == "--":
         args.command = args.command[1:]
     return args
+
+
+def _apply_config_file(args, parser) -> None:
+    """Overlay a YAML config file onto defaulted args: CLI flags win over the
+    file, the file wins over defaults (reference:
+    ``runner/common/util/config_parser.py`` — same precedence)."""
+    import yaml
+    with open(args.config_file) as f:
+        doc = yaml.safe_load(f) or {}
+    defaults = {a.dest: a.default for a in parser._actions}
+    for key, value in doc.items():
+        dest = key.replace("-", "_")
+        if dest not in defaults:
+            parser.error(f"unknown config-file key: {key}")
+        # Only apply when the user left the flag at its default.
+        if getattr(args, dest) == defaults[dest]:
+            setattr(args, dest, value)
 
 
 def _free_port() -> int:
@@ -100,10 +132,23 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_STALL_CHECK_DISABLE] = "1"
     env[ev.HVDTPU_STALL_CHECK_TIME_SECONDS] = str(
         args.stall_check_warning_time_seconds)
+    env[ev.HVDTPU_CACHE_CAPACITY] = str(args.cache_capacity)
     if args.autotune:
         env[ev.HVDTPU_AUTOTUNE] = "1"
         if args.autotune_log_file:
             env[ev.HVDTPU_AUTOTUNE_LOG] = args.autotune_log_file
+        if args.autotune_warmup_samples is not None:
+            env[ev.HVDTPU_AUTOTUNE_WARMUP_SAMPLES] = str(
+                args.autotune_warmup_samples)
+        if args.autotune_steps_per_sample is not None:
+            env[ev.HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE] = str(
+                args.autotune_steps_per_sample)
+        if args.autotune_bayes_opt_max_samples is not None:
+            env[ev.HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES] = str(
+                args.autotune_bayes_opt_max_samples)
+        if args.autotune_gaussian_process_noise is not None:
+            env[ev.HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE] = str(
+                args.autotune_gaussian_process_noise)
     return env
 
 

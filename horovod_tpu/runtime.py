@@ -62,6 +62,8 @@ class _RuntimeState:
     core: Optional[object] = None
     # Monotonic epoch, bumped on shutdown/re-init (elastic resets).
     epoch: int = 0
+    # SPMD-mode timeline: an XLA profiler trace is active.
+    xla_trace_active: bool = False
 
 
 _state = _RuntimeState()
@@ -215,21 +217,22 @@ def init(comm: Optional[Sequence[int]] = None,
                 st.local_size = ev.get_int(ev.HVDTPU_LOCAL_SIZE, 1)
                 st.cross_rank = ev.get_int(ev.HVDTPU_CROSS_RANK, st.rank)
                 st.cross_size = ev.get_int(ev.HVDTPU_CROSS_SIZE, st.size)
-            if st.size > 1:
-                try:
-                    from . import basics
-                except ImportError as e:
-                    raise NotInitializedError(
-                        "process mode (HVDTPU_SIZE > 1) requires the native "
-                        "core binding (horovod_tpu/basics.py + "
-                        "horovod_tpu/native); build it with "
-                        "`make -C horovod_tpu/native`") from e
-                st.core = basics.NativeCore(
-                    rank=st.rank, size=st.size,
-                    local_rank=st.local_rank, local_size=st.local_size,
-                    cross_rank=st.cross_rank, cross_size=st.cross_size,
-                    coord_host=controller[0], coord_port=controller[1])
-                st.core.start()
+            # The native core runs at every world size — a single-rank job
+            # still gets the background loop, timeline, and identical op
+            # semantics (the reference behaves the same at np=1).
+            try:
+                from . import basics
+            except ImportError as e:
+                raise NotInitializedError(
+                    "process mode requires the native core binding "
+                    "(horovod_tpu/basics.py + horovod_tpu/native); build "
+                    "it with `make -C horovod_tpu/native`") from e
+            st.core = basics.NativeCore(
+                rank=st.rank, size=st.size,
+                local_rank=st.local_rank, local_size=st.local_size,
+                cross_rank=st.cross_rank, cross_size=st.cross_size,
+                coord_host=controller[0], coord_port=controller[1])
+            st.core.start()
             log.debug("init: process mode rank=%d size=%d local=%d/%d",
                       st.rank, st.size, st.local_rank, st.local_size)
         else:
@@ -348,3 +351,34 @@ def core():
 
 def epoch() -> int:
     return _state.epoch
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start writing the collective-op timeline (Chrome-trace JSON) at runtime.
+
+    Reference: ``hvd.start_timeline`` → ``horovod_start_timeline``
+    (operations.cc:735-777). Process mode records negotiation/queue/op phases
+    from the native background loop. In SPMD mode the collectives are compiled
+    into XLA programs, so there is no per-op host timeline — use
+    :func:`jax.profiler.start_trace` (the XLA/TPU profiler) instead; this
+    function starts one rooted at ``file_path`` + ``.xplane`` for parity.
+    """
+    st = _require_init()
+    if st.core is not None:
+        st.core.start_timeline(file_path, mark_cycles)
+    else:
+        import jax.profiler
+        jax.profiler.start_trace(file_path + ".xplane")
+        st.xla_trace_active = True
+
+
+def stop_timeline() -> None:
+    """Stop a timeline started by :func:`start_timeline` (reference:
+    ``horovod_stop_timeline``, operations.cc:780-790)."""
+    st = _require_init()
+    if st.core is not None:
+        st.core.stop_timeline()
+    elif getattr(st, "xla_trace_active", False):
+        import jax.profiler
+        jax.profiler.stop_trace()
+        st.xla_trace_active = False

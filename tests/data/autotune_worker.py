@@ -1,0 +1,41 @@
+"""Worker: autotune smoke — the Bayesian parameter manager must explore
+(parameters move off their defaults), log samples, and never break
+correctness (reference: ParameterManager driven from the background loop,
+operations.cc:615-643)."""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+from horovod_tpu import runtime
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+default_cycle = 1.0
+steps = int(os.environ.get("TEST_STEPS", "120"))
+for it in range(steps):
+    for k in range(4):
+        x = np.full((256,), float(r + it), np.float32)
+        out = np.asarray(hvd.allreduce(x, name=f"p{k}", op=hvd.Sum))
+        np.testing.assert_allclose(out, sum(range(n)) + n * it, rtol=1e-6)
+
+core = runtime.core()
+if r == 0 and core is not None:
+    cycle = core.cycle_time_ms()
+    fusion = core.fusion_threshold()
+    # After warmup + several samples the tuner must have moved the params at
+    # least once (the GP proposal is continuous; hitting the exact defaults
+    # again is essentially impossible).
+    assert cycle != default_cycle or fusion != 64 * 1024 * 1024, \
+        (cycle, fusion)
+    log_path = os.environ.get("HVDTPU_AUTOTUNE_LOG")
+    if log_path:
+        with open(log_path) as f:
+            lines = f.read().strip().splitlines()
+        assert len(lines) >= 2, lines  # header + >=1 scored sample
+        assert lines[0].startswith("cycle_time_ms,"), lines[0]
+
+hvd.shutdown()
+print("ALL OK")

@@ -1,0 +1,36 @@
+"""Worker: repeated steady-state collectives to exercise the response cache
+(reference test analog: cached-response iterations in test_torch.py fused
+tests; native: RequestCache in core.cpp)."""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+steps = int(os.environ.get("TEST_STEPS", "30"))
+
+# Same tensor names every iteration -> cache hits after iteration 1. More
+# names than a tiny HVDTPU_CACHE_CAPACITY would hold exercises eviction and
+# the NEED_FULL repair path.
+for it in range(steps):
+    for k in range(6):
+        x = np.full((16,), float(r + it + k), np.float32)
+        out = np.asarray(hvd.allreduce(x, name=f"grad_{k}", op=hvd.Sum))
+        expect = np.full((16,), sum(range(n)) + n * (it + k))
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+    # An allgather with per-rank first dims, cached too.
+    g = np.full((r + 1, 2), float(it), np.float32)
+    out = np.asarray(hvd.allgather(g, name="gath"))
+    assert out.shape == (sum(range(1, n + 1)), 2), out.shape
+    np.testing.assert_allclose(out, float(it))
+
+# Changing the shape of a cached name must invalidate, not corrupt.
+x = np.full((8, 2), float(r), np.float32)
+out = np.asarray(hvd.allreduce(x, name="grad_0", op=hvd.Sum))
+np.testing.assert_allclose(out, np.full((8, 2), float(sum(range(n)))))
+
+hvd.shutdown()
+print("ALL OK")

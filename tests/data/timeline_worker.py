@@ -1,0 +1,42 @@
+"""Worker: runtime start_timeline/stop_timeline (reference:
+horovod_start_timeline/horovod_stop_timeline, operations.cc:735-790)."""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# Phase 1: no timeline yet.
+for it in range(3):
+    hvd.allreduce(np.ones((4,), np.float32), name="warm", op=hvd.Sum)
+
+path = os.environ["TEST_TIMELINE_PATH"] + f".{r}.json"
+hvd.start_timeline(path, mark_cycles=True)
+for it in range(5):
+    out = np.asarray(hvd.allreduce(np.full((4,), float(r), np.float32),
+                                   name="traced", op=hvd.Sum))
+    np.testing.assert_allclose(out, float(sum(range(n))))
+hvd.stop_timeline()
+# The stop request is applied by the background loop at its next cycle;
+# give it a moment so the "after" ops can't race into the trace.
+import time
+time.sleep(0.3)
+
+# Phase 3: ops after stop still work and are not recorded.
+for it in range(3):
+    hvd.allreduce(np.ones((4,), np.float32), name="after", op=hvd.Sum)
+
+import json
+events = json.load(open(path))
+names = {e.get("pid") for e in events}
+assert "traced" in names, names
+assert "after" not in names, names
+cats = {e.get("name") for e in events}
+assert "ALLREDUCE" in cats, cats
+
+hvd.shutdown()
+print("ALL OK")
