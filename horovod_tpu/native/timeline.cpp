@@ -5,6 +5,7 @@ namespace hvdtpu {
 Timeline::~Timeline() { Shutdown(); }
 
 void Timeline::Initialize(const std::string& path, int rank) {
+  std::lock_guard<std::mutex> st(state_mu_);
   if (initialized_ || path.empty()) return;
   file_ = fopen(path.c_str(), "w");
   if (file_ == nullptr) return;
@@ -23,17 +24,23 @@ void Timeline::Initialize(const std::string& path, int rank) {
 }
 
 void Timeline::Shutdown() {
-  if (!initialized_) return;
+  {
+    // Flip the flag under state_mu_: Emit holds state_mu_ for its whole
+    // body, so after this block no emitter can be touching timeline state.
+    std::lock_guard<std::mutex> st(state_mu_);
+    if (!initialized_) return;
+    initialized_ = false;
+  }
   {
     std::lock_guard<std::mutex> lk(mu_);
     stop_ = true;
   }
   cv_.notify_all();
   if (writer_.joinable()) writer_.join();
+  std::lock_guard<std::mutex> st(state_mu_);
   fputs("\n]\n", file_);
   fclose(file_);
   file_ = nullptr;
-  initialized_ = false;
 }
 
 int64_t Timeline::NowUs() const {
@@ -64,6 +71,9 @@ std::string JsonEscape(const std::string& s) {
 
 void Timeline::Emit(const std::string& name, char ph,
                     const std::string& args_json, const std::string& cat) {
+  // Hold state_mu_ across check + timestamp so a concurrent runtime
+  // Shutdown/Initialize (background thread) can't mutate start_ mid-read.
+  std::lock_guard<std::mutex> st(state_mu_);
   if (!initialized_) return;
   // One row ("pid") per tensor name, one thread row per rank — mirrors the
   // reference's tensor-as-process layout (timeline.cc:254-276). Built with
@@ -125,6 +135,8 @@ void Timeline::OpDone(const std::string& name, const std::string& result) {
 }
 
 void Timeline::MarkCycle() {
+  std::lock_guard<std::mutex> st(state_mu_);
+  if (!initialized_) return;
   char buf[160];
   snprintf(buf, sizeof(buf),
            "{\"name\": \"CYCLE %d\", \"ph\": \"i\", \"ts\": %lld, "

@@ -46,6 +46,10 @@ class Timeline {
   void WriterLoop();
   int64_t NowUs() const;
 
+  // Lifecycle state (initialized_/file_/start_/rank_) can be mutated by the
+  // background thread (runtime start/stop requests) while user threads Emit
+  // from EnqueueOp — state_mu_ guards it. Lock order: state_mu_ before mu_.
+  std::mutex state_mu_;
   std::atomic<bool> initialized_{false};
   int rank_ = 0;
   FILE* file_ = nullptr;

@@ -81,9 +81,14 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="worker command, e.g. python train.py")
+    # Config-file precedence (reference: runner/common/util/config_parser.py):
+    # CLI flags beat the file, the file beats built-in defaults. Achieved by
+    # installing the file's values as parser defaults BEFORE the real parse,
+    # so an explicitly-passed flag always wins — even at its default value.
+    pre, _ = p.parse_known_args(argv)
+    if pre.config_file:
+        _install_config_file_defaults(pre.config_file, p)
     args = p.parse_args(argv)
-    if args.config_file:
-        _apply_config_file(args, p)
     if not args.command:
         p.error("no worker command given")
     if args.command[0] == "--":
@@ -91,21 +96,18 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     return args
 
 
-def _apply_config_file(args, parser) -> None:
-    """Overlay a YAML config file onto defaulted args: CLI flags win over the
-    file, the file wins over defaults (reference:
-    ``runner/common/util/config_parser.py`` — same precedence)."""
+def _install_config_file_defaults(path: str, parser) -> None:
     import yaml
-    with open(args.config_file) as f:
+    with open(path) as f:
         doc = yaml.safe_load(f) or {}
-    defaults = {a.dest: a.default for a in parser._actions}
+    known = {a.dest for a in parser._actions}
+    overlay = {}
     for key, value in doc.items():
         dest = key.replace("-", "_")
-        if dest not in defaults:
+        if dest not in known:
             parser.error(f"unknown config-file key: {key}")
-        # Only apply when the user left the flag at its default.
-        if getattr(args, dest) == defaults[dest]:
-            setattr(args, dest, value)
+        overlay[dest] = value
+    parser.set_defaults(**overlay)
 
 
 def _free_port() -> int:

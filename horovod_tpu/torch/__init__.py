@@ -1,0 +1,304 @@
+"""``horovod_tpu.torch`` — PyTorch interop surface.
+
+The reference's flagship binding is ``import horovod.torch as hvd``
+(``horovod/torch/__init__.py``, ``mpi_ops.py``, ``optimizer.py``,
+``functions.py``): named-tensor collectives on ``torch.Tensor`` with async
+handles, autograd support, and a ``DistributedOptimizer`` that hooks gradient
+accumulation. This module provides the same surface on top of the TPU-native
+runtime: torch tensors bridge to the eager collective path (the native TCP
+controller in process mode), so a Horovod/PyTorch user can switch imports and
+keep their training script.
+
+Collectives here are host-side (torch CPU tensors through the native data
+plane) — the TPU compute path is the JAX surface; this module exists for
+capability parity and for torch-based data/preprocessing pipelines that need
+the same collective semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Tuple
+
+import numpy as np
+import torch
+
+from .. import functions as _functions
+from .. import runtime as _runtime
+from ..ops import collectives as _C
+from ..ops.collectives import ReduceOp, Average, Sum, Adasum, Min, Max, Product
+
+# Lifecycle / topology (reference: horovod/torch/__init__.py re-exports).
+from ..runtime import (init, shutdown, is_initialized, rank, size, local_rank,
+                       local_size, cross_rank, cross_size, is_homogeneous,
+                       start_timeline, stop_timeline)
+from .optimizer import DistributedOptimizer
+from .compression import Compression
+from . import elastic
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size", "is_homogeneous",
+    "start_timeline", "stop_timeline",
+    "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+    "join", "poll", "synchronize",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object", "DistributedOptimizer", "Compression",
+]
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    return t.detach().cpu().contiguous().numpy()
+
+
+def _to_torch(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    # Copy: jax outputs arrive as read-only numpy views, which torch cannot
+    # safely wrap in a writable tensor.
+    a = np.ascontiguousarray(a)
+    if not a.flags.writeable:
+        a = a.copy()
+    return torch.from_numpy(a).to(like.device)
+
+
+# ---------------------------------------------------------------------------
+# Async handles (reference: horovod/torch/mpi_ops.py handle_manager pattern)
+# ---------------------------------------------------------------------------
+
+_handles: dict = {}
+_next_handle = [0]
+
+
+def _new_handle(entry) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = entry
+    return h
+
+
+def poll(handle: int) -> bool:
+    """True when the async op behind ``handle`` has completed
+    (reference: ``hvd.poll``, torch/mpi_ops.py:594)."""
+    entry = _handles[handle]
+    return entry.poll()
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until the async op completes; returns its output tensor
+    (reference: ``hvd.synchronize``, torch/mpi_ops.py:610)."""
+    entry = _handles.pop(handle)
+    return entry.wait()
+
+
+class _Pending:
+    """A pending torch collective: wraps the JAX-surface handle plumbing."""
+
+    def __init__(self, base_handle: int, finish):
+        self._base = base_handle
+        self._finish = finish
+
+    def poll(self) -> bool:
+        return _C.poll(self._base)
+
+    def wait(self) -> torch.Tensor:
+        out = _C.synchronize(self._base)
+        return self._finish(np.asarray(out))
+
+
+def _async_op(kind: str, tensor: torch.Tensor, name: Optional[str],
+              finish, **kw) -> int:
+    arr = _to_numpy(tensor)
+    base = {
+        "allreduce": _C.allreduce_async,
+        "allgather": _C.allgather_async,
+        "broadcast": _C.broadcast_async,
+        "alltoall": _C.alltoall_async,
+    }[kind](arr, name=name, **kw)
+    return _new_handle(_Pending(base, finish))
+
+
+# ---------------------------------------------------------------------------
+# Collectives (reference: horovod/torch/mpi_ops.py)
+# ---------------------------------------------------------------------------
+
+class _AllreduceGrad(torch.autograd.Function):
+    """Differentiable allreduce: grad of an allreduce is an allreduce
+    (reference: class HorovodAllreduce, torch/mpi_ops.py:165)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name, op, prescale, postscale):
+        ctx.op = op
+        ctx.prescale = prescale
+        ctx.postscale = postscale
+        out = _C.allreduce(_to_numpy(tensor), name=name, op=op,
+                           prescale_factor=prescale,
+                           postscale_factor=postscale)
+        return _to_torch(np.asarray(out), tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        out = _C.allreduce(_to_numpy(grad), op=ctx.op,
+                           prescale_factor=ctx.prescale,
+                           postscale_factor=ctx.postscale)
+        return _to_torch(np.asarray(out), grad), None, None, None, None
+
+
+def allreduce(tensor: torch.Tensor, name: Optional[str] = None,
+              op: ReduceOp = Average, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              compression=None) -> torch.Tensor:
+    """Reference: ``hvd.allreduce`` (torch/mpi_ops.py:225 via :87);
+    differentiable."""
+    if compression is not None:
+        compressed, ctx = compression.compress(tensor)
+        reduced = allreduce(compressed, name=name, op=op,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+        return compression.decompress(reduced, ctx)
+    if tensor.requires_grad:
+        return _AllreduceGrad.apply(tensor, name, op, prescale_factor,
+                                    postscale_factor)
+    out = _C.allreduce(_to_numpy(tensor), name=name, op=op,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor)
+    return _to_torch(np.asarray(out), tensor)
+
+
+def allreduce_(tensor: torch.Tensor, name: Optional[str] = None,
+               op: ReduceOp = Average) -> torch.Tensor:
+    """In-place allreduce (reference: ``hvd.allreduce_``,
+    torch/mpi_ops.py:257)."""
+    out = _C.allreduce(_to_numpy(tensor), name=name, op=op)
+    tensor.copy_(_to_torch(np.asarray(out), tensor))
+    return tensor
+
+
+def allreduce_async(tensor: torch.Tensor, name: Optional[str] = None,
+                    op: ReduceOp = Average) -> int:
+    """Reference: ``hvd.allreduce_async`` (torch/mpi_ops.py:132)."""
+    like = tensor
+    return _async_op("allreduce", tensor, name,
+                     lambda a: _to_torch(a.reshape(like.shape), like), op=op)
+
+
+def allreduce_async_(tensor: torch.Tensor, name: Optional[str] = None,
+                     op: ReduceOp = Average) -> int:
+    """In-place async allreduce (reference: torch/mpi_ops.py:225)."""
+    def finish(a):
+        tensor.copy_(_to_torch(a.reshape(tensor.shape), tensor))
+        return tensor
+    return _async_op("allreduce", tensor, name, finish, op=op)
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Concatenate along dim 0 across ranks; ranks may differ in dim 0
+    (reference: ``hvd.allgather``, torch/mpi_ops.py:304)."""
+    out = _C.allgather(_to_numpy(tensor), name=name)
+    return _to_torch(np.asarray(out), tensor)
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    like = tensor
+    row = tuple(tensor.shape[1:])
+    def finish(a):
+        return _to_torch(a.reshape((-1,) + row), like)
+    return _async_op("allgather", tensor, name, finish)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Reference: ``hvd.broadcast`` (torch/mpi_ops.py:387)."""
+    out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    return _to_torch(np.asarray(out), tensor)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+    tensor.copy_(_to_torch(np.asarray(out).reshape(tensor.shape), tensor))
+    return tensor
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    like = tensor
+    return _async_op("broadcast", tensor, name,
+                     lambda a: _to_torch(a.reshape(like.shape), like),
+                     root_rank=root_rank)
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    def finish(a):
+        tensor.copy_(_to_torch(a.reshape(tensor.shape), tensor))
+        return tensor
+    return _async_op("broadcast", tensor, name, finish, root_rank=root_rank)
+
+
+def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
+             name: Optional[str] = None) -> torch.Tensor:
+    """Reference: ``hvd.alltoall`` (torch/mpi_ops.py:517) with optional
+    uneven splits."""
+    sp = None if splits is None else _to_numpy(splits).astype(np.int32)
+    out = _C.alltoall(_to_numpy(tensor), splits=sp, name=name)
+    return _to_torch(np.asarray(out), tensor)
+
+
+def alltoall_async(tensor: torch.Tensor,
+                   splits: Optional[torch.Tensor] = None,
+                   name: Optional[str] = None) -> int:
+    like = tensor
+    row = tuple(tensor.shape[1:])
+    sp = None if splits is None else _to_numpy(splits).astype(np.int32)
+    def finish(a):
+        return _to_torch(a.reshape((-1,) + row), like)
+    return _async_op("alltoall", tensor, name, finish, splits=sp)
+
+
+def join() -> int:
+    """Reference: ``hvd.join`` (torch/mpi_ops.py:633)."""
+    return _C.join()
+
+
+# ---------------------------------------------------------------------------
+# Parameter/object broadcast helpers (reference: horovod/torch/functions.py)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a module's ``state_dict()`` or ``named_parameters``
+    (reference: ``broadcast_parameters``, torch/functions.py:30)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = sorted(dict(params).items())
+    for name, p in items:
+        if p is None:
+            continue
+        if not torch.is_tensor(p):
+            continue
+        broadcast_(p.data if hasattr(p, "data") else p, root_rank,
+                   name=f"broadcast.param.{name}")
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state dict from ``root_rank``
+    (reference: ``broadcast_optimizer_state``, torch/functions.py:62)."""
+    state = optimizer.state_dict()
+    state = broadcast_object(state, root_rank=root_rank,
+                             name="broadcast.optimizer_state")
+    if rank() != root_rank:
+        optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj: Any = None, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Reference: ``broadcast_object`` (torch/functions.py:186)."""
+    return _functions.broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> list:
+    """Reference: ``allgather_object`` (torch/functions.py:229)."""
+    return _functions.allgather_object(obj, name=name)

@@ -1,0 +1,41 @@
+"""Gradient compression for the torch surface.
+
+Reference: ``horovod/torch/compression.py`` — ``Compression.none`` /
+``Compression.fp16``, applied around the wire allreduce by
+``DistributedOptimizer``.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class NoneCompressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    """Cast to fp16 for the wire, back to the original dtype after
+    (reference: FP16Compressor, torch/compression.py)."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype in (torch.float32, torch.float64):
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor if ctx is None else tensor.to(ctx)
+
+
+class Compression:
+    """Reference surface: ``hvd.Compression.none`` / ``.fp16``."""
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -1,0 +1,71 @@
+"""Elastic state for torch modules/optimizers.
+
+Reference: ``horovod/torch/elastic.py`` — ``TorchState`` (:51) captures
+``model.state_dict()`` / ``optimizer.state_dict()`` plus arbitrary python
+attributes, with commit/restore/sync semantics driven by
+``hvd.elastic.run`` (``horovod/common/elastic.py:147``).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+import torch
+
+from ..elastic.state import ObjectState, run, run_fn  # noqa: F401
+
+
+class TorchState(ObjectState):
+    """Elastic state that snapshots torch modules and optimizers by value.
+
+    Usage (reference parity)::
+
+        state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            ...
+            state.commit()
+    """
+
+    def __init__(self, model: torch.nn.Module = None,
+                 optimizer: torch.optim.Optimizer = None, **kwargs):
+        self._saved = {}
+        self.model = model
+        self.optimizer = optimizer
+        super().__init__(**kwargs)
+        self.save()
+
+    # -- State hooks -------------------------------------------------------
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._saved["model"] = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._saved["optimizer"] = copy.deepcopy(
+                self.optimizer.state_dict())
+        super().save()
+
+    def restore(self) -> None:
+        if self.model is not None and "model" in self._saved:
+            self.model.load_state_dict(copy.deepcopy(self._saved["model"]))
+        if self.optimizer is not None and "optimizer" in self._saved:
+            self.optimizer.load_state_dict(
+                copy.deepcopy(self._saved["optimizer"]))
+        super().restore()
+
+    def sync(self) -> None:
+        """Broadcast rank 0's model/optimizer state to all ranks (reference:
+        TorchState.sync → broadcast_parameters/broadcast_optimizer_state)."""
+        from . import broadcast_object, broadcast_parameters, rank
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            state = broadcast_object(self.optimizer.state_dict(),
+                                     root_rank=0,
+                                     name="elastic.torch.optimizer")
+            if rank() != 0:
+                self.optimizer.load_state_dict(state)
+        super().sync()
+        self.save()

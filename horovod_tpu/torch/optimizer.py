@@ -1,0 +1,156 @@
+"""Distributed optimizer for torch modules.
+
+Reference: ``horovod/torch/optimizer.py`` — ``_DistributedOptimizer`` (:32)
+dynamically subclasses the wrapped optimizer's class, registers per-parameter
+gradient-accumulation hooks (:104-150) that launch async allreduces, supports
+``backward_passes_per_step`` local accumulation, and ``synchronize()`` (:152)
+waits for the reduced gradients before ``step()`` (:190).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import torch
+
+from .compression import Compression
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op=None):
+        super(self.__class__, self).__init__(params)
+        from . import Average, allreduce_async, synchronize as _sync, size
+
+        self._hvd = {
+            "allreduce_async": allreduce_async,
+            "synchronize": _sync,
+            "size": size,
+        }
+        self._compression = compression
+        self._op = op if op is not None else Average
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+            self._param_names = {id(p): name for name, p in named}
+        else:
+            self._param_names = {}
+            for gi, group in enumerate(self.param_groups):
+                for pi, p in enumerate(group["params"]):
+                    # Reference naming: allreduce.noname.<group>.<index>
+                    self._param_names[id(p)] = f"allreduce.noname.{gi}.{pi}"
+
+        self._handles = {}           # param -> (handle, ctx)
+        self._allreduce_delay = {}   # param -> remaining backward passes
+        self._synchronized = False
+        self._should_synchronize = True
+        self._register_hooks()
+
+    # -- hooks -------------------------------------------------------------
+
+    def _register_hooks(self) -> None:
+        for group in self.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._allreduce_delay[p] = self.backward_passes_per_step
+                if hasattr(p, "register_post_accumulate_grad_hook"):
+                    p.register_post_accumulate_grad_hook(self._make_hook(p))
+                else:
+                    # Reference trick: hook the accumulation node
+                    # (optimizer.py:104-113).
+                    p_tmp = p.expand_as(p)
+                    grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                    grad_acc.register_hook(
+                        self._wrap_legacy_hook(self._make_hook(p), p))
+                    p._hvdtpu_grad_acc = grad_acc  # keep alive
+
+    def _wrap_legacy_hook(self, hook, p):
+        def _legacy(*args):
+            hook(p)
+        return _legacy
+
+    def _make_hook(self, p):
+        def hook(param):
+            if param in self._handles:
+                raise AssertionError(
+                    "gradient for this parameter was already reduced; call "
+                    "optimizer.step() or synchronize() between backward "
+                    "passes, or raise backward_passes_per_step")
+            self._allreduce_delay[param] -= 1
+            if self._allreduce_delay[param] == 0:
+                self._handles[param] = self._allreduce_grad_async(param)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._param_names.get(id(p), f"allreduce.noname.{id(p)}")
+        # Out-of-place: the compressed tensor may have a different dtype than
+        # the parameter, and torch >= 2.x refuses a grad whose dtype diverges
+        # from the param's — decompression back into p.grad happens in
+        # synchronize().
+        compressed, ctx = self._compression.compress(p.grad)
+        handle = self._hvd["allreduce_async"](compressed, name=name,
+                                              op=self._op)
+        return handle, ctx
+
+    # -- synchronization ---------------------------------------------------
+
+    def synchronize(self) -> None:
+        """Wait for all outstanding gradient allreduces and install the
+        reduced gradients (reference: optimizer.py:152-188)."""
+        # Parameters whose hooks never fired this step (e.g. unused in the
+        # graph) keep their local grad — matching the reference, which only
+        # reduces hooked grads on synchronize (missing_p handling, :158-166).
+        for p, (handle, ctx) in list(self._handles.items()):
+            output = self._hvd["synchronize"](handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            p.grad.copy_(self._compression.decompress(output, ctx))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self) -> Iterator[None]:
+        """Reference: ``optimizer.skip_synchronize()`` (optimizer.py:196) —
+        use when ``synchronize()`` was called manually before ``step()``."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+                warnings.warn(
+                    "optimizer.step() called without a new backward pass "
+                    "after synchronize(); use skip_synchronize() to avoid a "
+                    "redundant synchronization")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad() with pending gradient allreduces: call "
+                "synchronize() or step() first")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=None) -> torch.optim.Optimizer:
+    """Wrap a torch optimizer so gradients are averaged across ranks during
+    ``backward()`` (reference factory: optimizer.py:383 — same dynamic
+    subclassing so ``isinstance(opt, type(inner))`` keeps working)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op)

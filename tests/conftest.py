@@ -37,6 +37,47 @@ def subprocess_env() -> dict:
     return env
 
 
+def free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_world(n: int, script: str, extra_env=None, timeout=180):
+    """Spawn an n-rank process-mode world running ``script``; returns
+    [(returncode, stdout, stderr)] per rank (SURVEY.md §4: multi-node tested
+    as multi-process on localhost)."""
+    import subprocess
+    import sys
+    port = free_port()
+    procs = []
+    for r in range(n):
+        env = subprocess_env()
+        env.update({
+            "HVDTPU_RANK": str(r), "HVDTPU_SIZE": str(n),
+            "HVDTPU_LOCAL_RANK": str(r), "HVDTPU_LOCAL_SIZE": str(n),
+            "HVDTPU_CONTROLLER_PORT": str(port),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen([sys.executable, script], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        results.append((p.returncode, out, err))
+    return results
+
+
+def assert_all_ok(results):
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
 @pytest.fixture
 def spmd8():
     """Initialized SPMD runtime over the 8-device CPU mesh."""

@@ -8,51 +8,13 @@ localhost TCP.
 """
 
 import os
-import subprocess
-import sys
 
 import pytest
 
-from conftest import subprocess_env as _subprocess_env
+from conftest import assert_all_ok, launch_world
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DATA = os.path.join(REPO, "tests", "data")
-
-
-def _free_port() -> int:
-    import socket
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _launch_world(n: int, script: str, extra_env=None, timeout=180):
-    port = _free_port()
-    procs = []
-    for r in range(n):
-        env = _subprocess_env()
-        env.update({
-            "HVDTPU_RANK": str(r), "HVDTPU_SIZE": str(n),
-            "HVDTPU_LOCAL_RANK": str(r), "HVDTPU_LOCAL_SIZE": str(n),
-            "HVDTPU_CONTROLLER_PORT": str(port),
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen([sys.executable, script],
-                                      env=env, stdout=subprocess.PIPE,
-                                      stderr=subprocess.PIPE, text=True))
-    results = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
-        results.append((p.returncode, out, err))
-    return results
-
-
-def _assert_all_ok(results):
-    for r, (rc, out, err) in enumerate(results):
-        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
-        assert "ALL OK" in out
 
 
 @pytest.mark.parametrize("capacity", ["1024", "3", "0"])
@@ -60,21 +22,21 @@ def test_response_cache(capacity):
     """Steady-state repeat collectives stay correct with the cache at default
     capacity, at a tiny capacity (forcing evictions and the NEED_FULL repair
     round trip), and disabled."""
-    results = _launch_world(2, os.path.join(DATA, "cache_worker.py"),
-                            extra_env={"HVDTPU_CACHE_CAPACITY": capacity})
-    _assert_all_ok(results)
+    results = launch_world(2, os.path.join(DATA, "cache_worker.py"),
+                           extra_env={"HVDTPU_CACHE_CAPACITY": capacity})
+    assert_all_ok(results)
 
 
 def test_response_cache_world_4():
-    results = _launch_world(4, os.path.join(DATA, "cache_worker.py"))
-    _assert_all_ok(results)
+    results = launch_world(4, os.path.join(DATA, "cache_worker.py"))
+    assert_all_ok(results)
 
 
 def test_autotune(tmp_path):
     """The parameter manager explores (params move off defaults), logs scored
     samples, and collectives stay correct throughout."""
     log = tmp_path / "autotune.csv"
-    results = _launch_world(
+    results = launch_world(
         2, os.path.join(DATA, "autotune_worker.py"),
         extra_env={
             "HVDTPU_AUTOTUNE": "1",
@@ -84,12 +46,12 @@ def test_autotune(tmp_path):
             "HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE": "4",
             "HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "6",
         })
-    _assert_all_ok(results)
+    assert_all_ok(results)
 
 
 def test_runtime_timeline(tmp_path):
     """start_timeline/stop_timeline bracket exactly the traced phase."""
-    results = _launch_world(
+    results = launch_world(
         2, os.path.join(DATA, "timeline_worker.py"),
         extra_env={"TEST_TIMELINE_PATH": str(tmp_path / "tl")})
-    _assert_all_ok(results)
+    assert_all_ok(results)

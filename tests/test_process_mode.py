@@ -18,37 +18,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "data", "proc_worker.py")
 
 
-def _free_port() -> int:
-    import socket
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 from conftest import subprocess_env as _subprocess_env  # noqa: E402
-
-
-def _launch_world(n: int, script: str, extra_env=None, timeout=120):
-    port = _free_port()
-    procs = []
-    for r in range(n):
-        env = _subprocess_env()
-        env.update({
-            "HVDTPU_RANK": str(r), "HVDTPU_SIZE": str(n),
-            "HVDTPU_LOCAL_RANK": str(r), "HVDTPU_LOCAL_SIZE": str(n),
-            "HVDTPU_CONTROLLER_PORT": str(port),
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen([sys.executable, script],
-                                      env=env, stdout=subprocess.PIPE,
-                                      stderr=subprocess.PIPE, text=True))
-    results = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
-        results.append((p.returncode, out, err))
-    return results
+from conftest import free_port as _free_port  # noqa: E402
+from conftest import launch_world as _launch_world  # noqa: E402
 
 
 @pytest.mark.parametrize("n", [2, 4])

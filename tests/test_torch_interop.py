@@ -1,0 +1,84 @@
+"""Torch interop surface tests (reference: test/test_torch.py shapes).
+
+Multi-process over localhost TCP per SURVEY.md §4, plus single-process
+behavioral checks that don't need a world.
+"""
+
+import os
+
+import pytest
+
+from conftest import assert_all_ok, launch_world
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "data", "torch_worker.py")
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_torch_surface_multiprocess(n):
+    assert_all_ok(launch_world(n, WORKER, timeout=240))
+
+
+class TestSingleProcess:
+    """SPMD-mode semantics on torch tensors (size == device count; eager ops
+    follow the documented replicated-input semantics)."""
+
+    def test_allreduce_and_grad(self, spmd8):
+        import torch
+        import horovod_tpu.torch as hvd
+        n = hvd.size()
+        t = torch.ones(4, requires_grad=True)
+        out = hvd.allreduce(t, op=hvd.Sum)
+        assert torch.allclose(out.detach(), torch.full((4,), float(n)))
+        out.sum().backward()
+        assert torch.allclose(t.grad, torch.full((4,), float(n)))
+
+    def test_optimizer_trains(self, spmd8):
+        import numpy as np
+        import torch
+        import horovod_tpu.torch as hvd
+        torch.manual_seed(0)
+        model = torch.nn.Linear(8, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.Adam(model.parameters(), lr=5e-2),
+            named_parameters=model.named_parameters())
+        rng = np.random.RandomState(0)
+        X = torch.tensor(rng.randn(32, 8), dtype=torch.float32)
+        Y = X.sum(dim=1, keepdim=True)
+        losses = []
+        for _ in range(120):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X), Y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+    def test_torch_state_commit_restore(self, spmd8):
+        """TorchState captures and restores model/optimizer by value
+        (reference: test_elastic_torch.py state semantics)."""
+        import torch
+        import horovod_tpu.torch as hvd
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = hvd.elastic.TorchState(model=model, optimizer=opt, batch=7)
+        before = {k: v.clone() for k, v in model.state_dict().items()}
+        state.commit()
+        with torch.no_grad():
+            for p in model.parameters():
+                p.add_(1.0)
+        state.batch = 99
+        state.restore()
+        for k, v in model.state_dict().items():
+            assert torch.equal(v, before[k]), k
+        assert state.batch == 7
+
+    def test_compression_fp16_roundtrip(self):
+        import torch
+        from horovod_tpu.torch.compression import Compression
+        t = torch.randn(16, dtype=torch.float32)
+        c, ctx = Compression.fp16.compress(t)
+        assert c.dtype == torch.float16
+        out = Compression.fp16.decompress(c, ctx)
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, t, atol=1e-2)
