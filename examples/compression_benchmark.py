@@ -1,0 +1,59 @@
+"""Gradient-compression A/B benchmark.
+
+Reference: the IST-DASLab fork's knobs (``HOROVOD_COMPRESSION`` /
+``HOROVOD_REDUCTION`` / ``HOROVOD_QUANTIZATION_BITS``, common.h:96-108) and
+``HOROVOD_NCCL_FAKE_COMPRESSION`` A/B testing. Compares dense vs quantized
+allreduce on a synthetic gradient, reporting error and (per-shard) bytes.
+
+    python examples/compression_benchmark.py --bits 4 --size 1048576
+"""
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.compression import (MaxMinQuantizer, NormalizedQuantizer,
+                                     TopKCompressor, compressed_allreduce)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=1 << 20)
+    parser.add_argument("--bits", type=int, default=4)
+    parser.add_argument("--bucket-size", type=int, default=512)
+    parser.add_argument("--topk-ratio", type=float, default=0.05)
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+    rng = np.random.RandomState(hvd.rank())
+    grad = rng.randn(args.size).astype(np.float32)
+
+    dense = np.asarray(hvd.allreduce(grad, name="dense", op=hvd.Average))
+
+    schemes = {
+        "maxmin": MaxMinQuantizer(bits=args.bits,
+                                  bucket_size=args.bucket_size),
+        "uniform": NormalizedQuantizer(bits=args.bits,
+                                       bucket_size=args.bucket_size),
+        "topk": TopKCompressor(ratio=args.topk_ratio),
+    }
+    if hvd.rank() == 0:
+        print(f"{'scheme':>10} {'rel_err':>10} {'time_ms':>9}")
+    for name, comp in schemes.items():
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            out = compressed_allreduce(grad, compressor=comp)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters * 1e3
+        err = np.linalg.norm(np.asarray(out) - dense) / np.linalg.norm(dense)
+        if hvd.rank() == 0:
+            print(f"{name:>10} {err:10.4f} {dt:9.2f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

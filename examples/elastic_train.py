@@ -1,0 +1,74 @@
+"""Elastic fault-tolerant training (reference: examples/elastic/pytorch_mnist_elastic.py).
+
+Run with dynamic host discovery:
+
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/elastic_train.py
+
+On membership change or worker failure, the runtime rolls back to the last
+``state.commit()`` and re-rendezvouses (reference: hvd.elastic.run,
+horovod/common/elastic.py:147).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+
+
+def main():
+    hvd.init()
+    model = MLP(features=(64, 10))
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 20).astype(np.float32)
+    y = np.argmax(x @ rng.randn(20, 10).astype(np.float32), axis=1)
+
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(p, s, xb, yb):
+        def loss_fn(q):
+            logits = model.apply(q, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
+                                 epoch=0, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        bs = 128
+        while state.epoch < 5:
+            for i in range(state.batch * bs, len(x) - bs + 1, bs):
+                shard = bs // hvd.size()
+                lo = i + hvd.rank() * shard
+                p, s, loss = train_step(state.params, state.opt_state,
+                                        jnp.asarray(x[lo:lo + shard]),
+                                        jnp.asarray(y[lo:lo + shard]))
+                grads_synced = hvd.allreduce(loss, op=hvd.Average)
+                state.params, state.opt_state = p, s
+                state.batch += 1
+                if state.batch % 4 == 0:
+                    state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {float(grads_synced):.4f} "
+                      f"(world size {hvd.size()})")
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    train(state)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

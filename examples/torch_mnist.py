@@ -1,0 +1,79 @@
+"""Torch interop training (reference: examples/pytorch_mnist.py).
+
+A Horovod/PyTorch user's script ports by switching the import:
+
+    - import horovod.torch as hvd
+    + import horovod_tpu.torch as hvd
+
+Run:  hvdrun -np 2 python examples/torch_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 128)
+        self.fc2 = torch.nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)  # same init on every rank
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size())
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+
+    # Reference pattern: broadcast initial state from rank 0.
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    # Synthetic MNIST-shaped shard per rank.
+    rng = np.random.RandomState(hvd.rank())
+    x = torch.tensor(rng.randn(2048, 784), dtype=torch.float32)
+    w = torch.tensor(np.random.RandomState(0).randn(784, 10),
+                     dtype=torch.float32)
+    y = (x @ w).argmax(dim=1)
+
+    for epoch in range(args.epochs):
+        perm = torch.randperm(len(x))
+        losses = []
+        for i in range(0, len(x), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(x[idx]), y[idx])
+            loss.backward()
+            optimizer.step()
+            losses.append(float(loss.detach()))
+        avg = hvd.allreduce(torch.tensor(np.mean(losses)), name="epoch_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(avg):.4f}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

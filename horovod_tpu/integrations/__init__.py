@@ -1,0 +1,22 @@
+"""Cluster integrations (reference: SURVEY.md §2.6 — ``horovod.spark`` /
+``horovod.ray``).
+
+On TPU the cluster substrate is pods + a launcher, not Spark executors or Ray
+actors, so these integrations keep the reference's *API shapes* while running
+on the native runner:
+
+- :class:`Executor` — programmatic multi-process execution with per-rank
+  results (the role of ``RayExecutor.run`` / ``horovod.spark.run``).
+- :class:`RayExecutor` — the reference's Ray API (``horovod/ray/runner.py:246``),
+  available when ``ray`` is installed; import-gated otherwise.
+- :class:`Estimator` / :class:`LocalStore` — the Spark-estimator shape
+  (``horovod/spark/keras/estimator.py``, ``spark/common/store.py``):
+  ``fit(data) -> TrainedModel`` with checkpointing to a store.
+"""
+
+from .executor import Executor
+from .estimator import Estimator, EstimatorModel, LocalStore, Store
+from .ray import RayExecutor
+
+__all__ = ["Executor", "RayExecutor", "Estimator", "EstimatorModel",
+           "Store", "LocalStore"]

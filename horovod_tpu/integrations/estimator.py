@@ -1,0 +1,155 @@
+"""Estimator API: ``fit(data) -> model`` with store-backed checkpoints.
+
+Reference shape: the Spark estimators (``horovod/spark/keras/estimator.py:105``
+``KerasEstimator.fit(df) → TransformerModel``, ``horovod/spark/torch/``)
+backed by a ``Store`` (``horovod/spark/common/store.py`` — local/HDFS/DBFS
+paths for checkpoints + runs). The TPU-native counterpart trains a flax
+module data-parallel over the mesh and checkpoints the best epoch to the
+store; ``EstimatorModel.transform`` serves predictions, mirroring the Spark
+``TransformerModel``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Optional, Tuple
+
+
+class Store:
+    """Checkpoint/run-artifact locations (reference: store.py Store base)."""
+
+    def checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def save(self, run_id: str, payload: bytes) -> str:
+        raise NotImplementedError
+
+    def load(self, run_id: str) -> bytes:
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Filesystem store (reference: LocalStore / FilesystemStore,
+    spark/common/store.py)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, run_id, "checkpoint.pkl")
+
+    def save(self, run_id: str, payload: bytes) -> str:
+        path = self.checkpoint_path(run_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        return path
+
+    def load(self, run_id: str) -> bytes:
+        with open(self.checkpoint_path(run_id), "rb") as f:
+            return f.read()
+
+
+class EstimatorModel:
+    """Trained-model wrapper (reference: TransformerModel — holds the best
+    checkpoint and serves ``transform``)."""
+
+    def __init__(self, model, params, run_id: str, history):
+        self.model = model
+        self.params = params
+        self.run_id = run_id
+        self.history = history  # list of per-epoch losses
+
+    def transform(self, x):
+        """Predict on a host batch (reference: model.transform(df))."""
+        import jax.numpy as jnp
+        return self.model.apply(self.params, jnp.asarray(x))
+
+    @classmethod
+    def load(cls, model, store: Store, run_id: str) -> "EstimatorModel":
+        import jax
+        blob = pickle.loads(store.load(run_id))
+        params = jax.tree.map(lambda a: a, blob["params"])
+        return cls(model, params, run_id, blob.get("history", []))
+
+
+class Estimator:
+    """Train a flax module data-parallel and checkpoint the best epoch.
+
+    Reference constructor shape (spark/keras/estimator.py): model + optimizer
+    + loss + store + epochs/batch_size; ``fit`` returns the trained model
+    loaded from the best checkpoint.
+    """
+
+    def __init__(self, model, optimizer, loss: Callable, store: Store,
+                 epochs: int = 5, batch_size: int = 32,
+                 run_id: Optional[str] = None, seed: int = 0):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.store = store
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.run_id = run_id or "run"
+        self.seed = seed
+
+    def fit(self, data: Tuple[Any, Any]) -> EstimatorModel:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        import horovod_tpu as hvd
+
+        if not hvd.is_initialized():
+            hvd.init()
+
+        x, y = data
+        x = np.asarray(x)
+        y = np.asarray(y)
+        rng = jax.random.PRNGKey(self.seed)
+        params = self.model.init(rng, jnp.asarray(x[: 1]))
+        opt = hvd.DistributedOptimizer(self.optimizer)
+        opt_state = opt.init(params)
+        model, loss_fn = self.model, self.loss
+
+        def train_step(p, s, batch):
+            xb, yb = batch
+
+            def objective(q):
+                return loss_fn(model.apply(q, xb), yb)
+
+            l, g = jax.value_and_grad(objective)(p)
+            updates, s = opt.update(g, s, p)
+            p = optax.apply_updates(p, updates)
+            return p, s, hvd.allreduce(l, op=hvd.Average)
+
+        step = hvd.data_parallel_step(train_step, donate_state=False)
+
+        # Batches must tile the mesh's data axis evenly; trim the remainder
+        # (the reference's Petastorm loader repartitions for the same reason).
+        n_shards = hvd.size()
+        bs = max(self.batch_size // n_shards * n_shards, n_shards)
+        history = []
+        best = (float("inf"), None)
+        for epoch in range(self.epochs):
+            epoch_losses = []
+            for i in range(0, len(x) - bs + 1, bs):
+                batch = hvd.shard_batch((jnp.asarray(x[i:i + bs]),
+                                         jnp.asarray(y[i:i + bs])))
+                params, opt_state, l = step(params, opt_state, batch)
+                epoch_losses.append(float(l))
+            epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
+            history.append(epoch_loss)
+            if epoch_loss < best[0]:
+                host_params = jax.tree.map(np.asarray, params)
+                best = (epoch_loss, host_params)
+                if hvd.rank() == 0:
+                    self.store.save(self.run_id, pickle.dumps(
+                        {"params": host_params, "history": history}))
+
+        return EstimatorModel(self.model, best[1], self.run_id, history)

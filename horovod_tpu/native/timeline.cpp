@@ -71,10 +71,17 @@ std::string JsonEscape(const std::string& s) {
 
 void Timeline::Emit(const std::string& name, char ph,
                     const std::string& args_json, const std::string& cat) {
-  // Hold state_mu_ across check + timestamp so a concurrent runtime
-  // Shutdown/Initialize (background thread) can't mutate start_ mid-read.
-  std::lock_guard<std::mutex> st(state_mu_);
-  if (!initialized_) return;
+  // Snapshot under state_mu_ (so a concurrent runtime Shutdown/Initialize
+  // can't mutate start_/rank_ mid-read), then build the JSON outside it —
+  // emitters shouldn't serialize on heap work.
+  int64_t ts;
+  int rank;
+  {
+    std::lock_guard<std::mutex> st(state_mu_);
+    if (!initialized_) return;
+    ts = NowUs();
+    rank = rank_;
+  }
   // One row ("pid") per tensor name, one thread row per rank — mirrors the
   // reference's tensor-as-process layout (timeline.cc:254-276). Built with
   // std::string so long tensor names can't truncate into invalid JSON.
@@ -82,9 +89,9 @@ void Timeline::Emit(const std::string& name, char ph,
   e += JsonEscape(cat.empty() ? name : cat);
   e += "\", \"ph\": \"";
   e += ph;
-  e += "\", \"ts\": " + std::to_string(NowUs());
+  e += "\", \"ts\": " + std::to_string(ts);
   e += ", \"pid\": \"" + JsonEscape(name) + "\", \"tid\": " +
-       std::to_string(rank_);
+       std::to_string(rank);
   if (!args_json.empty()) e += ", \"args\": " + args_json;
   if (!cat.empty()) e += ", \"cat\": \"" + JsonEscape(cat) + "\"";
   e += "}";

@@ -17,13 +17,12 @@ the same collective semantics.
 
 from __future__ import annotations
 
-from typing import Any, Iterator, Optional, Tuple
+from typing import Any, Optional
 
 import numpy as np
 import torch
 
 from .. import functions as _functions
-from .. import runtime as _runtime
 from ..ops import collectives as _C
 from ..ops.collectives import ReduceOp, Average, Sum, Adasum, Min, Max, Product
 

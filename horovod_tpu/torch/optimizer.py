@@ -34,15 +34,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._op = op if op is not None else Average
         self.backward_passes_per_step = backward_passes_per_step
 
+        # Deterministic index-based names for every param (reference naming:
+        # allreduce.noname.<group>.<index>), overridden by named_parameters
+        # where it covers them. Never derive a name from id(p): memory
+        # addresses differ across processes, and mismatched names deadlock
+        # the name-based negotiation.
+        self._param_names = {}
+        for gi, group in enumerate(self.param_groups):
+            for pi, p in enumerate(group["params"]):
+                self._param_names[id(p)] = f"allreduce.noname.{gi}.{pi}"
         if named_parameters is not None:
-            named = list(named_parameters)
-            self._param_names = {id(p): name for name, p in named}
-        else:
-            self._param_names = {}
-            for gi, group in enumerate(self.param_groups):
-                for pi, p in enumerate(group["params"]):
-                    # Reference naming: allreduce.noname.<group>.<index>
-                    self._param_names[id(p)] = f"allreduce.noname.{gi}.{pi}"
+            for name, p in list(named_parameters):
+                self._param_names[id(p)] = name
 
         self._handles = {}           # param -> (handle, ctx)
         self._allreduce_delay = {}   # param -> remaining backward passes
@@ -87,7 +90,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return hook
 
     def _allreduce_grad_async(self, p):
-        name = self._param_names.get(id(p), f"allreduce.noname.{id(p)}")
+        name = self._param_names[id(p)]
         # Out-of-place: the compressed tensor may have a different dtype than
         # the parameter, and torch >= 2.x refuses a grad whose dtype diverges
         # from the param's — decompression back into p.grad happens in

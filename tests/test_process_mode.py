@@ -19,7 +19,6 @@ WORKER = os.path.join(REPO, "tests", "data", "proc_worker.py")
 
 
 from conftest import subprocess_env as _subprocess_env  # noqa: E402
-from conftest import free_port as _free_port  # noqa: E402
 from conftest import launch_world as _launch_world  # noqa: E402
 
 
