@@ -24,7 +24,7 @@ from .ops.collectives import (
     allreduce, allreduce_async, grouped_allreduce,
     allgather, allgather_async, broadcast, broadcast_async,
     alltoall, alltoall_async, reducescatter, join, poll, synchronize,
-    release_handle,
+    release_handle, hierarchical_allreduce_p,
     # In-step primitives (inside shard_map / run_step).
     allreduce_p, allgather_p, broadcast_p, alltoall_p, reducescatter_p,
     ppermute_p, rank_in_step, size_in_step, in_named_trace, pvary,

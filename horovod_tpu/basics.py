@@ -77,6 +77,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_cache_capacity.restype = ctypes.c_int
     lib.hvdtpu_set_cache_capacity.argtypes = [ctypes.c_void_p,
                                               ctypes.c_longlong]
+    lib.hvdtpu_set_stall_shutdown.restype = ctypes.c_int
+    lib.hvdtpu_set_stall_shutdown.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_double]
     lib.hvdtpu_set_autotune.restype = ctypes.c_int
     lib.hvdtpu_set_autotune.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -152,6 +155,11 @@ class NativeCore:
         # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
         self._lib.hvdtpu_set_cache_capacity(
             self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
+        # Stall force-shutdown (reference: HOROVOD_STALL_SHUTDOWN_TIME_SECONDS,
+        # 0 = disabled).
+        self._lib.hvdtpu_set_stall_shutdown(
+            self._core,
+            ev.get_float(ev.HVDTPU_STALL_SHUTDOWN_TIME_SECONDS, 0.0))
         # Autotune (reference: HOROVOD_AUTOTUNE + HOROVOD_AUTOTUNE_* knobs,
         # operations.cc:474-532).
         if ev.get_bool(ev.HVDTPU_AUTOTUNE):

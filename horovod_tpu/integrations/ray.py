@@ -73,6 +73,16 @@ class RayExecutor:
                 import socket
                 return socket.gethostname()
 
+            def probe_port(self):
+                # Runs ON this worker's node — the controller binds there,
+                # so the free-port probe must happen there too.
+                import socket
+                s = socket.socket()
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+                s.close()
+                return port
+
             def set_env(self, env):
                 import os
                 os.environ.update(env)
@@ -82,11 +92,8 @@ class RayExecutor:
 
         self._workers = [_Worker.remote() for _ in range(self.num_workers)]
         node_ids = ray.get([w.hostname.remote() for w in self._workers])
-        import socket
-        free = socket.socket()
-        free.bind(("", 0))
-        port = free.getsockname()[1]
-        free.close()
+        # Rank 0 hosts the controller; probe the port on its node.
+        port = ray.get(self._workers[0].probe_port.remote())
         coord = _Coordinator(node_ids, node_ids[0], port)
         ray.get([w.set_env.remote(coord.env_for(i))
                  for i, w in enumerate(self._workers)])

@@ -40,9 +40,36 @@
 #include "socket_util.h"
 #include "timeline.h"
 
+#include <execinfo.h>
+
 namespace hvdtpu {
 
 namespace {
+
+// Diagnostic terminate handler: print a native backtrace before aborting so
+// an uncaught C++ exception in a background thread is debuggable in CI logs.
+// Installed lazily from hvdtpu_create (not a static initializer — merely
+// loading the library must not hijack the host process's handler) and chains
+// to whatever handler was installed before.
+std::terminate_handler g_prev_terminate = nullptr;
+
+void TerminateWithBacktrace() {
+  void* frames[64];
+  int n = backtrace(frames, 64);
+  fprintf(stderr, "[hvdtpu] fatal: uncaught exception; backtrace:\n");
+  backtrace_symbols_fd(frames, n, 2);
+  if (g_prev_terminate != nullptr && g_prev_terminate != TerminateWithBacktrace) {
+    g_prev_terminate();
+  }
+  abort();
+}
+
+void InstallTerminateHandlerOnce() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    g_prev_terminate = std::set_terminate(TerminateWithBacktrace);
+  });
+}
 
 enum class CtrlMsg : int32_t {
   HELLO = 1,
@@ -67,6 +94,18 @@ double NowSeconds() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+void LogBadFrame(int rank, const char* where,
+                 const std::vector<uint8_t>& frame) {
+  char hex[3 * 64 + 1] = {0};
+  size_t n = frame.size() < 64 ? frame.size() : 64;
+  for (size_t i = 0; i < n; ++i) {
+    snprintf(hex + 3 * i, 4, "%02x ", frame[i]);
+  }
+  fprintf(stderr,
+          "[hvdtpu %d] ERROR: corrupt control frame in %s (len=%zu): %s\n",
+          rank, where, frame.size(), hex);
 }
 
 // Compare everything that must match for a cached announcement to be valid
@@ -183,6 +222,9 @@ struct CoreConfig {
   std::string timeline_path;
   bool timeline_mark_cycles = false;
   double stall_warn_secs = 60.0;  // reference HOROVOD_STALL_CHECK_TIME
+  // Reference HOROVOD_STALL_SHUTDOWN_TIME: after this long stalled, break
+  // the world instead of hanging forever. 0 disables (reference default).
+  double stall_shutdown_secs = 0.0;
   int64_t cache_capacity = 1024;  // reference HOROVOD_CACHE_CAPACITY
   // Autotune (reference HOROVOD_AUTOTUNE_* knobs, operations.cc:474-532).
   bool autotune = false;
@@ -674,8 +716,9 @@ void Core::PumpControlPlane() {
         std::vector<Request> fulls;
         {
           std::lock_guard<std::mutex> lk(mu_);
-          for (int64_t i = 0; i < n; ++i) {
+          for (int64_t i = 0; i < n && r.ok(); ++i) {
             std::string name = r.Str();
+            if (!r.ok()) break;
             auto it = outstanding_.find(name);
             if (it == outstanding_.end()) continue;
             TensorEntry* e = it->second;
@@ -708,7 +751,13 @@ void Core::PumpControlPlane() {
       if (type != CtrlMsg::RESPONSES) continue;
       int64_t n = r.I64();
       std::vector<Response> list;
-      for (int64_t i = 0; i < n; ++i) list.push_back(DeserializeResponse(&r));
+      for (int64_t i = 0; i < n && r.ok(); ++i) {
+        list.push_back(DeserializeResponse(&r));
+      }
+      if (!r.ok()) {
+        LogBadFrame(cfg_.rank, "worker RESPONSES", frame);
+        continue;
+      }
       ExecuteResponseList(list);
     }
   }
@@ -762,8 +811,9 @@ void Core::CoordinatorIngest() {
       if (type == CtrlMsg::READY) {
         int64_t n = r.I64();
         std::vector<Request> reqs;
-        for (int64_t i = 0; i < n; ++i) {
+        for (int64_t i = 0; i < n && r.ok(); ++i) {
           Request q = DeserializeRequest(&r);
+          if (!r.ok()) break;
           if (cache_.enabled()) cache_.PutRank(q);
           reqs.push_back(std::move(q));
         }
@@ -771,14 +821,19 @@ void Core::CoordinatorIngest() {
         // sent; on a miss (entry evicted here) ask the worker to resend.
         int64_t ncached = r.I64();
         std::vector<std::string> need_full;
-        for (int64_t i = 0; i < ncached; ++i) {
+        for (int64_t i = 0; i < ncached && r.ok(); ++i) {
           std::string name = r.Str();
+          if (!r.ok()) break;
           Request q;
           if (cache_.GetRank(name, rank, &q)) {
             reqs.push_back(std::move(q));
           } else {
             need_full.push_back(std::move(name));
           }
+        }
+        if (!r.ok()) {
+          LogBadFrame(cfg_.rank, "coordinator READY", frame);
+          continue;
         }
         if (!need_full.empty()) {
           Writer w;
@@ -1225,9 +1280,11 @@ void Core::ExecuteResponse(const Response& resp) {
   Status st = Status::OK();
   switch (resp.op_type) {
     case OpType::ALLREDUCE: {
+      // Completion AND timeline finalization happen inside: once
+      // CompleteEntry runs, the user thread may CopyResult and free the
+      // entry, so nothing here may touch `entries` afterwards.
       ExecuteFusedAllreduce(resp, entries);
-      for (auto* e : entries) timeline_.ActivityEnd(e->name);
-      return;  // completion handled inside
+      return;
     }
     case OpType::ALLGATHER: {
       TensorEntry* e = entries[0];
@@ -1410,6 +1467,9 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
                        fusion.begin() + (off + n) * static_cast<int64_t>(elem));
     }
     off += n;
+    // Timeline events BEFORE CompleteEntry: completion hands ownership to
+    // the user thread, which may free the entry immediately.
+    timeline_.ActivityEnd(e->name);
     timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason);
     if (e->handle >= 0) CompleteEntry(e, st);
   }
@@ -1417,10 +1477,21 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
 
 void Core::CheckStalls() {
   // Reference: StallInspector (stall_inspector.{h,cc}) — rank 0 warns when
-  // some ranks announced a tensor and others have not for stall_warn_secs.
+  // some ranks announced a tensor and others have not for stall_warn_secs,
+  // and force-shuts-down after stall_shutdown_secs (stall_inspector.cc
+  // ShutdownIfStalled).
   double now = NowSeconds();
   for (auto& kv : message_table_) {
     auto& slot = kv.second;
+    if (cfg_.stall_shutdown_secs > 0 &&
+        now - slot.first_seen > cfg_.stall_shutdown_secs) {
+      LogWarn(0,
+              "tensor '%s' stalled for over %.0f s "
+              "(HVDTPU_STALL_SHUTDOWN_TIME_SECONDS); aborting the job",
+              kv.first.c_str(), cfg_.stall_shutdown_secs);
+      world_broken_ = true;
+      return;
+    }
     if (slot.stall_warned ||
         now - slot.first_seen < cfg_.stall_warn_secs) {
       continue;
@@ -1471,6 +1542,7 @@ void* hvdtpu_create(int rank, int size, int local_rank, int local_size,
                     int coord_port, const char* my_host, double cycle_time_ms,
                     long long fusion_threshold, const char* timeline_path,
                     int timeline_mark_cycles, double stall_warn_secs) {
+  hvdtpu::InstallTerminateHandlerOnce();
   CoreConfig cfg;
   cfg.rank = rank;
   cfg.size = size;
@@ -1552,6 +1624,11 @@ long long hvdtpu_join(void* core) {
 // operations.cc:456-532 — here Python parses env and pushes values down).
 int hvdtpu_set_cache_capacity(void* core, long long capacity) {
   static_cast<Core*>(core)->mutable_config()->cache_capacity = capacity;
+  return 0;
+}
+
+int hvdtpu_set_stall_shutdown(void* core, double secs) {
+  static_cast<Core*>(core)->mutable_config()->stall_shutdown_secs = secs;
   return 0;
 }
 

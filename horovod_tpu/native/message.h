@@ -102,32 +102,46 @@ class Reader {
   int64_t I64() { int64_t v = 0; Raw(&v, sizeof(v)); return v; }
   double F64() { double v = 0; Raw(&v, sizeof(v)); return v; }
   std::string Str() {
-    int64_t n = I64();
-    std::string s(n, '\0');
+    int64_t n = Len(1);
+    std::string s(static_cast<size_t>(n), '\0');
     Raw(s.data(), static_cast<size_t>(n));
     return s;
   }
   std::vector<int64_t> VecI64() {
-    int64_t n = I64();
+    int64_t n = Len(sizeof(int64_t));
     std::vector<int64_t> v(static_cast<size_t>(n));
     Raw(v.data(), v.size() * sizeof(int64_t));
     return v;
   }
   std::vector<int32_t> VecI32() {
-    int64_t n = I64();
+    int64_t n = Len(sizeof(int32_t));
     std::vector<int32_t> v(static_cast<size_t>(n));
     Raw(v.data(), v.size() * sizeof(int32_t));
     return v;
   }
   std::vector<double> VecF64() {
-    int64_t n = I64();
+    int64_t n = Len(sizeof(double));
     std::vector<double> v(static_cast<size_t>(n));
     Raw(v.data(), v.size() * sizeof(double));
     return v;
   }
   bool ok() const { return ok_; }
+  size_t pos() const { return pos_; }
+  size_t size() const { return buf_.size(); }
 
  private:
+  // A length prefix can never exceed the bytes remaining in the frame; a
+  // bigger value means the frame is corrupt — flag it instead of letting a
+  // garbage allocation size throw std::length_error.
+  int64_t Len(size_t elem_size) {
+    int64_t n = I64();
+    if (n < 0 ||
+        static_cast<size_t>(n) > (buf_.size() - pos_) / elem_size) {
+      ok_ = false;
+      return 0;
+    }
+    return n;
+  }
   void Raw(void* p, size_t n) {
     if (pos_ + n > buf_.size()) { ok_ = false; return; }
     memcpy(p, buf_.data() + pos_, n);

@@ -294,6 +294,64 @@ def ppermute_p(x, perm: Sequence[tuple], axis: Optional[str] = None):
     return lax.ppermute(x, _resolve_axis(axis), perm=perm)
 
 
+def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
+                             inner_axis: str = None, outer_axis: str = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0):
+    """Hierarchical allreduce over a 2D mesh: reduce-scatter over the
+    fast ``inner_axis`` (ICI within a slice), allreduce the 1/n_inner shard
+    over the slow ``outer_axis`` (DCN across slices), allgather over inner.
+
+    Reference: ``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:204``) —
+    NCCL ReduceScatter intra-node → MPI allreduce cross-node on a
+    local_size-divisible chunk → NCCL Allgather. Only 1/n_inner of the bytes
+    cross the slow fabric per chip, which is the whole point.
+
+    ``op=Adasum`` gives the VHDD composition (reference:
+    ``adasum_gpu_operations.h``): sum-reduce-scatter within the slice, Adasum
+    across slices, allgather — scaling stability across slices where it
+    matters.
+    """
+    if inner_axis is None or outer_axis is None:
+        raise ValueError("hierarchical_allreduce_p needs explicit "
+                         "inner_axis (ICI) and outer_axis (DCN)")
+    x = _apply_scale(x, prescale_factor)
+    if op in (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT):
+        # No reduce-scatter form; reduce over both axes directly.
+        y = allreduce_p(allreduce_p(x, op=op, axis=inner_axis),
+                        op=op, axis=outer_axis)
+        return _apply_scale(y, postscale_factor)
+
+    n_inner = lax.axis_size(inner_axis)
+    total = n_inner * lax.axis_size(outer_axis)
+    orig_shape, orig_dtype = x.shape, x.dtype
+
+    # Flatten + pad so dim 0 splits evenly across the inner axis (reference:
+    # the NCCL path reduces the local_size-divisible chunk hierarchically and
+    # broadcasts the remainder; padding is the compiled-friendly equivalent).
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_inner
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+
+    shard = lax.psum_scatter(flat, inner_axis, scatter_dimension=0, tiled=True)
+    if op == ReduceOp.ADASUM:
+        from ..parallel.adasum import adasum_p
+        shard = adasum_p(shard, axis=outer_axis)
+    else:
+        shard = lax.psum(shard, outer_axis)
+    # allgather_p (masked-psum form) so the output is provably replicated
+    # over the inner axis under shard_map's varying-axes check.
+    full = allgather_p(shard, axis=inner_axis)
+
+    if pad:
+        full = full[:-pad]
+    y = full.reshape(orig_shape).astype(orig_dtype)
+    if op == ReduceOp.AVERAGE:
+        y = _apply_scale(y, 1.0 / total)
+    return _apply_scale(y, postscale_factor)
+
+
 # ---------------------------------------------------------------------------
 # Eager path — SPMD mode
 # ---------------------------------------------------------------------------

@@ -16,7 +16,7 @@ fusion + cycle machinery.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,13 +29,34 @@ from ..ops import collectives as C
 def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
                         compression=None, prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0,
-                        axis: Optional[str] = None):
+                        axis: Optional[str] = None,
+                        hierarchical: Optional[Tuple[str, str]] = None):
     """Allreduce a gradient pytree across the data-parallel axis.
 
     Functional analog of ``DistributedGradientTape.gradient``
     (reference ``horovod/tensorflow/__init__.py:509-527``): use directly after
     ``jax.grad`` when not using :func:`DistributedOptimizer`.
+
+    ``hierarchical=(inner_axis, outer_axis)`` routes through
+    :func:`~horovod_tpu.ops.collectives.hierarchical_allreduce_p` — reduce-
+    scatter over the fast ICI axis, allreduce over the slow DCN axis,
+    allgather back (reference: ``NCCLHierarchicalAllreduce``). In-step only.
     """
+    if hierarchical is not None:
+        if compression is not None:
+            raise ValueError(
+                "hierarchical allreduce does not take a compressor; use "
+                "compressed_allreduce over the slow axis instead")
+        if not C.in_named_trace(hierarchical[0]):
+            raise ValueError(
+                "hierarchical allreduce is in-step only: call inside "
+                "run_step/shard_map over a mesh with both axes")
+        inner, outer = hierarchical
+        return jax.tree.map(
+            lambda g: C.hierarchical_allreduce_p(
+                g, op=op, inner_axis=inner, outer_axis=outer,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor), grads)
     return C.grouped_allreduce(grads, name="grads", op=op,
                                compression=compression,
                                prescale_factor=prescale_factor,

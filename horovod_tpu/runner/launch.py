@@ -28,8 +28,9 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
         prog="hvdrun",
         description="Launch a horovod_tpu process-mode job "
                     "(Horovod-parity runner; reference: horovodrun)")
-    p.add_argument("-np", "--num-proc", type=int, required=True,
-                   help="total number of worker processes")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="total number of worker processes (required unless "
+                        "--check-build)")
     p.add_argument("-H", "--hosts", default=None,
                    help='host list "h1:slots,h2:slots" (default: localhost)')
     p.add_argument("--hostfile", default=None,
@@ -49,6 +50,14 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   default=0.0,
+                   help="abort the job after a collective stalls this long; "
+                        "0 disables (reference: "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)")
+    p.add_argument("--check-build", action="store_true",
+                   help="print available features and exit "
+                        "(reference: horovodrun --check-build)")
     p.add_argument("--cache-capacity", type=int, default=1024,
                    help="response cache capacity; 0 disables "
                         "(reference: --cache-capacity / "
@@ -89,11 +98,51 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     if pre.config_file:
         _install_config_file_defaults(pre.config_file, p)
     args = p.parse_args(argv)
+    if args.check_build:
+        print(_check_build_text())
+        raise SystemExit(0)
+    if args.num_proc is None:
+        p.error("the following arguments are required: -np/--num-proc")
     if not args.command:
         p.error("no worker command given")
     if args.command[0] == "--":
         args.command = args.command[1:]
     return args
+
+
+def _check_build_text() -> str:
+    """Reference: ``horovodrun --check-build`` (launch.py:106) — report which
+    frameworks/controllers/ops this build provides."""
+    import horovod_tpu
+
+    def has(modname: str) -> bool:
+        import importlib.util
+        return importlib.util.find_spec(modname) is not None
+
+    native = os.path.exists(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native", "libhvdtpu_core.so"))
+    mark = lambda b: "[X]" if b else "[ ]"  # noqa: E731
+    return f"""horovod_tpu v{horovod_tpu.__version__}:
+
+Available Frameworks:
+    {mark(has('jax'))} JAX (native)
+    {mark(has('flax'))} Flax models
+    {mark(has('torch'))} PyTorch (interop)
+
+Available Controllers:
+    {mark(native)} native TCP controller (process mode)
+    {mark(has('jax'))} XLA/SPMD mesh (compiled mode)
+
+Available Tensor Operations:
+    {mark(True)} allreduce / grouped_allreduce (Sum, Average, Adasum, Min, Max, Product)
+    {mark(True)} allgather (varying first dim)
+    {mark(True)} broadcast
+    {mark(True)} alltoall (uneven splits)
+    {mark(True)} reducescatter
+    {mark(True)} hierarchical allreduce (ICI/DCN)
+    {mark(True)} join
+    {mark(True)} compressed allreduce (maxmin/uni/exp/topk + error feedback)"""
 
 
 def _install_config_file_defaults(path: str, parser) -> None:
@@ -134,6 +183,9 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_STALL_CHECK_DISABLE] = "1"
     env[ev.HVDTPU_STALL_CHECK_TIME_SECONDS] = str(
         args.stall_check_warning_time_seconds)
+    if args.stall_check_shutdown_time_seconds:
+        env[ev.HVDTPU_STALL_SHUTDOWN_TIME_SECONDS] = str(
+            args.stall_check_shutdown_time_seconds)
     env[ev.HVDTPU_CACHE_CAPACITY] = str(args.cache_capacity)
     if args.autotune:
         env[ev.HVDTPU_AUTOTUNE] = "1"

@@ -1,0 +1,25 @@
+"""Worker: rank 1 never announces the tensor — with
+HVDTPU_STALL_SHUTDOWN_TIME_SECONDS set, rank 0's collective must abort
+(reference: StallInspector shutdown, stall_inspector.cc) instead of hanging."""
+import os, sys, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HvdTpuInternalError
+
+hvd.init()
+r = hvd.rank()
+if r == 0:
+    try:
+        hvd.allreduce(np.ones((4,), np.float32), name="stalled")
+    except HvdTpuInternalError:
+        print("ALL OK")  # aborted coherently, no hang
+        sys.exit(0)
+    print("FAIL: stalled collective completed")
+    sys.exit(1)
+else:
+    # Never announce; wait out the abort, then exit cleanly.
+    time.sleep(15)
+    print("ALL OK")

@@ -21,16 +21,24 @@ for it in range(5):
                                    name="traced", op=hvd.Sum))
     np.testing.assert_allclose(out, float(sum(range(n))))
 hvd.stop_timeline()
-# The stop request is applied by the background loop at its next cycle;
-# give it a moment so the "after" ops can't race into the trace.
+# The stop request is applied by the background loop at its next cycle; wait
+# until the trace file is closed (parseable JSON) so the "after" ops can't
+# race into it — a fixed sleep is flaky on a loaded machine.
+import json
 import time
-time.sleep(0.3)
+deadline = time.time() + 30
+while True:
+    try:
+        json.load(open(path))
+        break
+    except Exception:
+        assert time.time() < deadline, "timeline never closed"
+        time.sleep(0.05)
 
 # Phase 3: ops after stop still work and are not recorded.
 for it in range(3):
     hvd.allreduce(np.ones((4,), np.float32), name="after", op=hvd.Sum)
 
-import json
 events = json.load(open(path))
 names = {e.get("pid") for e in events}
 assert "traced" in names, names

@@ -49,6 +49,19 @@ def test_autotune(tmp_path):
     assert_all_ok(results)
 
 
+def test_stall_shutdown():
+    """A rank that never announces must abort the job after
+    HVDTPU_STALL_SHUTDOWN_TIME_SECONDS, not hang (reference:
+    StallInspector::ShutdownIfStalled)."""
+    results = launch_world(
+        2, os.path.join(DATA, "stall_worker.py"),
+        extra_env={
+            "HVDTPU_STALL_CHECK_TIME_SECONDS": "1",
+            "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS": "3",
+        }, timeout=60)
+    assert_all_ok(results)
+
+
 def test_runtime_timeline(tmp_path):
     """start_timeline/stop_timeline bracket exactly the traced phase."""
     results = launch_world(
