@@ -240,6 +240,14 @@ bool ParameterManager::Update(int64_t bytes, double now_secs) {
     return false;
   }
 
+  // Median-of-N scoring per tuning step: single samples are noisy (one
+  // GC pause or burst skews bytes/sec), and the GP fit amplifies outliers.
+  step_scores_.push_back(score);
+  if (static_cast<int>(step_scores_.size()) < kScoresPerStep) return false;
+  std::sort(step_scores_.begin(), step_scores_.end());
+  score = step_scores_[step_scores_.size() / 2];
+  step_scores_.clear();
+
   LogSample(score);
   opt_.AddSample(ToVector(current_), score);
   if (static_cast<int>(opt_.num_samples()) >= max_samples_) {

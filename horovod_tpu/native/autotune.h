@@ -87,8 +87,12 @@ class ParameterManager {
 
   bool active() const { return active_; }
 
-  // Record bytes moved by one nonempty background cycle. Returns true when
-  // the tuned parameters changed (caller re-reads Current() and broadcasts).
+  // Record bytes moved by one nonempty background cycle (callers must skip
+  // zero-byte cycles — they would dilute the bytes/sec score with idle/app
+  // time). Each tuning step scores the median of kScoresPerStep samples
+  // (reference: parameter_manager.cc tunes on the median of several samples).
+  // Returns true when the tuned parameters changed (caller re-reads
+  // Current() and broadcasts).
   bool Update(int64_t bytes, double now_secs);
   Params Current() const { return current_; }
 
@@ -108,6 +112,8 @@ class ParameterManager {
   int cycle_count_ = 0;
   int64_t bytes_acc_ = 0;
   double sample_start_ = 0.0;
+  static constexpr int kScoresPerStep = 3;
+  std::vector<double> step_scores_;
   FILE* log_ = nullptr;
 };
 

@@ -1171,7 +1171,11 @@ void Core::CoordinatorEmitResponses() {
         bytes += NumElements(s) * static_cast<int64_t>(DataTypeSize(resp.dtype));
       }
     }
-    if (param_manager_.Update(bytes, NowSeconds())) {
+    // Zero-byte lists (ERROR/JOIN_DONE only) are not data cycles; letting
+    // them advance the sample would dilute the bytes/sec score with idle
+    // time (reference advances samples by per-tensor step counts,
+    // parameter_manager.cc:142-160).
+    if (bytes > 0 && param_manager_.Update(bytes, NowSeconds())) {
       ParameterManager::Params p = param_manager_.Current();
       {
         std::lock_guard<std::mutex> lk(mu_);

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import subprocess
 import threading
 from typing import Optional, Sequence, Tuple
 
@@ -68,6 +69,59 @@ class _RuntimeState:
 
 _state = _RuntimeState()
 _lock = threading.RLock()
+
+
+class _SingleRankCore:
+    """Pure-Python stand-in for the native core at world size 1 when the
+    compiled library is unavailable: collectives degenerate to local math
+    (allreduce/broadcast/allgather/alltoall/reducescatter of one rank are
+    the input, modulo pre/postscale). No timeline, autotune, or stall
+    inspection — a degraded but working mode for source-only installs."""
+
+    def __init__(self):
+        self._results = {}
+        self._next = 0
+
+    def start(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+    def enqueue(self, kind, name, arr, op=1, prescale=1.0, postscale=1.0,
+                root_rank=0, splits=None):
+        out = np.asarray(arr)
+        if kind in ("allreduce", "reducescatter") and \
+                (prescale != 1.0 or postscale != 1.0):
+            out = out * (prescale * postscale)
+        h = self._next
+        self._next += 1
+        self._results[h] = out
+        return h
+
+    def poll(self, handle):
+        return True
+
+    def wait(self, handle, out_dtype, row_shape):
+        return self._results.pop(handle)
+
+    def collective(self, kind, name, arr, **kw):
+        return self.wait(self.enqueue(kind, name, arr, **kw), None, None)
+
+    def join(self):
+        return 0
+
+    def start_timeline(self, path, mark_cycles=False):
+        log.warning("timeline requires the compiled native core; ignoring")
+
+    def stop_timeline(self):
+        pass
+
+    def cycle_time_ms(self):
+        return 0.0
+
+    def fusion_threshold(self):
+        return 0
 _init_kwargs: dict = {}
 
 
@@ -219,19 +273,32 @@ def init(comm: Optional[Sequence[int]] = None,
                 st.cross_size = ev.get_int(ev.HVDTPU_CROSS_SIZE, st.size)
             # The native core runs at every world size — a single-rank job
             # still gets the background loop, timeline, and identical op
-            # semantics (the reference behaves the same at np=1).
+            # semantics (the reference behaves the same at np=1). Pure-Python
+            # installs (no compiled .so) keep working at size 1 only, with
+            # collectives degenerating to local math.
             try:
                 from . import basics
-            except ImportError as e:
+                st.core = basics.NativeCore(
+                    rank=st.rank, size=st.size,
+                    local_rank=st.local_rank, local_size=st.local_size,
+                    cross_rank=st.cross_rank, cross_size=st.cross_size,
+                    coord_host=controller[0], coord_port=controller[1])
+            except (ImportError, OSError,
+                    subprocess.CalledProcessError) as e:
+                if st.size == 1:
+                    log.warning(
+                        "native core unavailable (%s); single-rank process "
+                        "mode continues without it (no timeline/autotune). "
+                        "Build with `make -C horovod_tpu/native` for the "
+                        "full runtime.", e)
+                    st.core = _SingleRankCore()
+                    st.initialized = True
+                    _state = st
+                    return
                 raise NotInitializedError(
                     "process mode requires the native core binding "
                     "(horovod_tpu/basics.py + horovod_tpu/native); build "
                     "it with `make -C horovod_tpu/native`") from e
-            st.core = basics.NativeCore(
-                rank=st.rank, size=st.size,
-                local_rank=st.local_rank, local_size=st.local_size,
-                cross_rank=st.cross_rank, cross_size=st.cross_size,
-                coord_host=controller[0], coord_port=controller[1])
             st.core.start()
             log.debug("init: process mode rank=%d size=%d local=%d/%d",
                       st.rank, st.size, st.local_rank, st.local_size)

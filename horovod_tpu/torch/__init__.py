@@ -48,17 +48,38 @@ __all__ = [
 ]
 
 
+try:  # numpy has no native bfloat16; ml_dtypes (shipped with jax) does
+    import ml_dtypes
+    _NP_BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _NP_BFLOAT16 = None
+
+
 def _to_numpy(t: torch.Tensor) -> np.ndarray:
-    return t.detach().cpu().contiguous().numpy()
+    t = t.detach().cpu().contiguous()
+    if t.dtype == torch.bfloat16:
+        # Bridge via a bit-level reinterpret: Tensor.numpy() raises on bf16.
+        # ml_dtypes keeps the 2-byte payload (and the native data plane's
+        # bf16 reduce path); without it, upcast to fp32.
+        if _NP_BFLOAT16 is not None:
+            return t.view(torch.int16).numpy().view(_NP_BFLOAT16)
+        return t.float().numpy()
+    return t.numpy()
 
 
 def _to_torch(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    if _NP_BFLOAT16 is not None and a.dtype == _NP_BFLOAT16:
+        out = torch.from_numpy(np.ascontiguousarray(a).view(np.int16).copy())
+        return out.view(torch.bfloat16).to(like.device)
     # Copy: jax outputs arrive as read-only numpy views, which torch cannot
     # safely wrap in a writable tensor.
     a = np.ascontiguousarray(a)
     if not a.flags.writeable:
         a = a.copy()
-    return torch.from_numpy(a).to(like.device)
+    out = torch.from_numpy(a)
+    if like.dtype == torch.bfloat16:  # fp32-upcast fallback round-trip
+        out = out.to(torch.bfloat16)
+    return out.to(like.device)
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         self._handles = {}           # param -> (handle, ctx)
         self._allreduce_delay = {}   # param -> remaining backward passes
+        self._requires_update = set()  # every hooked param — see synchronize()
         self._synchronized = False
         self._should_synchronize = True
         self._register_hooks()
@@ -61,6 +62,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 if not p.requires_grad:
                     continue
                 self._allreduce_delay[p] = self.backward_passes_per_step
+                self._requires_update.add(p)
                 if hasattr(p, "register_post_accumulate_grad_hook"):
                     p.register_post_accumulate_grad_hook(self._make_hook(p))
                 else:
@@ -79,14 +81,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _make_hook(self, p):
         def hook(param):
-            if param in self._handles:
+            if self._handles.get(param, (None, None))[0] is not None:
                 raise AssertionError(
                     "gradient for this parameter was already reduced; call "
                     "optimizer.step() or synchronize() between backward "
                     "passes, or raise backward_passes_per_step")
+            handle, ctx = None, None
             self._allreduce_delay[param] -= 1
             if self._allreduce_delay[param] == 0:
-                self._handles[param] = self._allreduce_grad_async(param)
+                handle, ctx = self._allreduce_grad_async(param)
+            # Accumulating params park (None, None) so synchronize() can
+            # force-launch them (reference optimizer.py:140-150).
+            self._handles[param] = (handle, ctx)
         return hook
 
     def _allreduce_grad_async(self, p):
@@ -104,10 +110,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def synchronize(self) -> None:
         """Wait for all outstanding gradient allreduces and install the
-        reduced gradients (reference: optimizer.py:152-188)."""
-        # Parameters whose hooks never fired this step (e.g. unused in the
-        # graph) keep their local grad — matching the reference, which only
-        # reduces hooked grads on synchronize (missing_p handling, :158-166).
+        reduced gradients (reference: optimizer.py:152-188).
+
+        Every rank must contribute to every negotiated collective: a param
+        whose hook never fired on this rank (unused param, conditional
+        branch) or that is still mid-accumulation gets its allreduce
+        force-launched here, exactly like the reference's ``missing_p`` /
+        handle-``None`` handling (optimizer.py:153-166) — otherwise ranks
+        that did fire block forever on ranks that never will.
+        """
+        for p in self._requires_update - set(self._handles):
+            if p.grad is None:
+                p.grad = torch.zeros_like(p)
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            if handle is None:
+                self._handles[p] = self._allreduce_grad_async(p)
         for p, (handle, ctx) in list(self._handles.items()):
             output = self._hvd["synchronize"](handle)
             self._allreduce_delay[p] = self.backward_passes_per_step

@@ -33,6 +33,17 @@ def test_full_collective_menu(n):
         assert "ALL OK" in out
 
 
+@pytest.mark.parametrize("n", [2, 3])
+def test_true_async_collectives(n):
+    """N async allreduces are all in flight on the native core before the
+    first synchronize (round-1 verdict #2: backward/comm overlap)."""
+    worker = os.path.join(os.path.dirname(WORKER), "async_worker.py")
+    results = _launch_world(n, worker)
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
 def test_hvdrun_cli(tmp_path):
     """hvdrun end-to-end (reference: test_static_run.py)."""
     timeline = tmp_path / "tl"
@@ -141,3 +152,30 @@ def test_join_after_peer_death_fails_over(tmp_path):
         rc, out, err = results[r]
         assert rc == 0, f"rank {r}: rc={rc}\n{err}\n{out}"
         assert "JOIN FAILED OVER" in out
+
+
+def test_single_rank_without_native_core(monkeypatch):
+    """Source-only installs (no compiled .so) keep working at size 1:
+    init falls back to a pure-Python local core (ADVICE r1 low)."""
+    import horovod_tpu as hvd
+    from horovod_tpu import basics, runtime
+
+    def boom(*a, **k):
+        raise OSError("simulated missing libhvdtpu_core.so")
+
+    monkeypatch.setattr(basics, "NativeCore", boom)
+    monkeypatch.setenv("HVDTPU_RANK", "0")
+    monkeypatch.setenv("HVDTPU_SIZE", "1")
+    hvd.shutdown()
+    try:
+        hvd.init()
+        assert hvd.size() == 1 and hvd.rank() == 0
+        assert isinstance(runtime.core(), runtime._SingleRankCore)
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), np.ones(4))
+        gathered = hvd.allgather(np.arange(3.0, dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(gathered),
+                                   np.arange(3.0, dtype=np.float32))
+        hvd.join()
+    finally:
+        hvd.shutdown()

@@ -82,3 +82,62 @@ class TestSingleProcess:
         out = Compression.fp16.decompress(c, ctx)
         assert out.dtype == torch.float32
         assert torch.allclose(out, t, atol=1e-2)
+
+    def test_bfloat16_numpy_bridge(self):
+        """bf16 — the dominant TPU training dtype — must round-trip through
+        the numpy bridge bit-exactly (ADVICE r1: Tensor.numpy() raises on
+        bf16; reference torch binding supports bf16 natively)."""
+        import torch
+        from horovod_tpu.torch import _to_numpy, _to_torch
+        t = torch.randn(64).to(torch.bfloat16)
+        a = _to_numpy(t)
+        assert a.itemsize == 2  # stays 2-byte on the wire
+        back = _to_torch(a, t)
+        assert back.dtype == torch.bfloat16
+        assert torch.equal(back, t)
+
+    def test_bfloat16_allreduce(self, spmd8):
+        import torch
+        import horovod_tpu.torch as hvd
+        n = hvd.size()
+        t = torch.ones(8, dtype=torch.bfloat16)
+        out = hvd.allreduce(t, op=hvd.Sum)
+        assert out.dtype == torch.bfloat16
+        assert torch.allclose(out.float(), torch.full((8,), float(n)))
+
+    def test_unused_param_synchronize(self, spmd8):
+        """A param whose hook never fires (unused in the graph) must still
+        be reduced on synchronize() so all ranks issue the same collectives
+        (ADVICE r1 high; reference optimizer.py:153-166)."""
+        import torch
+        import horovod_tpu.torch as hvd
+        used = torch.nn.Linear(4, 1)
+        unused = torch.nn.Linear(4, 1)
+        params = list(used.parameters()) + list(unused.parameters())
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(params, lr=0.1))
+        opt.zero_grad()
+        loss = used(torch.ones(2, 4)).sum()
+        loss.backward()
+        opt.step()  # must not raise / hang; unused params get zero grads
+        for p in unused.parameters():
+            assert p.grad is not None
+            assert torch.count_nonzero(p.grad) == 0
+
+    def test_accumulation_forced_on_synchronize(self, spmd8):
+        """backward_passes_per_step=2 with a manual synchronize() after one
+        pass: the mid-accumulation param must be force-launched (reference
+        handle-None handling)."""
+        import torch
+        import horovod_tpu.torch as hvd
+        model = torch.nn.Linear(4, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            backward_passes_per_step=2)
+        opt.zero_grad()
+        model(torch.ones(2, 4)).sum().backward()
+        opt.synchronize()  # one backward pass so far: handles are parked None
+        for p in model.parameters():
+            assert p.grad is not None
+        with opt.skip_synchronize():
+            opt.step()
