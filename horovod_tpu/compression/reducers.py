@@ -39,12 +39,10 @@ def _tree_index(tree, i):
     return jax.tree.map(lambda leaf: leaf[i], tree)
 
 
-def allgather_reducer_p(x, compressor, axis: Optional[str] = None,
-                        residual=None, key=None):
-    """Compress locally, allgather payloads, decompress + sum all ranks
-    (reference: ``reducers/mpi_allgather.cc``). One compressed volley; wire
-    cost n * compressed_size."""
-    ax = axis if axis is not None else runtime.dp_axis()
+def _uplink_gather_sum(x, compressor, ax: str, residual, key):
+    """Shared uplink: compress locally (with error feedback when a residual
+    is given), allgather payloads, decompress + sum — returns the float32
+    aggregate and the new residual."""
     n = lax.axis_size(ax)
     if residual is not None:
         from .error_feedback import compress_with_feedback
@@ -57,6 +55,16 @@ def allgather_reducer_p(x, compressor, axis: Optional[str] = None,
     for i in range(n):
         total = total + compressor.decompress(
             _tree_index(gathered, i), ctx).astype(jnp.float32)
+    return total, residual
+
+
+def allgather_reducer_p(x, compressor, axis: Optional[str] = None,
+                        residual=None, key=None):
+    """Compress locally, allgather payloads, decompress + sum all ranks
+    (reference: ``reducers/mpi_allgather.cc``). One compressed volley; wire
+    cost n * compressed_size."""
+    ax = axis if axis is not None else runtime.dp_axis()
+    total, residual = _uplink_gather_sum(x, compressor, ax, residual, key)
     out = total.astype(x.dtype)
     return (out, residual) if residual is not None else (out, None)
 
@@ -174,10 +182,88 @@ def ring_reducer_p(x, compressor, axis: Optional[str] = None,
     return (out, residual) if residual is not None else (out, None)
 
 
+def ps_reducer_p(x, compressor, axis: Optional[str] = None,
+                 residual=None, key=None):
+    """Parameter-server reduction (reference: ``reducers/mpi_ps.cc``):
+    workers send compressed gradients to the root, the root decompresses and
+    sums, **re-compresses the aggregate**, and sends it back down — two
+    quantization stages (uplink + downlink), an n→1→n wire pattern.
+
+    SPMD form: the uplink is a compressed allgather (on ICI a gather-to-root
+    costs the same as gather-to-all and keeps the program uniform); every
+    rank then applies the root's downlink quantization so the result is
+    bit-identical to the PS broadcast.
+    """
+    ax = axis if axis is not None else runtime.dp_axis()
+    total, residual = _uplink_gather_sum(x, compressor, ax, residual, key)
+    # Downlink: the root re-compresses the aggregate (mpi_ps.cc second
+    # round); all ranks hold the same `total`, so applying the same
+    # deterministic quantization reproduces the root's broadcast payload.
+    payload2, ctx2 = compressor.compress(total)
+    out = compressor.decompress(payload2, ctx2)
+    out = out.reshape(x.shape).astype(x.dtype)
+    return (out, residual) if residual is not None else (out, None)
+
+
+def tree_reducer_p(x, compressor, axis: Optional[str] = None,
+                   residual=None, key=None):
+    """Binomial-tree reduction (reference: ``reducers/mpi_tree.cc``):
+    bottom-up, at round s ranks that are odd multiples of 2^s compress and
+    send their accumulator to their parent (rank − 2^s), which decompresses
+    and adds — ceil(log2 n) compressed hops to the root. The reduced result
+    then propagates back down compressed (here: one compressed broadcast
+    from the root, wire-equivalent on ICI to the reference's top-down tree).
+
+    Compression noise accumulates along the tree depth (each merge
+    re-compresses), matching the reference's tradeoff.
+    """
+    ax = axis if axis is not None else runtime.dp_axis()
+    n = lax.axis_size(ax)
+    idx = lax.axis_index(ax)
+    acc = x.astype(jnp.float32)
+    if residual is not None:
+        from .error_feedback import compress_with_feedback
+        # Feedback applies to this rank's contribution: both the round-0
+        # uplink payload and the local accumulator carry x + residual.
+        acc = acc + residual.astype(jnp.float32).reshape(acc.shape)
+        payload, ctx, residual = compress_with_feedback(
+            compressor, x, residual, key)
+    else:
+        payload, ctx = compressor.compress(x, key)
+
+    shift = 2
+    rnd = 0
+    while shift // 2 < n:
+        half = shift // 2
+        if rnd > 0:
+            k = None if key is None else jax.random.fold_in(key, rnd)
+            payload, ctx = compressor.compress(acc, k)
+        perm = [(r, r - half) for r in range(n)
+                if r % shift == half]
+        received = jax.tree.map(
+            lambda leaf: lax.ppermute(leaf, ax, perm), payload)
+        is_recv = jnp.logical_and(idx % shift == 0, idx + half < n)
+        add = compressor.decompress(received, ctx).astype(jnp.float32)
+        add = add.reshape(acc.shape)
+        acc = acc + jnp.where(is_recv, add, jnp.zeros_like(add))
+        shift *= 2
+        rnd += 1
+
+    # Top-down: root's compressed aggregate to everyone.
+    payload_f, ctx_f = compressor.compress(acc)
+    payload_f = jax.tree.map(
+        lambda leaf: C.broadcast_p(leaf, root_rank=0, axis=ax), payload_f)
+    out = compressor.decompress(payload_f, ctx_f)
+    out = out.reshape(x.shape).astype(x.dtype)
+    return (out, residual) if residual is not None else (out, None)
+
+
 _REDUCERS = {
     "allgather": allgather_reducer_p,
     "scatter_allgather": scatter_allgather_reducer_p,
     "ring": ring_reducer_p,
+    "ps": ps_reducer_p,
+    "tree": tree_reducer_p,
 }
 
 

@@ -202,9 +202,15 @@ def allgather_p(x, axis: Optional[str] = None):
 
     Implemented as scatter-into-zeros + ``psum`` rather than ``lax.all_gather``
     so the output is *provably replicated* under shard_map's varying-axes check
-    (``lax.all_gather`` types its output as device-varying); XLA lowers the
-    masked psum to an efficient collective. Use :func:`allgather_varying_p` if
-    you want the raw ``lax.all_gather`` (output typed as varying).
+    (``lax.all_gather`` types its output as device-varying).
+
+    .. note:: XLA lowers the masked psum to an **all-reduce** over the n-sized
+       output (n× the bytes of a true all-gather) unless its
+       all-reduce→all-gather rewrite fires. When the consumer stays
+       per-device, prefer :func:`allgather_varying_p` (raw ``lax.all_gather``,
+       bandwidth-optimal, output typed varying); the eager
+       ``hvd.allgather`` path already uses the raw form via an unchecked
+       shard_map.
     """
     ax = _resolve_axis(axis)
     n = lax.axis_size(ax)
@@ -393,9 +399,16 @@ def _sharded_collective_fn(kind: str, ax: str, dim: int, op: ReduceOp,
             return reducescatter_p(shard, op=op, axis=ax)
         out_spec = in_spec
     elif kind == "allgather":
+        # Real lax.all_gather under check_vma=False: the masked-psum form
+        # lowers to a full all-reduce (n-times the wire bytes — verified on
+        # the CPU backend, round-1 weak #5). The output is replicated by
+        # construction, so skipping the VMA proof is sound here.
         def fn(shard):
-            return allgather_p(shard, axis=ax)
-        out_spec = P()
+            return lax.all_gather(shard, ax, axis=0, tiled=True)
+
+        mesh_ = mesh
+        return jax.jit(jax.shard_map(fn, mesh=mesh_, in_specs=in_spec,
+                                     out_specs=P(), check_vma=False))
     elif kind == "alltoall":
         def fn(shard):
             return alltoall_p(shard, axis=ax)
@@ -413,18 +426,10 @@ def _sharded_collective_fn(kind: str, ax: str, dim: int, op: ReduceOp,
                                  out_specs=out_spec))
 
 
-def _eager_spmd_allreduce(x, op, pre, post):
-    ax = runtime.dp_axis()
-    dim = _mesh_axis_dim(x, ax)
-    if dim is not None:
-        fn = _sharded_collective_fn("allreduce", ax, dim, op, pre, post,
-                                    runtime.epoch())
-        return fn(x)
-    # Replicated / host array: every rank holds the same value, so the reduction
-    # is computable locally (sum == x * size). Matches Horovod's semantics when
-    # all ranks pass identical tensors.
-    n = runtime.size()
-    x = jnp.asarray(x)
+def _replicated_local_reduce(x, op, pre, post, n):
+    """Reduction of a value every rank holds identically: computable locally
+    (sum == x * size). Matches Horovod's semantics when all ranks pass
+    identical tensors."""
     x = _apply_scale(x, pre)
     if op == ReduceOp.SUM:
         y = _apply_scale(x, float(n))
@@ -435,6 +440,57 @@ def _eager_spmd_allreduce(x, op, pre, post):
     else:
         raise ValueError(f"unknown ReduceOp {op}")
     return _apply_scale(y, post)
+
+
+def _eager_spmd_allreduce(x, op, pre, post):
+    ax = runtime.dp_axis()
+    dim = _mesh_axis_dim(x, ax)
+    if dim is not None:
+        fn = _sharded_collective_fn("allreduce", ax, dim, op, pre, post,
+                                    runtime.epoch())
+        return fn(x)
+    # n is the dp-axis extent (== world size on the default 1-axis mesh),
+    # matching the axis the sharded path reduces over — grouped and single
+    # allreduce must agree on multi-axis meshes.
+    n = int(runtime.mesh().shape[ax])
+    return _replicated_local_reduce(jnp.asarray(x), op, pre, post, n)
+
+
+@functools.lru_cache(maxsize=None)
+def _grouped_allreduce_fn(sig, ax: str, op: ReduceOp, pre: float, post: float,
+                          epoch: int):
+    """One compiled program reducing a whole tensor group.
+
+    The reference fuses co-negotiated tensors into a single buffer
+    (``controller.cc:686`` FuseResponses); here the group signature
+    (shapes, dtypes, sharded dims) keys ONE cached ``jit(shard_map)`` program
+    so an N-tensor group costs one dispatch and XLA fuses/schedules the
+    collectives jointly.
+    """
+    mesh = runtime.mesh()
+    in_specs = []
+    for _shape, _dtype, dim in sig:
+        if dim is None:
+            in_specs.append(P())
+        else:
+            entries: list = [None] * (dim + 1)
+            entries[dim] = ax
+            in_specs.append(P(*entries))
+
+    def fn(*shards):
+        outs = []
+        for (_shape, _dtype, dim), s in zip(sig, shards):
+            if dim is None:
+                outs.append(_replicated_local_reduce(
+                    s, op, pre, post, lax.axis_size(ax)))
+            else:
+                outs.append(allreduce_p(s, op=op, axis=ax,
+                                        prescale_factor=pre,
+                                        postscale_factor=post))
+        return tuple(outs)
+
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=tuple(in_specs),
+                                 out_specs=P()))
 
 
 # ---------------------------------------------------------------------------
@@ -569,12 +625,31 @@ def grouped_allreduce(tensors, name: Optional[str] = None,
         out = [allreduce_p(t, op=op, axis=axis, prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor) for t in leaves]
         return jax.tree.unflatten(treedef, out)
-    out = [allreduce(t, name=f"{name or 'group'}.{i}", op=op,
-                     prescale_factor=prescale_factor,
-                     postscale_factor=postscale_factor,
-                     compression=compression, axis=axis)
-           for i, t in enumerate(leaves)]
-    return jax.tree.unflatten(treedef, out)
+    if compression is not None:
+        # Compression changes payload dtype/shape per leaf; keep per-leaf ops.
+        out = [allreduce(t, name=f"{name or 'group'}.{i}", op=op,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor,
+                         compression=compression, axis=axis)
+               for i, t in enumerate(leaves)]
+        return jax.tree.unflatten(treedef, out)
+    if runtime.mode() == "process":
+        # Enqueue the whole group async so the native controller negotiates
+        # and FUSES it in one cycle (reference: FuseResponses,
+        # controller.cc:686), then wait — instead of serializing N blocking
+        # round-trips.
+        handles = [_core_async("allreduce", t, f"{name or 'group'}.{i}",
+                               op=int(op), prescale=prescale_factor,
+                               postscale=postscale_factor)
+                   for i, t in enumerate(leaves)]
+        return jax.tree.unflatten(treedef, [synchronize(h) for h in handles])
+    # SPMD eager: ONE cached compiled program for the whole group.
+    ax = _resolve_axis(axis)
+    arrs = [jnp.asarray(t) for t in leaves]
+    sig = tuple((a.shape, str(a.dtype), _mesh_axis_dim(a, ax)) for a in arrs)
+    fn = _grouped_allreduce_fn(sig, ax, op, prescale_factor, postscale_factor,
+                               runtime.epoch())
+    return jax.tree.unflatten(treedef, list(fn(*arrs)))
 
 
 def allgather(x, name: Optional[str] = None, axis: Optional[str] = None):

@@ -35,7 +35,24 @@ def _combine(a, b, dot, na2, nb2):
 
 
 def adasum_p(x, axis: str):
-    """In-step Adasum over mesh axis ``axis`` (use inside shard_map)."""
+    """In-step Adasum over mesh axis ``axis`` (use inside shard_map).
+
+    Vector-halving distance-doubling, like the reference's VHDD
+    (``adasum.h:168`` FusedAllreduce): at level L each pair ``(r, r^L)``
+    exchanges only the half-segment the other keeps, so the whole
+    reduce-scatter phase moves ~1x the vector per rank (the round-1
+    implementation moved the full vector every hop). The Adasum coefficients
+    need *global* dot/norms of the two logical vectors being combined — each
+    rank holds only a piece, so per-piece partials are summed over the
+    2L-sized exchange group (reference: ``FusedPairwiseReduceWithComm``'s
+    ``SumAllreduceWithComm`` over ``reduction_comms[comm_index]``), here via
+    one tiny 3-scalar all_gather per level. Reassembly is a single masked
+    psum whose output is provably replicated under shard_map's varying-axes
+    check — subsuming the old extra full-vector broadcast. Note the masked
+    psum lowers to an all-reduce over the full vector (~2x an all-gather's
+    bytes) unless XLA's rewrite fires — still far below the old
+    log2(n)-full-vector hops, but the final hop dominates the wire cost.
+    """
     n = lax.axis_size(axis)
     if n == 1:
         return x
@@ -56,32 +73,51 @@ def adasum_p(x, axis: str):
         incoming = lax.ppermute(v, axis, perm=perm_down)
         v = jnp.where(idx < r, v + incoming, v)
 
-    # Hypercube pairwise exchange among the first p ranks.
-    distance = 1
-    while distance < p:
-        perm = [(i, i ^ distance) for i in range(p)]
-        other = lax.ppermute(v, axis, perm=perm)
-        dot = jnp.sum(v * other)
-        mine2 = jnp.sum(v * v)
-        theirs2 = jnp.sum(other * other)
-        is_lower = (idx & distance) == 0
-        a = jnp.where(is_lower, v, other)
-        b = jnp.where(is_lower, other, v)
-        na2 = jnp.where(is_lower, mine2, theirs2)
-        nb2 = jnp.where(is_lower, theirs2, mine2)
+    # Pad so the segment halves evenly at every level.
+    count = v.shape[0]
+    pad = (-count) % p
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+    length = v.shape[0]
+
+    # Reduce-scatter phase: segment halves at each level; offset tracks the
+    # start of this rank's kept segment within the full vector.
+    seg = v
+    seg_size = length
+    offset = jnp.zeros((), jnp.int32)
+    level = 1
+    while level < p:
+        half = seg_size // 2
+        upper = (idx & level) != 0
+        keep = jnp.where(upper, seg[half:], seg[:half])
+        send = jnp.where(upper, seg[:half], seg[half:])
+        perm = [(i, i ^ level) for i in range(p)]
+        other = lax.ppermute(send, axis, perm=perm)
+        # 'a' is the lower-side logical vector's piece, 'b' the upper side's.
+        a = jnp.where(upper, other, keep)
+        b = jnp.where(upper, keep, other)
+        partial = jnp.stack([jnp.sum(a * b), jnp.sum(a * a), jnp.sum(b * b)])
+        gathered = lax.all_gather(partial, axis)  # [n, 3] — 3 scalars/rank
+        group = (jnp.arange(n) // (2 * level)) == (idx // (2 * level))
+        dot, na2, nb2 = jnp.sum(
+            jnp.where(group[:, None], gathered, 0.0), axis=0)
         combined = _combine(a, b, dot, na2, nb2)
-        v = jnp.where(idx < p, combined, v)
-        distance *= 2
+        seg = jnp.where(idx < p, combined, seg[:half])
+        offset = offset + jnp.where(upper, half, 0).astype(jnp.int32)
+        seg_size = half
+        level *= 2
 
-    # All ranks in the hypercube now hold the combined vector, but the ppermute
-    # chain types it device-varying; finish with a psum-based broadcast from
-    # rank 0 so the output is provably replicated (shard_map VMA check) and
-    # extra (non-power-of-two) ranks receive the result too.
-    # TODO(perf): switch to vector-halving distance-doubling (Rabenseifner-style,
-    # like the reference's VHDD) so each exchange moves half the payload.
-    v = lax.psum(jnp.where(idx == 0, v, jnp.zeros_like(v)), axis)
+    # Reassemble with one masked psum: each hypercube rank contributes its
+    # combined segment at its offset; extra (non-power-of-two) ranks
+    # contribute nothing and receive the replicated result like everyone.
+    full = jnp.zeros((length,), jnp.float32)
+    full = lax.dynamic_update_slice(full, seg, (offset,))
+    full = jnp.where(idx < p, full, jnp.zeros_like(full))
+    out = lax.psum(full, axis)
 
-    return v.reshape(orig_shape).astype(orig_dtype)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(orig_shape).astype(orig_dtype)
 
 
 def adasum_reference(tensors: Sequence[np.ndarray]) -> np.ndarray:

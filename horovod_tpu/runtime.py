@@ -332,6 +332,7 @@ def shutdown() -> None:
         # so elastic re-inits don't accumulate stale executables.
         from .ops import collectives as _C
         _C._sharded_collective_fn.cache_clear()
+        _C._grouped_allreduce_fn.cache_clear()
 
 
 def reinit() -> None:

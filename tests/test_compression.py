@@ -204,7 +204,8 @@ class TestReducers:
         return out, expect
 
     @pytest.mark.parametrize("reduction",
-                             ["allgather", "scatter_allgather", "ring"])
+                             ["allgather", "scatter_allgather", "ring",
+                              "ps", "tree"])
     def test_agrees_with_dense(self, spmd8, reduction):
         out, expect = self._run(reduction, spmd8)
         err = np.abs(out - expect)
@@ -224,6 +225,25 @@ class TestReducers:
 
         out = np.asarray(step(jnp.asarray(data)))
         np.testing.assert_allclose(out, data.mean(axis=0), atol=0.05)
+
+    @pytest.mark.parametrize("reduction", ["ps", "tree"])
+    def test_nonpow2_world(self, make_runtime, reduction):
+        """PS/tree at a non-power-of-two world size (the binomial tree must
+        skip absent peers; reference assumed powers of two)."""
+        import jax
+        hvd = make_runtime(mesh_shape={"dp": 5}, devices=jax.devices()[:5])
+        rng = np.random.RandomState(11)
+        data = rng.randn(5, 96).astype(np.float32)
+        q = MaxMinQuantizer(bits=8, bucket_size=32, use_pallas=False)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P())
+        def step(x):
+            return compressed_allreduce(x[0], q, reduction=reduction,
+                                        op=hvd.Sum)
+
+        out = np.asarray(step(jnp.asarray(data)))
+        expect = data.sum(axis=0)
+        assert np.abs(out - expect).max() < 0.05 * np.abs(expect).max() + 0.3
 
     def test_eager_spmd(self, spmd8):
         """Eager path (single-controller): identical copies reduce-average to
@@ -251,7 +271,8 @@ class TestReducers:
         assert np.any(np.asarray(res) != 0)  # something was lost and kept
 
     @pytest.mark.parametrize("reduction",
-                             ["allgather", "scatter_allgather", "ring"])
+                             ["allgather", "scatter_allgather", "ring",
+                              "ps", "tree"])
     def test_error_feedback_nondivisible_count(self, spmd8, reduction):
         """Element count not divisible by world size (regression: the ring
         reducer crashed reshaping an unpadded residual)."""
