@@ -77,6 +77,11 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_cache_capacity.restype = ctypes.c_int
     lib.hvdtpu_set_cache_capacity.argtypes = [ctypes.c_void_p,
                                               ctypes.c_longlong]
+    lib.hvdtpu_set_secret.restype = ctypes.c_int
+    lib.hvdtpu_set_secret.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvdtpu_hmac_hex.restype = ctypes.c_int
+    lib.hvdtpu_hmac_hex.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_char_p, ctypes.c_int]
     lib.hvdtpu_set_stall_shutdown.restype = ctypes.c_int
     lib.hvdtpu_set_stall_shutdown.argtypes = [ctypes.c_void_p,
                                               ctypes.c_double]
@@ -155,6 +160,10 @@ class NativeCore:
         # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
         self._lib.hvdtpu_set_cache_capacity(
             self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
+        secret = ev.get_str(ev.HVDTPU_SECRET, "")
+        if secret:
+            # Authenticated control plane (reference: secret.py shared key).
+            self._lib.hvdtpu_set_secret(self._core, secret.encode())
         # Stall force-shutdown (reference: HOROVOD_STALL_SHUTDOWN_TIME_SECONDS,
         # 0 = disabled).
         self._lib.hvdtpu_set_stall_shutdown(

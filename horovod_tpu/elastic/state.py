@@ -233,7 +233,8 @@ class _NotificationManager:
             from ..runner.http_kv import KVStoreClient
             from .. import runtime as _rt
             self._client = KVStoreClient(
-                addr, int(os.environ.get(ev.HVDTPU_RENDEZVOUS_PORT, "0")))
+                addr, int(os.environ.get(ev.HVDTPU_RENDEZVOUS_PORT, "0")),
+                secret=os.environ.get(ev.HVDTPU_SECRET) or None)
             self._seen_epoch = _rt._elastic_last_epoch
 
     def poll(self) -> None:

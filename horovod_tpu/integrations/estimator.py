@@ -87,7 +87,9 @@ class Estimator:
 
     def __init__(self, model, optimizer, loss: Callable, store: Store,
                  epochs: int = 5, batch_size: int = 32,
-                 run_id: Optional[str] = None, seed: int = 0):
+                 run_id: Optional[str] = None, seed: int = 0,
+                 feature_cols: Optional[list] = None,
+                 label_col: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -96,6 +98,29 @@ class Estimator:
         self.batch_size = batch_size
         self.run_id = run_id or "run"
         self.seed = seed
+        self.feature_cols = feature_cols
+        self.label_col = label_col
+
+    def _coerce(self, data):
+        """Accept an ``(x, y)`` array pair or a Spark DataFrame (reference:
+        ``KerasEstimator.fit(df)`` with feature_cols/label_cols params,
+        spark/keras/estimator.py:105 + spark/common/params.py)."""
+        try:
+            from pyspark.sql import DataFrame as SparkDataFrame
+        except ImportError:
+            return data
+        if not isinstance(data, SparkDataFrame):
+            return data
+        if not self.feature_cols or not self.label_col:
+            raise ValueError(
+                "fitting a Spark DataFrame requires feature_cols and "
+                "label_col (reference estimators require the same params)")
+        import numpy as np
+        pdf = data.select(*self.feature_cols, self.label_col).toPandas()
+        x = np.stack([np.asarray(pdf[c].to_list()) for c in
+                      self.feature_cols], axis=-1).astype(np.float32)
+        y = np.asarray(pdf[self.label_col].to_list())
+        return x, y
 
     def fit(self, data: Tuple[Any, Any]) -> EstimatorModel:
         import jax
@@ -108,7 +133,7 @@ class Estimator:
         if not hvd.is_initialized():
             hvd.init()
 
-        x, y = data
+        x, y = self._coerce(data)
         x = np.asarray(x)
         y = np.asarray(y)
         rng = jax.random.PRNGKey(self.seed)

@@ -34,6 +34,7 @@
 #include <unordered_set>
 
 #include "autotune.h"
+#include "sha256.h"
 #include "common.h"
 #include "data_plane.h"
 #include "message.h"
@@ -222,6 +223,10 @@ struct CoreConfig {
   std::string timeline_path;
   bool timeline_mark_cycles = false;
   double stall_warn_secs = 60.0;  // reference HOROVOD_STALL_CHECK_TIME
+  // Shared job secret (reference: runner/common/util/secret.py). When set,
+  // every HELLO must carry an HMAC proof; unauthenticated connections are
+  // rejected. Empty = auth disabled (un-launched / single-host debugging).
+  std::string secret;
   // Reference HOROVOD_STALL_SHUTDOWN_TIME: after this long stalled, break
   // the world instead of hanging forever. 0 disables (reference default).
   double stall_shutdown_secs = 0.0;
@@ -400,27 +405,70 @@ Status Core::Start() {
                                  std::to_string(cfg_.coord_port));
       }
       worker_fds_.assign(cfg_.size, -1);
-      for (int i = 0; i < cfg_.size - 1; ++i) {
+      int pending = cfg_.size - 1;
+      int rejects = 0;
+      // With auth enabled, every malformed / slow / unauthenticated / dup
+      // connection is rejected and accepting continues — a stray client
+      // must not be able to kill or join the job (reference: secret.py +
+      // authenticated driver_service). Without a secret a bad HELLO aborts
+      // loudly: it's a real peer bug, not an attack surface. Note: the
+      // proof binds (rank, host, port) but has no nonce — a same-boot
+      // replay of a captured HELLO is rejected only by the dup-rank check;
+      // full replay protection would need challenge-response.
+      const bool authed = !cfg_.secret.empty();
+      auto reject = [&](int fd, const char* why) -> bool {
+        LogWarn(cfg_.rank, "coordinator: rejecting connection (%s)", why);
+        CloseFd(fd);
+        return ++rejects <= 1000;
+      };
+      while (pending > 0) {
         int fd = TcpAccept(coord_listen_fd_);
         if (fd < 0) {
           return Status::Error(StatusCode::ABORTED, "coordinator: accept failed");
         }
+        if (authed && !Readable(fd, 10000)) {
+          if (reject(fd, "no HELLO within 10s")) continue;
+          return Status::Error(StatusCode::ABORTED,
+                               "coordinator: too many bad connections");
+        }
         std::vector<uint8_t> frame;
         if (RecvFrame(fd, &frame) != 0) {
+          if (authed && reject(fd, "hello recv failed")) continue;
           return Status::Error(StatusCode::ABORTED, "coordinator: hello failed");
         }
         Reader r(frame);
         if (static_cast<CtrlMsg>(r.I32()) != CtrlMsg::HELLO) {
+          if (authed && reject(fd, "not a HELLO frame")) continue;
           return Status::Error(StatusCode::ABORTED, "coordinator: bad hello");
         }
         int32_t rank = r.I32();
         std::string host = r.Str();
         int32_t port = r.I32();
+        if (authed) {
+          std::string proof = r.ok() && r.pos() < r.size() ? r.Str() : "";
+          std::string expect = HmacSha256Hex(
+              cfg_.secret, "hvdtpu-hello:" + std::to_string(rank) + ":" +
+                               host + ":" + std::to_string(port));
+          if (!r.ok() || !ConstTimeEquals(proof, expect)) {
+            if (reject(fd, "bad or missing secret proof")) continue;
+            return Status::Error(StatusCode::ABORTED,
+                                 "coordinator: too many unauthenticated "
+                                 "connection attempts");
+          }
+        }
         if (rank <= 0 || rank >= cfg_.size) {
+          if (authed && reject(fd, "rank out of range")) continue;
           return Status::Error(StatusCode::ABORTED, "coordinator: bad rank");
+        }
+        if (worker_fds_[rank] != -1) {
+          // Duplicate rank (double connect or HELLO replay): keep the first.
+          if (authed && reject(fd, "duplicate rank")) continue;
+          return Status::Error(StatusCode::ABORTED,
+                               "coordinator: duplicate rank in HELLO");
         }
         peers[rank] = {host, port};
         worker_fds_[rank] = fd;
+        --pending;
       }
       Writer w;
       w.I32(static_cast<int32_t>(CtrlMsg::PEERS));
@@ -447,6 +495,12 @@ Status Core::Start() {
       w.I32(cfg_.rank);
       w.Str(cfg_.my_host);
       w.I32(data_plane_.port());
+      if (!cfg_.secret.empty()) {
+        w.Str(HmacSha256Hex(
+            cfg_.secret, "hvdtpu-hello:" + std::to_string(cfg_.rank) + ":" +
+                             cfg_.my_host + ":" +
+                             std::to_string(data_plane_.port())));
+      }
       if (SendFrame(control_fd_, w.buffer()) != 0) {
         return Status::Error(StatusCode::ABORTED, "worker: hello send failed");
       }
@@ -1628,6 +1682,20 @@ long long hvdtpu_join(void* core) {
 // operations.cc:456-532 — here Python parses env and pushes values down).
 int hvdtpu_set_cache_capacity(void* core, long long capacity) {
   static_cast<Core*>(core)->mutable_config()->cache_capacity = capacity;
+  return 0;
+}
+
+int hvdtpu_hmac_hex(const char* key, const char* msg, char* out,
+                    int outlen) {
+  // Exposed for tests and the Python side's proof checks.
+  std::string hex = hvdtpu::HmacSha256Hex(key ? key : "", msg ? msg : "");
+  if (outlen < static_cast<int>(hex.size()) + 1) return -1;
+  std::memcpy(out, hex.c_str(), hex.size() + 1);
+  return 0;
+}
+
+int hvdtpu_set_secret(void* core, const char* secret) {
+  static_cast<Core*>(core)->mutable_config()->secret = secret ? secret : "";
   return 0;
 }
 

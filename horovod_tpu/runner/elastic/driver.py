@@ -23,11 +23,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import socket
 import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from ...utils import envvars as ev
 from ...utils import logging as log
 from .. import safe_exec
 from ..hosts import get_host_assignments
@@ -63,7 +65,14 @@ class ElasticDriver:
         self._command = command
         self._base_env = dict(env)
         self._verbose = verbose
-        self._kv = KVStoreServer()
+        # One consistent secret for the KV server AND every spawned worker
+        # (falling back to os.environ alone would let the server and the
+        # workers authenticate with different values).
+        self._secret = env.get(ev.HVDTPU_SECRET) or \
+            os.environ.get(ev.HVDTPU_SECRET)
+        if self._secret:
+            self._base_env[ev.HVDTPU_SECRET] = self._secret
+        self._kv = KVStoreServer(secret=self._secret)
         self._registry = WorkerStateRegistry()
         self._epoch = 0
         self._procs: Dict[str, safe_exec.WorkerProcess] = {}
@@ -189,14 +198,19 @@ class ElasticDriver:
             log.info("elastic: spawning %s", worker_id)
         if safe_exec.is_local_host(hostname):
             command = self._command
+            stdin_data = None
         else:
+            stdin_data = None
             # Remote slot: exec over SSH like the static launcher. The
             # controller port was allocated on the driver host — collisions on
             # the remote rank-0 host are possible but unlikely (ephemeral
             # range); rank 0 fails fast and re-rendezvouses if so.
             env["HVDTPU_RENDEZVOUS_ADDR"] = socket.gethostname()
             command = safe_exec.ssh_wrap(hostname, 22, env, self._command)
-        proc = safe_exec.WorkerProcess(command, env, worker_id)
+            if self._secret:
+                stdin_data = (self._secret + "\n").encode()
+        proc = safe_exec.WorkerProcess(command, env, worker_id,
+                                       stdin_data=stdin_data)
         self._procs[worker_id] = proc
         threading.Thread(target=self._watch, args=(worker_id, proc),
                          daemon=True).start()

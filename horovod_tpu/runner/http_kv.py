@@ -9,11 +9,22 @@ and workers poll with plain HTTP.
 
 from __future__ import annotations
 
+import hmac
+import hashlib
 import threading
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
+
+_AUTH_HEADER = "X-Hvdtpu-Auth"
+
+
+def _sign(secret: str, method: str, path: str, body: bytes) -> str:
+    """HMAC proof over the request (reference: secret.py + the HMAC'd
+    pickled-message protocol in common/service/driver_service.py)."""
+    msg = method.encode() + b"\n" + path.encode() + b"\n" + body
+    return hmac.new(secret.encode(), msg, hashlib.sha256).hexdigest()
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -23,7 +34,21 @@ class _KVHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence
         pass
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        secret = getattr(self.server, "kv_secret", None)
+        if not secret:
+            return True
+        proof = self.headers.get(_AUTH_HEADER, "")
+        expect = _sign(secret, self.command, self.path, body)
+        if hmac.compare_digest(proof, expect):
+            return True
+        self.send_response(403)
+        self.end_headers()
+        return False
+
     def do_GET(self):
+        if not self._authorized():
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             val = self.server.kv_store.get(self.path)  # type: ignore
         if val is None:
@@ -38,6 +63,8 @@ class _KVHandler(BaseHTTPRequestHandler):
     def do_PUT(self):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if not self._authorized(body):
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self.server.kv_store[self.path] = body  # type: ignore
         hook = getattr(self.server, "kv_put_hook", None)
@@ -47,6 +74,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self.server.kv_store.pop(self.path, None)  # type: ignore
         self.send_response(200)
@@ -59,11 +88,13 @@ class KVStoreServer:
     the reference uses the same mechanism to collect worker addresses
     (elastic/rendezvous.py:52)."""
 
-    def __init__(self, port: int = 0, put_hook=None):
+    def __init__(self, port: int = 0, put_hook=None,
+                 secret: Optional[str] = None):
         self._server = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._server.kv_store = {}  # type: ignore[attr-defined]
         self._server.kv_lock = threading.Lock()  # type: ignore[attr-defined]
         self._server.kv_put_hook = put_hook  # type: ignore[attr-defined]
+        self._server.kv_secret = secret  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -91,20 +122,31 @@ class KVStoreServer:
 
 
 class KVStoreClient:
-    """HTTP client for the KV store (reference: http_client.py)."""
+    """HTTP client for the KV store (reference: http_client.py). ``secret``
+    adds the HMAC proof header every request when the server authenticates."""
 
-    def __init__(self, addr: str, port: int, timeout: float = 10.0):
+    def __init__(self, addr: str, port: int, timeout: float = 10.0,
+                 secret: Optional[str] = None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
+        self._secret = secret
+
+    def _headers(self, method: str, key: str, body: bytes) -> dict:
+        if not self._secret:
+            return {}
+        return {_AUTH_HEADER: _sign(self._secret, method, key, body)}
 
     def put(self, key: str, value: bytes) -> None:
         req = urllib.request.Request(self._base + key, data=value,
-                                     method="PUT")
+                                     method="PUT",
+                                     headers=self._headers("PUT", key, value))
         urllib.request.urlopen(req, timeout=self._timeout).read()
 
     def get(self, key: str) -> Optional[bytes]:
         try:
-            return urllib.request.urlopen(self._base + key,
+            req = urllib.request.Request(
+                self._base + key, headers=self._headers("GET", key, b""))
+            return urllib.request.urlopen(req,
                                           timeout=self._timeout).read()
         except urllib.error.HTTPError as e:
             if e.code == 404:

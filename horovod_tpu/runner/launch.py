@@ -170,6 +170,13 @@ def _free_port() -> int:
 def _apply_tuning_env(env: dict, args) -> dict:
     """Forward the runtime tuning knobs shared by the static and elastic
     paths (reference: config_parser.py mapping CLI flags → HOROVOD_* env)."""
+    # One shared secret per job (reference: runner/common/util/secret.py,
+    # generated by the launcher and injected into every worker): the native
+    # controller and the HTTP KV store reject unauthenticated connections.
+    if not getattr(args, "_job_secret", None):
+        import secrets as _secrets
+        args._job_secret = os.environ.get(ev.HVDTPU_SECRET) or             _secrets.token_hex(16)
+    env[ev.HVDTPU_SECRET] = args._job_secret
     env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
     env[ev.HVDTPU_FUSION_THRESHOLD] = str(
         int(args.fusion_threshold_mb * 1024 * 1024))
@@ -257,21 +264,26 @@ def run_launcher(args: argparse.Namespace) -> int:
     controller_host = slots[0].hostname
     controller_port = args.start_port or _free_port()
 
-    commands, envs, names = [], [], []
+    commands, envs, names, stdins = [], [], [], []
     for slot in slots:
         env = _build_env(slot, args, controller_host, controller_port)
         if _is_local(slot.hostname):
             commands.append(list(args.command))
             envs.append(env)
+            stdins.append(None)
         else:
             commands.append(_ssh_wrap(slot.hostname, args.ssh_port, env,
                                       args.command))
             envs.append(dict(os.environ))
+            # Secret travels over ssh stdin, never the command line.
+            secret = env.get(ev.HVDTPU_SECRET)
+            stdins.append((secret + "\n").encode() if secret else None)
         names.append(f"rank{slot.rank}@{slot.hostname}")
         if args.verbose:
             print(f"hvdrun: {names[-1]}: {' '.join(commands[-1])}",
                   file=sys.stderr)
-    return safe_exec.run_workers(commands, envs, names, verbose=args.verbose)
+    return safe_exec.run_workers(commands, envs, names, verbose=args.verbose,
+                                 stdin_datas=stdins)
 
 
 def main(argv: List[str] = None) -> int:

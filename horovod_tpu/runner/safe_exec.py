@@ -19,10 +19,20 @@ from typing import Dict, List, Optional
 def ssh_wrap(host: str, ssh_port: int, env: Dict[str, str],
              command: List[str]) -> List[str]:
     """Build an SSH remote command with HVDTPU_* env forwarding
-    (reference: gloo_run.py get_remote_command)."""
+    (reference: gloo_run.py get_remote_command).
+
+    The job secret is deliberately NOT inlined — anything on the remote
+    command line is world-readable via ``ps``. When ``HVDTPU_SECRET`` is in
+    ``env``, the remote shell reads it from stdin instead; spawn the command
+    with ``WorkerProcess(..., stdin_data=secret + "\n")``.
+    """
     exports = " ".join(
-        f"{k}={v!r}" for k, v in env.items() if k.startswith("HVDTPU_"))
-    remote = f"cd {os.getcwd()!r} 2>/dev/null; env {exports} " + \
+        f"{k}={v!r}" for k, v in env.items()
+        if k.startswith("HVDTPU_") and k != "HVDTPU_SECRET")
+    prefix = ""
+    if env.get("HVDTPU_SECRET"):
+        prefix = "IFS= read -r HVDTPU_SECRET; export HVDTPU_SECRET; "
+    remote = f"cd {os.getcwd()!r} 2>/dev/null; {prefix}env {exports} " + \
         " ".join(command)
     return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port),
             host, remote]
@@ -35,11 +45,19 @@ def is_local_host(host: str) -> bool:
 
 class WorkerProcess:
     def __init__(self, cmd: List[str], env: Dict[str, str], name: str,
-                 stdout=None, stderr=None):
+                 stdout=None, stderr=None, stdin_data: Optional[bytes] = None):
         self.name = name
         self.proc = subprocess.Popen(
             cmd, env=env, stdout=stdout, stderr=stderr,
+            stdin=subprocess.PIPE if stdin_data is not None else None,
             start_new_session=True)  # own process group
+        if stdin_data is not None:
+            try:
+                self.proc.stdin.write(stdin_data)
+                self.proc.stdin.flush()
+                self.proc.stdin.close()
+            except OSError:
+                pass  # worker died instantly; wait() will surface it
 
     def poll(self) -> Optional[int]:
         return self.proc.poll()
@@ -66,11 +84,15 @@ class WorkerProcess:
 
 
 def run_workers(commands: List[List[str]], envs: List[Dict[str, str]],
-                names: List[str], verbose: bool = False) -> int:
+                names: List[str], verbose: bool = False,
+                stdin_datas: Optional[List[Optional[bytes]]] = None) -> int:
     """Run all workers; if any exits non-zero, terminate the rest
     (reference: gloo_run.py launch_gloo thread-per-worker exec)."""
-    workers = [WorkerProcess(cmd, env, name)
-               for cmd, env, name in zip(commands, envs, names)]
+    if stdin_datas is None:
+        stdin_datas = [None] * len(commands)
+    workers = [WorkerProcess(cmd, env, name, stdin_data=sd)
+               for cmd, env, name, sd in zip(commands, envs, names,
+                                             stdin_datas)]
     first_failure: List[int] = []
 
     def watch(w: WorkerProcess):

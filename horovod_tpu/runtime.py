@@ -122,6 +122,8 @@ class _SingleRankCore:
 
     def fusion_threshold(self):
         return 0
+
+
 _init_kwargs: dict = {}
 
 
@@ -152,7 +154,8 @@ def _elastic_assignment() -> Optional[dict]:
     from .runner.http_kv import KVStoreClient
     port = ev.get_int(ev.HVDTPU_RENDEZVOUS_PORT, 0)
     worker_id = ev.get_str("HVDTPU_WORKER_ID")
-    client = KVStoreClient(addr, port)
+    client = KVStoreClient(addr, port,
+                           secret=ev.get_str(ev.HVDTPU_SECRET) or None)
     timeout = ev.get_float(ev.HVDTPU_ELASTIC_TIMEOUT, 600.0)
     deadline = _time.monotonic() + timeout
     missing_since = None

@@ -25,6 +25,7 @@ HVDTPU_LOCAL_SIZE = "HVDTPU_LOCAL_SIZE"
 HVDTPU_CROSS_RANK = "HVDTPU_CROSS_RANK"
 HVDTPU_CROSS_SIZE = "HVDTPU_CROSS_SIZE"
 HVDTPU_HOSTNAME = "HVDTPU_HOSTNAME"
+HVDTPU_SECRET = "HVDTPU_SECRET"  # shared job secret (reference: secret.py)
 HVDTPU_RENDEZVOUS_ADDR = "HVDTPU_RENDEZVOUS_ADDR"
 HVDTPU_RENDEZVOUS_PORT = "HVDTPU_RENDEZVOUS_PORT"
 HVDTPU_CONTROLLER_ADDR = "HVDTPU_CONTROLLER_ADDR"

@@ -4,6 +4,7 @@ scripts driven by elastic_common.py)."""
 
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -11,6 +12,9 @@ import horovod_tpu as hvd
 
 RESULT_FILE = os.environ["ELASTIC_RESULT_FILE"]
 TARGET = int(os.environ.get("ELASTIC_TARGET_BATCHES", "12"))
+# Pace the loop so membership changes land mid-run deterministically
+# (tests that grow/shrink the world race the training loop otherwise).
+BATCH_SLEEP = float(os.environ.get("ELASTIC_BATCH_SLEEP", "0"))
 CRASH_AT = os.environ.get("ELASTIC_CRASH_AT")  # "worker_id:batch"
 CRASH_MARKER = os.environ.get("ELASTIC_CRASH_MARKER", "")
 
@@ -34,6 +38,8 @@ def train(state):
         state.total += float(np.asarray(out)[0])  # == size at that step
         state.batches += 1
         state.commit()
+        if BATCH_SLEEP:
+            time.sleep(BATCH_SLEEP)
     return hvd.size()
 
 

@@ -1,0 +1,31 @@
+"""Worker: drives horovod_tpu.spark._spark_task directly (no Spark) — the
+same rendezvous + controller bootstrap a Spark executor would run.
+Args: <rank> <num_proc> <kv_port>."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np  # noqa: E402
+
+rank, num_proc, kv_port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+import pickle  # noqa: E402
+
+from horovod_tpu.spark import _spark_task  # noqa: E402
+
+
+def train():
+    import horovod_tpu as hvd
+    assert hvd.size() == num_proc
+    out = hvd.allreduce(np.full((4,), float(hvd.rank()), np.float32),
+                        name="spark.t", op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((4,), float(sum(range(num_proc)))))
+    return ("rank", hvd.rank())
+
+
+payload = pickle.dumps((train, (), {}))
+got_rank, result = _spark_task(rank, num_proc, "127.0.0.1", kv_port,
+                               payload, start_timeout=60.0, env=None)
+assert got_rank == rank and result == ("rank", rank), (got_rank, result)
+print("ALL OK")

@@ -91,7 +91,8 @@ class TestElasticIntegration:
         """Host added mid-run: workers reset at commit and finish at size 3
         (reference: elastic_common.py:118 hosts added/removed)."""
         script, hosts_file = _write_discovery(tmp_path, "localhost:2\n")
-        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="40")
+        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="40",
+                        ELASTIC_BATCH_SLEEP="0.2")
         settings = ElasticSettings(min_np=2, max_np=3,
                                    discovery_interval_s=0.3,
                                    elastic_timeout_s=60)

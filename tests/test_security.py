@@ -1,0 +1,126 @@
+"""Control-plane authentication tests (round-1 verdict #7; reference:
+horovod/runner/common/util/secret.py + common/service/driver_service.py —
+driver/task and KV traffic authenticated with a launcher-injected shared
+secret)."""
+
+import os
+import socket
+import struct
+import threading
+import urllib.error
+
+import pytest
+
+from conftest import assert_all_ok, launch_world
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "data", "proc_worker.py")
+
+
+class TestKVAuth:
+    def _server(self, secret):
+        from horovod_tpu.runner.http_kv import KVStoreServer
+        server = KVStoreServer(port=0, secret=secret)
+        server.start()
+        return server
+
+    def test_authenticated_roundtrip(self):
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        server = self._server("s3cret")
+        try:
+            client = KVStoreClient("127.0.0.1", server.port, secret="s3cret")
+            client.put("/k", b"v")
+            assert client.get("/k") == b"v"
+        finally:
+            server.stop()
+
+    def test_missing_secret_rejected(self):
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        server = self._server("s3cret")
+        try:
+            bare = KVStoreClient("127.0.0.1", server.port)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                bare.put("/k", b"v")
+            assert e.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as e:
+                bare.get("/k")
+            assert e.value.code == 403
+        finally:
+            server.stop()
+
+    def test_wrong_secret_rejected(self):
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        server = self._server("s3cret")
+        try:
+            bad = KVStoreClient("127.0.0.1", server.port, secret="wrong")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                bad.get("/k")
+            assert e.value.code == 403
+        finally:
+            server.stop()
+
+    def test_no_secret_server_is_open(self):
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        server = self._server(None)
+        try:
+            client = KVStoreClient("127.0.0.1", server.port)
+            client.put("/k", b"v")
+            assert client.get("/k") == b"v"
+        finally:
+            server.stop()
+
+
+def _frame(payload: bytes) -> bytes:
+    # SendFrame wire format: u64 length prefix (native/socket_util.cpp:117).
+    return struct.pack("<Q", len(payload)) + payload
+
+
+def _rogue_hello(port: int, stop: threading.Event):
+    """Keep sending unauthenticated HELLO frames at the coordinator: rank 1,
+    no secret proof. An unauthenticated controller would accept this as the
+    real rank 1 and the job would break."""
+    payload = (struct.pack("<i", 1)            # CtrlMsg::HELLO
+               + struct.pack("<i", 1)          # rank 1
+               + struct.pack("<q", 9) + b"127.0.0.1"
+               + struct.pack("<i", 1))         # bogus data-plane port
+    while not stop.is_set():
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=0.5)
+            s.sendall(_frame(payload))
+            s.settimeout(0.5)
+            try:
+                s.recv(64)
+            except OSError:
+                pass
+            s.close()
+        except OSError:
+            pass
+        stop.wait(0.05)
+
+
+def test_world_with_secret_and_rogue_connection():
+    """A full 2-rank world with HVDTPU_SECRET set completes while a rogue
+    unauthenticated client hammers the controller port with fake HELLOs —
+    the coordinator must reject them and keep accepting (verdict #7 done
+    criterion: unauthenticated connection rejected, tested)."""
+    from conftest import free_port
+    port = free_port()
+    stop = threading.Event()
+    rogue = threading.Thread(target=_rogue_hello, args=(port, stop),
+                             daemon=True)
+    rogue.start()
+    try:
+        results = launch_world(
+            2, WORKER,
+            extra_env={"HVDTPU_SECRET": "job-secret-123",
+                       "HVDTPU_CONTROLLER_PORT": str(port)})
+        assert_all_ok(results)
+    finally:
+        stop.set()
+        rogue.join(timeout=2)
+
+
+def test_world_with_secret_plain():
+    results = launch_world(2, WORKER,
+                           extra_env={"HVDTPU_SECRET": "another-secret"})
+    assert_all_ok(results)

@@ -1,0 +1,92 @@
+"""Spark integration tests (reference: test/test_spark.py — local Spark
+session; here pyspark-gated with a sparkless rendezvous drive that exercises
+the same task body)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import subprocess_env
+
+def _has_pyspark() -> bool:
+    try:
+        import pyspark  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "data", "spark_task_worker.py")
+
+
+class TestRankLayout:
+    def test_single_host(self):
+        from horovod_tpu.spark import _rank_layout
+        hosts = ["a", "a", "a"]
+        assert _rank_layout(hosts, 0) == (0, 3, 0, 1)
+        assert _rank_layout(hosts, 2) == (2, 3, 0, 1)
+
+    def test_two_hosts(self):
+        from horovod_tpu.spark import _rank_layout
+        hosts = ["a", "b", "a", "b"]
+        assert _rank_layout(hosts, 0) == (0, 2, 0, 2)
+        assert _rank_layout(hosts, 1) == (0, 2, 1, 2)
+        assert _rank_layout(hosts, 2) == (1, 2, 0, 2)
+        assert _rank_layout(hosts, 3) == (1, 2, 1, 2)
+
+
+def test_spark_task_rendezvous_without_spark():
+    """The exact task body Spark executors run, driven as subprocesses
+    against a local KV server: register → rank layout → controller bootstrap
+    → collective → result (reference flow: spark/runner.py:195)."""
+    from horovod_tpu.runner.http_kv import KVStoreServer
+
+    server = KVStoreServer(port=0)
+    server.start()
+    try:
+        n = 2
+        procs = [subprocess.Popen(
+            [sys.executable, WORKER, str(r), str(n), str(server.port)],
+            env=subprocess_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for r in range(n)]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"rank {r}:\n{err}\n{out}"
+            assert "ALL OK" in out
+    finally:
+        server.stop()
+
+
+def test_run_without_pyspark_raises():
+    if _has_pyspark():
+        pytest.skip("pyspark installed")
+    import horovod_tpu.spark as hs
+    with pytest.raises(ImportError, match="pyspark"):
+        hs.run(lambda: None, num_proc=2)
+
+
+def test_run_elastic_not_implemented():
+    import horovod_tpu.spark as hs
+    with pytest.raises(NotImplementedError):
+        hs.run_elastic()
+
+
+@pytest.mark.skipif(not _has_pyspark(), reason="pyspark not installed")
+def test_spark_run_end_to_end():
+    from pyspark.sql import SparkSession
+    import horovod_tpu.spark as hs
+
+    spark = (SparkSession.builder.master("local[2]")
+             .appName("hvdtpu-test").getOrCreate())
+    try:
+        def train():
+            import horovod_tpu as hvd
+            return hvd.rank(), hvd.size()
+
+        results = hs.run(train, num_proc=2)
+        assert results == [(0, 2), (1, 2)]
+    finally:
+        spark.stop()
