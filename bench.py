@@ -27,8 +27,10 @@ IMAGE_SIZE = int(os.environ.get("HVDTPU_BENCH_IMAGE", 224))
 WARMUP = int(os.environ.get("HVDTPU_BENCH_WARMUP", 5))
 ITERS = int(os.environ.get("HVDTPU_BENCH_ITERS", 20))
 
-# ResNet-50 fwd ≈ 4.1e9 FLOPs/image @224 (MAC=2); training ≈ 3x fwd. Used only
-# when XLA cost analysis is unavailable.
+# ResNet-50 fwd ≈ 4.1e9 FLOPs/image @224 (MAC=2); training ≈ 3x fwd. This is
+# the ground truth the XLA cost analysis is cross-checked against (round-2
+# verdict #1: cost_analysis() on the experimental axon backend reported ~2x
+# this, producing an impossible mfu=246%).
 ANALYTIC_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
 
 _TRANSIENT_MARKERS = (
@@ -90,12 +92,33 @@ def _per_chip_flops(compiled) -> float:
         return None
 
 
+def _fence(jax, out):
+    """Force a real device->host value fetch of one element of ``out``.
+
+    ``jax.block_until_ready`` proved unreliable on the remote axon backend
+    (round-2 verdict #1: it returned before execution finished, yielding
+    physically impossible throughput). A literal value transfer cannot
+    complete before the producing computation has, and device execution is
+    in-order, so fetching from the *last* enqueued result fences the chain.
+    """
+    import numpy as np
+    leaf = jax.tree.leaves(out)[0]
+    if hasattr(leaf, "reshape") and getattr(leaf, "size", 1) > 1:
+        leaf = leaf.reshape(-1)[:1]  # tiny on-device slice, tiny transfer
+    return np.asarray(jax.device_get(leaf))
+
+
 def _microbench(hvd, jnp, jax):
     """Collective op wall times at 1MB-256MB (fp32), per VERDICT round-1 #3:
-    perf regressions in the collective hot paths must be visible."""
+    perf regressions in the collective hot paths must be visible.
+
+    At world size 1 these are DISPATCH-OVERHEAD canaries, not fabric
+    measurements (a 1-chip psum moves no bytes), so gbps is only reported
+    for world size > 1 (round-2 verdict #10)."""
     from horovod_tpu.compression import compressed_allreduce, make_compressor
 
     results = []
+    n = hvd.size()
     compressor = make_compressor("maxmin", bits=4)
     for nbytes in (1 << 20, 16 << 20, 256 << 20):
         nelem = nbytes // 4
@@ -110,25 +133,37 @@ def _microbench(hvd, jnp, jax):
             if name != "allreduce" and nbytes > (16 << 20):
                 continue  # allgather/compressed outputs scale with world size
             try:
-                jax.block_until_ready(fn())  # warm the program cache
+                _fence(jax, fn())  # warm the program cache
                 reps = 5
                 t0 = time.perf_counter()
                 for _ in range(reps):
                     out = fn()
-                jax.block_until_ready(out)
+                _fence(jax, out)
                 dt = (time.perf_counter() - t0) / reps
-                results.append({"op": name, "mbytes": nbytes >> 20,
-                                "ms": round(dt * 1e3, 3),
-                                "gbps": round(nbytes / dt / 1e9, 2)})
+                entry = {"op": name, "mbytes": nbytes >> 20,
+                         "ms": round(dt * 1e3, 3)}
+                if n > 1:
+                    entry["gbps"] = round(nbytes / dt / 1e9, 2)
+                results.append(entry)
             except Exception as exc:
                 results.append({"op": name, "mbytes": nbytes >> 20,
                                 "error": f"{type(exc).__name__}: "
                                          f"{str(exc)[:120]}"})
-    return results
+    return {"world_size": n,
+            "note": ("dispatch-bound: world size 1 moves no fabric bytes; "
+                     "ms is per-call overhead, a regression canary only")
+            if n == 1 else "per-op wall time across the fabric",
+            "ops": results}
 
 
 def _run():
     import jax
+    # Local-validation escape hatch: the axon sitecustomize force-overrides
+    # jax_platforms, so plain JAX_PLATFORMS=cpu is ignored. The driver does
+    # not set this knob — it benches the real chip.
+    if os.environ.get("HVDTPU_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms",
+                          os.environ["HVDTPU_BENCH_PLATFORM"])
     import jax.numpy as jnp
     import numpy as np  # noqa: F401
     import optax
@@ -195,29 +230,39 @@ def _run():
         for _ in range(WARMUP):
             params, batch_stats, opt_state, loss = compiled(
                 params, batch_stats, opt_state, batch)
-        jax.block_until_ready(loss)
+        _fence(jax, loss)
 
     _with_retries(warm, "warmup")
 
+    # Each step consumes the previous step's (donated) params, so the final
+    # loss transitively depends on every step; fetching its value fences the
+    # whole chain even on backends whose block_until_ready lies (_fence doc).
     t0 = time.perf_counter()
     for _ in range(ITERS):
         params, batch_stats, opt_state, loss = compiled(
             params, batch_stats, opt_state, batch)
-    jax.block_until_ready(loss)
+    loss_value = float(_fence(jax, loss).reshape(()))
     dt = time.perf_counter() - t0
 
     images_per_sec = global_batch * ITERS / dt
     per_chip = images_per_sec / n
 
-    if flops_per_chip is None:
-        flops_per_chip = ANALYTIC_TRAIN_FLOPS_PER_IMAGE * global_batch / n
+    # FLOPs: cross-check XLA cost analysis against the analytic ResNet-50
+    # number; the analytic value wins when they disagree badly (the axon
+    # backend's cost analysis reported ~2x reality in round 2).
+    analytic_flops = ANALYTIC_TRAIN_FLOPS_PER_IMAGE * global_batch / n
+    flops_source = "cost_analysis"
+    if flops_per_chip is None or not (
+            0.5 * analytic_flops <= flops_per_chip <= 1.5 * analytic_flops):
+        flops_per_chip = analytic_flops
+        flops_source = "analytic"
     peak = _peak_flops_per_chip(jax.devices()[0])
     achieved = flops_per_chip * ITERS / dt
     mfu = round(achieved / peak, 4) if peak else None
 
     micro = _microbench(hvd, jnp, jax)
 
-    return {
+    result = {
         "metric": "ResNet-50 synthetic training throughput per chip "
                   f"(bf16, bs={BATCH_PER_CHIP}/chip, {n} chip(s))",
         "value": round(per_chip, 2),
@@ -225,9 +270,18 @@ def _run():
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
         "mfu": mfu,
         "flops_per_step_per_chip": flops_per_chip,
+        "flops_source": flops_source,
+        "loss": loss_value,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         "microbench": micro,
     }
+    if mfu is not None and mfu > 1.0:
+        # >100% of peak is physically impossible: the measurement is broken
+        # (timing not fenced or FLOPs overcounted). Never report it as real.
+        result["error"] = (
+            f"mfu={mfu} exceeds 1.0 — measurement invalid (achieved "
+            f"{achieved / 1e12:.1f} TFLOP/s vs {peak / 1e12:.0f} peak)")
+    return result
 
 
 def _arm_watchdog():

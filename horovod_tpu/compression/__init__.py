@@ -84,5 +84,6 @@ from .quantize import (MaxMinQuantizer, NormalizedQuantizer,  # noqa: E402
                        DEFAULT_BUCKET_SIZE)
 from .error_feedback import (init_error_feedback,  # noqa: E402
                              compress_with_feedback)
-from .reducers import compressed_allreduce  # noqa: E402
+from .reducers import (compressed_allreduce,  # noqa: E402
+                       compressed_grouped_allreduce)
 from .config import CompressionConfig, make_compressor, from_env  # noqa: E402

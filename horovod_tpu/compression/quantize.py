@@ -101,6 +101,20 @@ class MaxMinQuantizer:
         self.stochastic = stochastic
         self._use_pallas = use_pallas
 
+    # Equal-config quantizers hash equal so the eager compiled-program cache
+    # (reducers._eager_compressed_fn) dedupes across instances — the TPU
+    # analog of the reference keying reducers off env config, not objects.
+    def _cache_key(self):
+        return ("maxmin", self.bits, self.bucket_size, self.stochastic,
+                self._use_pallas)
+
+    def __hash__(self):
+        return hash(self._cache_key())
+
+    def __eq__(self, other):
+        return isinstance(other, MaxMinQuantizer) and \
+            other._cache_key() == self._cache_key()
+
     def _pallas_enabled(self) -> bool:
         if self._use_pallas is not None:
             return self._use_pallas
@@ -196,6 +210,20 @@ class NormalizedQuantizer:
         self.kind = levels
         self.norm = norm
 
+    def _cache_key(self):
+        # The user level table is part of identity: set_quantization_levels
+        # must invalidate cached compiled programs that baked the old table.
+        lv = _user_levels.get(self.kind)
+        return ("norm", self.bits, self.bucket_size, self.kind, self.norm,
+                None if lv is None else lv.tobytes())
+
+    def __hash__(self):
+        return hash(self._cache_key())
+
+    def __eq__(self, other):
+        return isinstance(other, NormalizedQuantizer) and \
+            other._cache_key() == self._cache_key()
+
     def _levels(self) -> jnp.ndarray:
         levels = default_levels(self.bits, self.kind)
         max_levels = 1 << (self.bits - 1)
@@ -252,6 +280,16 @@ class TopKCompressor:
         if not 0 < ratio <= 1:
             raise ValueError("ratio must be in (0, 1]")
         self.ratio = ratio
+
+    def _cache_key(self):
+        return ("topk", self.ratio)
+
+    def __hash__(self):
+        return hash(self._cache_key())
+
+    def __eq__(self, other):
+        return isinstance(other, TopKCompressor) and \
+            other._cache_key() == self._cache_key()
 
     def compress(self, x: jnp.ndarray, key=None):
         ctx = QuantContext(tuple(x.shape), x.dtype,

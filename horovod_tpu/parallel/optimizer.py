@@ -153,7 +153,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
     def _compressed_reduce(grads, residuals):
         from ..compression import Compressor
-        from ..compression.reducers import compressed_allreduce
+        from ..compression.reducers import compressed_grouped_allreduce
         if op == C.ReduceOp.ADASUM:
             raise ValueError(
                 "op=Adasum is not supported with quantized compression "
@@ -162,7 +162,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
         res_leaves = (jax.tree.leaves(residuals) if residuals is not None
                       else [None] * len(flat))
-        outs, new_res = [], []
+        outs = [None] * len(flat)
+        new_res = [None] * len(flat)
         ax = axis if axis is not None else runtime.dp_axis()
         # Same scaling semantics as the dense path (_reduce).
         eff_op = op
@@ -174,7 +175,13 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             pre_f = gradient_predivide_factor / n
             post_f = 1.0 / gradient_predivide_factor
             eff_op = C.ReduceOp.SUM
-        for (path, g), r in zip(flat, res_leaves):
+
+        # Partition leaves: dense / wire-compressed per leaf, quantized leaves
+        # grouped by compressor config and FUSED into one buffer per group
+        # (reference: CompressionMode::Fused, common.h:164-168 — hundreds of
+        # small layers must not pay per-tensor bucket metadata + dispatch).
+        groups: dict = {}  # compressor -> list of leaf indices
+        for i, ((path, g), r) in enumerate(zip(flat, res_leaves)):
             comp = comp_cfg.for_name(_leaf_name(path))
             if comp is not None and C.in_named_trace(axis) and \
                     C._dp_invariant(g, ax):
@@ -187,24 +194,31 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             wire_comp = isinstance(comp, type) and issubclass(comp, Compressor)
             if comp is None or wire_comp:
                 # Dense (or dtype-cast wire compression): plain allreduce.
-                outs.append(C.allreduce(g, name=f"g/{_leaf_name(path)}",
-                                        op=eff_op, prescale_factor=pre_f,
-                                        postscale_factor=post_f,
-                                        compression=comp, axis=axis))
-                new_res.append(r if r is not None else None)
+                outs[i] = C.allreduce(g, name=f"g/{_leaf_name(path)}",
+                                      op=eff_op, prescale_factor=pre_f,
+                                      postscale_factor=post_f,
+                                      compression=comp, axis=axis)
+                new_res[i] = r
             else:
-                g_in = g if pre_f == 1.0 else g * jnp.asarray(pre_f, g.dtype)
-                result = compressed_allreduce(g_in, comp,
-                                              reduction=comp_cfg.reduction,
-                                              op=eff_op, axis=axis, residual=r)
-                if r is not None:
-                    out, nr = result
-                else:
-                    out, nr = result, None
-                if post_f != 1.0:
-                    out = out * jnp.asarray(post_f, out.dtype)
-                outs.append(out)
-                new_res.append(nr)
+                groups.setdefault(comp, []).append(i)
+
+        for comp, idxs in groups.items():
+            g_leaves = [flat[i][1] for i in idxs]
+            r_leaves = ([res_leaves[i] for i in idxs]
+                        if residuals is not None else None)
+            result = compressed_grouped_allreduce(
+                tuple(g_leaves), comp, reduction=comp_cfg.reduction,
+                op=eff_op, axis=axis, residuals=None if r_leaves is None
+                else tuple(r_leaves), prescale_factor=pre_f,
+                postscale_factor=post_f)
+            if residuals is not None:
+                red, nres = result
+                for i, o, nr in zip(idxs, red, nres):
+                    outs[i], new_res[i] = o, nr
+            else:
+                for i, o in zip(idxs, result):
+                    outs[i] = o
+
         unflatten = jax.tree_util.tree_unflatten
         grads_out = unflatten(jax.tree.structure(grads), outs)
         res_out = (unflatten(jax.tree.structure(grads), new_res)

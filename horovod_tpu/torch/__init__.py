@@ -32,6 +32,7 @@ from ..runtime import (init, shutdown, is_initialized, rank, size, local_rank,
                        start_timeline, stop_timeline)
 from .optimizer import DistributedOptimizer
 from .compression import Compression
+from .sync_batch_norm import SyncBatchNorm
 from . import elastic
 
 __all__ = [
@@ -45,6 +46,7 @@ __all__ = [
     "join", "poll", "synchronize",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer", "Compression",
+    "SyncBatchNorm",
 ]
 
 
@@ -195,20 +197,26 @@ def allreduce_(tensor: torch.Tensor, name: Optional[str] = None,
 
 
 def allreduce_async(tensor: torch.Tensor, name: Optional[str] = None,
-                    op: ReduceOp = Average) -> int:
+                    op: ReduceOp = Average, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
     """Reference: ``hvd.allreduce_async`` (torch/mpi_ops.py:132)."""
     like = tensor
     return _async_op("allreduce", tensor, name,
-                     lambda a: _to_torch(a.reshape(like.shape), like), op=op)
+                     lambda a: _to_torch(a.reshape(like.shape), like), op=op,
+                     prescale_factor=prescale_factor,
+                     postscale_factor=postscale_factor)
 
 
 def allreduce_async_(tensor: torch.Tensor, name: Optional[str] = None,
-                     op: ReduceOp = Average) -> int:
+                     op: ReduceOp = Average, prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
     """In-place async allreduce (reference: torch/mpi_ops.py:225)."""
     def finish(a):
         tensor.copy_(_to_torch(a.reshape(tensor.shape), tensor))
         return tensor
-    return _async_op("allreduce", tensor, name, finish, op=op)
+    return _async_op("allreduce", tensor, name, finish, op=op,
+                     prescale_factor=prescale_factor,
+                     postscale_factor=postscale_factor)
 
 
 def allgather(tensor: torch.Tensor,

@@ -21,7 +21,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def __init__(self, params, named_parameters=None,
                  compression=Compression.none,
                  backward_passes_per_step: int = 1,
-                 op=None):
+                 op=None, gradient_predivide_factor: float = 1.0):
         super(self.__class__, self).__init__(params)
         from . import Average, allreduce_async, synchronize as _sync, size
 
@@ -32,6 +32,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         }
         self._compression = compression
         self._op = op if op is not None else Average
+        if gradient_predivide_factor != 1.0 and self._op != Average:
+            # Reference: optimizer.py:388-392 — predivide splits the
+            # averaging factor, so it only makes sense for op=Average.
+            raise ValueError("gradient_predivide_factor not supported "
+                             "with op != Average")
+        self.gradient_predivide_factor = gradient_predivide_factor
         self.backward_passes_per_step = backward_passes_per_step
 
         # Deterministic index-based names for every param (reference naming:
@@ -44,7 +50,31 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             for pi, p in enumerate(group["params"]):
                 self._param_names[id(p)] = f"allreduce.noname.{gi}.{pi}"
         if named_parameters is not None:
-            for name, p in list(named_parameters):
+            named_parameters = list(named_parameters)
+            if any(not isinstance(nv, tuple) or len(nv) != 2
+                   for nv in named_parameters):
+                raise ValueError(
+                    "named_parameters should be a sequence of tuples "
+                    "(name, parameter), usually produced by "
+                    "model.named_parameters()")
+            # Duplicate names would alias collectives and corrupt the
+            # negotiation (reference: optimizer.py:49-63 find_duplicates).
+            seen, dups = set(), set()
+            for name, _ in named_parameters:
+                (dups if name in seen else seen).add(name)
+            if dups:
+                raise ValueError(
+                    "Parameter names in named_parameters must be unique. "
+                    "Found duplicates: %s" % ", ".join(sorted(dups)))
+            all_ids = {id(p) for g in self.param_groups for p in g["params"]}
+            named_ids = {id(p) for _, p in named_parameters}
+            unnamed = all_ids - named_ids
+            if unnamed:
+                raise ValueError(
+                    "named_parameters was specified, but one or more model "
+                    "parameters were not named. Python object ids: "
+                    + ", ".join(str(i) for i in sorted(unnamed)))
+            for name, p in named_parameters:
                 self._param_names[id(p)] = name
 
         self._handles = {}           # param -> (handle, ctx)
@@ -53,6 +83,25 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._synchronized = False
         self._should_synchronize = True
         self._register_hooks()
+
+    def load_state_dict(self, *args, **kwargs):
+        """Reset accumulation/handle bookkeeping on checkpoint reload
+        (reference: optimizer.py:81-89) — stale ``_allreduce_delay`` counters
+        from the pre-reload run would desynchronize ranks and hang the next
+        accumulation window."""
+        self._handles = {}
+        self._synchronized = False
+        self._should_synchronize = True
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = self.backward_passes_per_step
+        super(self.__class__, self).load_state_dict(*args, **kwargs)
+
+    def set_backward_passes_per_step(self, passes: int) -> None:
+        """Change the accumulation window mid-training
+        (reference: optimizer.py:99-102)."""
+        self.backward_passes_per_step = passes
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = self.backward_passes_per_step
 
     # -- hooks -------------------------------------------------------------
 
@@ -102,9 +151,26 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # from the param's — decompression back into p.grad happens in
         # synchronize().
         compressed, ctx = self._compression.compress(p.grad)
+        if self._op == self._hvd_average() and \
+                self.gradient_predivide_factor != 1.0:
+            # Split the averaging across pre/postscale (reference:
+            # optimizer.py:120-128): grads are predivided by f before the
+            # sum and the 1/size average is re-multiplied by f after —
+            # numerically safer for large world sizes / small grads.
+            pre = 1.0 / self.gradient_predivide_factor
+            post = self.gradient_predivide_factor
+        else:
+            pre = post = 1.0
         handle = self._hvd["allreduce_async"](compressed, name=name,
-                                              op=self._op)
+                                              op=self._op,
+                                              prescale_factor=pre,
+                                              postscale_factor=post)
         return handle, ctx
+
+    @staticmethod
+    def _hvd_average():
+        from . import Average
+        return Average
 
     # -- synchronization ---------------------------------------------------
 
@@ -167,11 +233,13 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
-                         op=None) -> torch.optim.Optimizer:
+                         op=None,
+                         gradient_predivide_factor: float = 1.0
+                         ) -> torch.optim.Optimizer:
     """Wrap a torch optimizer so gradients are averaged across ranks during
     ``backward()`` (reference factory: optimizer.py:383 — same dynamic
     subclassing so ``isinstance(opt, type(inner))`` keeps working)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, op)
+               backward_passes_per_step, op, gradient_predivide_factor)

@@ -1,0 +1,133 @@
+"""Synchronized BatchNorm for torch modules: normalize over the GLOBAL batch.
+
+Reference: ``horovod/torch/sync_batch_norm.py:39`` — a hand-rolled
+allgather/allreduce-based SyncBN (statistics gathered across ranks in
+forward, gradient sums allreduced in backward). The reference leans on
+CUDA-only ``torch.batch_norm_stats``/``batch_norm_gather_stats_with_counts``
+kernels; this implementation computes the same math with plain tensor ops so
+it runs on CPU tensors feeding the TPU-native collective plane.
+
+Math (per channel c, over the global batch of N elements), two-pass so the
+variance is cancellation-free in float32 (the collective plane's wire dtype —
+E[x^2]-mean^2 loses all precision for large-mean activations):
+    pass 1:  allreduce [sum(x), count]          -> global mean
+    pass 2:  allreduce sum((x-mean)^2)          -> exact global var
+    backward: dx = w*invstd * (dy - mean(dy) - (x-mean)*invstd^2 *
+              mean(dy*(x-mean)))  with mean(.) over the global batch —
+              one allreduce of [sum(dy), sum(dy*(x-mean))].
+Weight/bias gradients stay local (the DistributedOptimizer averages them,
+matching the reference's division of labor).
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+
+def _channel_sums(t: torch.Tensor) -> torch.Tensor:
+    """Sum over every dim except channel (dim 1)."""
+    dims = [0] + list(range(2, t.dim()))
+    return t.sum(dim=dims)
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var,
+                eps, momentum):
+        from . import Sum, allreduce
+
+        c = input.size(1)
+        count_local = input.numel() // c
+        x32 = input.float()
+
+        stats = torch.empty(c + 1, dtype=torch.float32)
+        stats[:c] = _channel_sums(x32)
+        stats[c] = float(count_local)
+        stats = allreduce(stats, op=Sum, name="sync_batch_norm.mean")
+        count = stats[c].item()
+        mean = (stats[:c] / count).to(input.dtype)
+
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xmu32 = x32 - mean.float().view(shape)
+        sqsum = allreduce(_channel_sums(xmu32 * xmu32), op=Sum,
+                          name="sync_batch_norm.var")
+        var = (sqsum / count).clamp(min=0.0).to(input.dtype)
+        invstd = torch.rsqrt(var + eps)
+
+        if running_mean is not None:
+            unbiased = var * (count / max(count - 1, 1))
+            running_mean.mul_(1 - momentum).add_(mean.detach(),
+                                                 alpha=momentum)
+            running_var.mul_(1 - momentum).add_(unbiased.detach(),
+                                                alpha=momentum)
+
+        xhat = (input - mean.view(shape)) * invstd.view(shape)
+        out = xhat * weight.view(shape) + bias.view(shape)
+        ctx.save_for_backward(input, weight, mean, invstd)
+        ctx.count = count
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        from . import Sum, allreduce
+
+        input, weight, mean, invstd = ctx.saved_tensors
+        c = input.size(1)
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xmu = input - mean.view(shape)
+
+        sums = torch.empty(2 * c, dtype=torch.float32)
+        sums[:c] = _channel_sums(grad_out.float())
+        sums[c:] = _channel_sums(grad_out.float() * xmu.float())
+        sums = allreduce(sums, op=Sum, name="sync_batch_norm.grad")
+        mean_dy = (sums[:c] / ctx.count).to(input.dtype)
+        mean_dy_xmu = (sums[c:] / ctx.count).to(input.dtype)
+
+        dx = (weight.view(shape) * invstd.view(shape)) * (
+            grad_out - mean_dy.view(shape)
+            - xmu * (invstd * invstd * mean_dy_xmu).view(shape))
+        # Local weight/bias grads; the optimizer's allreduce averages them.
+        dweight = _channel_sums(grad_out * xmu * invstd.view(shape))
+        dbias = _channel_sums(grad_out)
+        return dx, dweight, dbias, None, None, None, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in ``torch.nn.BatchNorm*`` replacement whose statistics span all
+    ranks (reference: ``hvd.SyncBatchNorm``, torch/sync_batch_norm.py:39).
+
+    Falls back to regular (local) batch norm when the world size is 1 or in
+    eval mode, like the reference (:64-67).
+    """
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input: torch.Tensor) -> torch.Tensor:
+        from . import size
+
+        self._check_input_dim(input)
+        if not self.training or size() == 1:
+            return super().forward(input)
+
+        if self.num_batches_tracked is not None:
+            self.num_batches_tracked += 1
+        if self.momentum is None:
+            # Cumulative moving average needs the tracked count; without
+            # track_running_stats there are no running stats to update.
+            momentum = (1.0 / float(self.num_batches_tracked)
+                        if self.num_batches_tracked is not None else 0.0)
+        else:
+            momentum = self.momentum
+
+        weight = self.weight if self.affine else \
+            torch.ones(self.num_features, dtype=input.dtype)
+        bias = self.bias if self.affine else \
+            torch.zeros(self.num_features, dtype=input.dtype)
+        running_mean = self.running_mean if self.track_running_stats else None
+        running_var = self.running_var if self.track_running_stats else None
+        return _SyncBatchNormFn.apply(input, weight, bias, running_mean,
+                                      running_var, self.eps, momentum)

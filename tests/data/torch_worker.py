@@ -106,6 +106,74 @@ for micro in range(2):
     out.sum().backward()
 opt2.step()
 
+# checkpoint resume mid-accumulation: load_state_dict must reset the delay
+# counters or the next window hangs (reference: optimizer.py:81-89)
+sd = opt2.state_dict()
+opt2.zero_grad()
+out = model2(torch.ones(2, 4))
+out.sum().backward()           # delay now 1 (mid-window)
+opt2.load_state_dict(sd)       # resume: counters reset to 2
+opt2.zero_grad()
+for micro in range(2):
+    out = model2(torch.ones(2, 4) * (micro + 1))
+    out.sum().backward()
+opt2.step()                    # would hang without the reset
+
+# set_backward_passes_per_step mid-training (reference: optimizer.py:99)
+opt2.set_backward_passes_per_step(3)
+opt2.zero_grad()
+for micro in range(3):
+    out = model2(torch.ones(2, 4) * (micro + 1))
+    out.sum().backward()
+opt2.step()
+
+# gradient_predivide_factor splits averaging across pre/postscale
+# (reference: optimizer.py:120-128) — same result as plain averaging
+m3a = torch.nn.Linear(4, 1)
+m3b = torch.nn.Linear(4, 1)
+m3b.load_state_dict(m3a.state_dict())
+opt3a = hvd.DistributedOptimizer(
+    torch.optim.SGD(m3a.parameters(), lr=0.1),
+    named_parameters=[("w." + k, v) for k, v in m3a.named_parameters()])
+opt3b = hvd.DistributedOptimizer(
+    torch.optim.SGD(m3b.parameters(), lr=0.1),
+    named_parameters=[("v." + k, v) for k, v in m3b.named_parameters()],
+    gradient_predivide_factor=2.0)
+for o, m in ((opt3a, m3a), (opt3b, m3b)):
+    o.zero_grad()
+    m(torch.ones(2, 4) * (r + 1)).sum().backward()
+    o.step()
+for pa, pb in zip(m3a.parameters(), m3b.parameters()):
+    assert torch.allclose(pa, pb, atol=1e-6), (pa, pb)
+
+# SyncBatchNorm: statistics over the GLOBAL batch (reference:
+# torch/sync_batch_norm.py:39). Compare against plain BatchNorm1d over the
+# concatenated batch.
+torch.manual_seed(7)  # same affine init everywhere
+bn = hvd.SyncBatchNorm(3)
+ref_bn = torch.nn.BatchNorm1d(3)
+ref_bn.load_state_dict({k: v.clone() for k, v in bn.state_dict().items()})
+gens = [torch.Generator().manual_seed(100 + i) for i in range(n)]
+xs = [torch.randn(4, 3, 5, generator=g) for g in gens]
+x_local = xs[r].clone().requires_grad_(True)
+x_cat = torch.cat(xs).clone().requires_grad_(True)
+out = bn(x_local)
+ref = ref_bn(x_cat)
+assert torch.allclose(out, ref[r * 4:(r + 1) * 4], atol=1e-4), \
+    (out - ref[r * 4:(r + 1) * 4]).abs().max()
+wg = torch.randn(n * 4, 3, 5, generator=torch.Generator().manual_seed(99))
+(out * wg[r * 4:(r + 1) * 4]).sum().backward()
+(ref * wg).sum().backward()
+assert torch.allclose(x_local.grad, x_cat.grad[r * 4:(r + 1) * 4],
+                      atol=1e-4), \
+    (x_local.grad - x_cat.grad[r * 4:(r + 1) * 4]).abs().max()
+assert torch.allclose(bn.running_mean, ref_bn.running_mean, atol=1e-5)
+assert torch.allclose(bn.running_var, ref_bn.running_var, atol=1e-5)
+# eval mode uses running stats locally (no collective)
+bn.eval()
+ref_bn.eval()
+assert torch.allclose(bn(xs[0]), ref_bn(xs[0]), atol=1e-5)
+
 hvd.join()
 hvd.shutdown()
 print("ALL OK")
