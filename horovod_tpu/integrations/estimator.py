@@ -7,6 +7,18 @@ paths for checkpoints + runs). The TPU-native counterpart trains a flax
 module data-parallel over the mesh and checkpoints the best epoch to the
 store; ``EstimatorModel.transform`` serves predictions, mirroring the Spark
 ``TransformerModel``.
+
+``fit`` accepts three data forms:
+
+* ``(x, y)`` in-memory arrays (single-process SPMD over the mesh);
+* a **parquet directory path** — batches stream through
+  :class:`~horovod_tpu.spark.util.ParquetShardReader`, each rank reading its
+  shard (the Petastorm-analog path);
+* a **Spark DataFrame** — materialized to the store as parquet
+  (:func:`~horovod_tpu.spark.util.prepare_data`) and, when ``num_proc`` is
+  set, trained distributed via :func:`horovod_tpu.spark.run` with one
+  process-mode rank per Spark task (reference:
+  ``spark/keras/estimator.py`` fit → ``horovod.spark.run(remote trainer)``).
 """
 
 from __future__ import annotations
@@ -15,43 +27,11 @@ import os
 import pickle
 from typing import Any, Callable, Optional, Tuple
 
-
-class Store:
-    """Checkpoint/run-artifact locations (reference: store.py Store base)."""
-
-    def checkpoint_path(self, run_id: str) -> str:
-        raise NotImplementedError
-
-    def save(self, run_id: str, payload: bytes) -> str:
-        raise NotImplementedError
-
-    def load(self, run_id: str) -> bytes:
-        raise NotImplementedError
-
-
-class LocalStore(Store):
-    """Filesystem store (reference: LocalStore / FilesystemStore,
-    spark/common/store.py)."""
-
-    def __init__(self, prefix_path: str):
-        self.prefix_path = prefix_path
-        os.makedirs(prefix_path, exist_ok=True)
-
-    def checkpoint_path(self, run_id: str) -> str:
-        return os.path.join(self.prefix_path, run_id, "checkpoint.pkl")
-
-    def save(self, run_id: str, payload: bytes) -> str:
-        path = self.checkpoint_path(run_id)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)
-        return path
-
-    def load(self, run_id: str) -> bytes:
-        with open(self.checkpoint_path(run_id), "rb") as f:
-            return f.read()
+# Store hierarchy lives with the Spark integration (reference:
+# horovod/spark/common/store.py); re-exported here for back-compat with the
+# round-2 surface.
+from ..spark.store import (Store, FilesystemStore, LocalStore,  # noqa: F401
+                           HDFSStore, DBFSLocalStore)
 
 
 class EstimatorModel:
@@ -77,6 +57,28 @@ class EstimatorModel:
         return cls(model, params, run_id, blob.get("history", []))
 
 
+def _remote_fit(estimator: "Estimator", train_path: str) -> list:
+    """Per-rank training body for the distributed (Spark) path: read this
+    rank's parquet shard, train with cross-rank gradient averaging through
+    the eager collectives, rank 0 checkpoints the best epoch
+    (reference: the estimators' remote training fns,
+    ``spark/keras/remote.py`` / ``spark/torch/remote.py``)."""
+    import horovod_tpu as hvd
+    from ..spark.util import ParquetShardReader
+
+    if not hvd.is_initialized():
+        hvd.init()
+    reader = ParquetShardReader(
+        train_path, estimator.feature_cols, estimator.label_col,
+        batch_size=estimator.batch_size, rank=hvd.rank(), size=hvd.size())
+    # Every step issues blocking cross-rank collectives, so all ranks MUST
+    # run the same number of steps; shards can be uneven (fragment sizes,
+    # dropped partials) — agree on the minimum full-batch count.
+    local_steps = reader.rows() // estimator.batch_size
+    return estimator._fit_loop(lambda _epoch: reader.batches(),
+                               distributed=True, local_steps=local_steps)
+
+
 class Estimator:
     """Train a flax module data-parallel and checkpoint the best epoch.
 
@@ -89,7 +91,8 @@ class Estimator:
                  epochs: int = 5, batch_size: int = 32,
                  run_id: Optional[str] = None, seed: int = 0,
                  feature_cols: Optional[list] = None,
-                 label_col: Optional[str] = None):
+                 label_col: Optional[str] = None,
+                 sample_input=None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -100,29 +103,109 @@ class Estimator:
         self.seed = seed
         self.feature_cols = feature_cols
         self.label_col = label_col
+        # Shape template for model.init on the distributed path, where the
+        # driver never materializes a batch (first shard batch is used when
+        # omitted).
+        self.sample_input = sample_input
 
-    def _coerce(self, data):
-        """Accept an ``(x, y)`` array pair or a Spark DataFrame (reference:
-        ``KerasEstimator.fit(df)`` with feature_cols/label_cols params,
-        spark/keras/estimator.py:105 + spark/common/params.py)."""
+    # ------------------------------------------------------------------
+    def fit(self, data, num_proc: Optional[int] = None) -> EstimatorModel:
+        """Train and return the best-checkpoint model. ``num_proc`` > 0 with
+        a Spark DataFrame trains distributed via ``horovod_tpu.spark.run``."""
+        spark_df = self._as_spark_df(data)
+        if spark_df is None and not isinstance(data, str) and num_proc:
+            raise ValueError(
+                "num_proc requires a Spark DataFrame or a parquet directory "
+                "path; in-memory (x, y) data trains on the local mesh only")
+        if spark_df is not None:
+            from ..spark.util import prepare_data
+            if not self.feature_cols or not self.label_col:
+                raise ValueError(
+                    "fitting a Spark DataFrame requires feature_cols and "
+                    "label_col (reference estimators require the same "
+                    "params)")
+            meta = prepare_data(spark_df, self.store, self.run_id,
+                                partitions=num_proc)
+            return self.fit_on_parquet(meta["train_data_path"],
+                                       num_proc=num_proc)
+        if isinstance(data, str):
+            return self.fit_on_parquet(data, num_proc=num_proc)
+        x, y = data
+        return self._fit_arrays(x, y)
+
+    def fit_on_parquet(self, train_path: str,
+                       num_proc: Optional[int] = None) -> EstimatorModel:
+        """Train from a materialized parquet directory. With ``num_proc``,
+        fan out over Spark tasks (process mode); otherwise read locally and
+        train over the SPMD mesh."""
+        if not self.feature_cols or not self.label_col:
+            raise ValueError("parquet training requires feature_cols and "
+                             "label_col")
+        if num_proc:
+            from .. import spark as hvd_spark
+            histories = hvd_spark.run(_remote_fit, args=(self, train_path),
+                                      num_proc=num_proc)
+            history = histories[0]
+        else:
+            import horovod_tpu as hvd
+            from ..spark.util import ParquetShardReader
+            if not hvd.is_initialized():
+                hvd.init()
+            # Batches must tile the mesh's data axis (same rounding as the
+            # in-memory path) or shard_batch rejects the first batch.
+            n_shards = hvd.size()
+            bs = max(self.batch_size // n_shards * n_shards, n_shards)
+            reader = ParquetShardReader(
+                train_path, self.feature_cols, self.label_col,
+                batch_size=bs, rank=0, size=1)
+            history = self._fit_loop(lambda _e: reader.batches(),
+                                     distributed=False)
+        blob = pickle.loads(self.store.load(self.run_id))
+        return EstimatorModel(self.model, blob["params"], self.run_id,
+                              history)
+
+    # ------------------------------------------------------------------
+    def _as_spark_df(self, data):
         try:
             from pyspark.sql import DataFrame as SparkDataFrame
         except ImportError:
-            return data
-        if not isinstance(data, SparkDataFrame):
-            return data
-        if not self.feature_cols or not self.label_col:
-            raise ValueError(
-                "fitting a Spark DataFrame requires feature_cols and "
-                "label_col (reference estimators require the same params)")
-        import numpy as np
-        pdf = data.select(*self.feature_cols, self.label_col).toPandas()
-        x = np.stack([np.asarray(pdf[c].to_list()) for c in
-                      self.feature_cols], axis=-1).astype(np.float32)
-        y = np.asarray(pdf[self.label_col].to_list())
-        return x, y
+            return None
+        return data if isinstance(data, SparkDataFrame) else None
 
-    def fit(self, data: Tuple[Any, Any]) -> EstimatorModel:
+    def _fit_arrays(self, x, y) -> EstimatorModel:
+        import numpy as np
+
+        import horovod_tpu as hvd
+        if not hvd.is_initialized():
+            hvd.init()
+        x = np.asarray(x)
+        y = np.asarray(y)
+        # Batches must tile the mesh's data axis evenly; trim the remainder
+        # (the reference's Petastorm loader repartitions for the same
+        # reason).
+        n_shards = hvd.size()
+        bs = max(self.batch_size // n_shards * n_shards, n_shards)
+
+        def batches(_epoch):
+            for i in range(0, len(x) - bs + 1, bs):
+                yield x[i:i + bs], y[i:i + bs]
+
+        history = self._fit_loop(batches, distributed=False)
+        blob = pickle.loads(self.store.load(self.run_id))
+        return EstimatorModel(self.model, blob["params"], self.run_id,
+                              history)
+
+    def _fit_loop(self, batches: Callable, distributed: bool,
+                  local_steps: Optional[int] = None) -> list:
+        """Shared epoch loop. ``batches(epoch)`` yields host ``(x, y)``
+        pairs — the full global batch in SPMD mode (sharded over the mesh),
+        this rank's local batch in distributed (process) mode (reduced
+        through the eager collectives). In distributed mode
+        ``local_steps`` (this rank's full-batch count) is MIN-agreed across
+        ranks and the epoch is truncated to it: every step runs blocking
+        collectives, so a rank with extra batches would deadlock the world."""
+        import itertools
+
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -133,48 +216,86 @@ class Estimator:
         if not hvd.is_initialized():
             hvd.init()
 
-        x, y = self._coerce(data)
-        x = np.asarray(x)
-        y = np.asarray(y)
+        steps_per_epoch = None
+        if distributed and local_steps is not None:
+            agreed = hvd.allreduce(np.asarray([local_steps], np.int64),
+                                   op=hvd.Min, name="estimator.steps")
+            steps_per_epoch = int(np.asarray(agreed)[0])
+            if steps_per_epoch == 0:
+                raise ValueError(
+                    "a rank has zero full batches (shard smaller than "
+                    "batch_size); use more data, fewer ranks, or a smaller "
+                    "batch_size")
+
+        if self.sample_input is not None:
+            sample = np.asarray(self.sample_input)
+        else:
+            # Peek one batch from a throwaway generator for the init shape
+            # (each batches() call starts a fresh pass over the data).
+            first_batch = next(iter(batches(0)), None)
+            if first_batch is None:
+                raise ValueError("no training batches (empty dataset or "
+                                 "batch_size larger than the shard)")
+            sample = first_batch[0][:1]
+
         rng = jax.random.PRNGKey(self.seed)
-        params = self.model.init(rng, jnp.asarray(x[: 1]))
+        params = self.model.init(rng, jnp.asarray(sample))
         opt = hvd.DistributedOptimizer(self.optimizer)
         opt_state = opt.init(params)
         model, loss_fn = self.model, self.loss
 
-        def train_step(p, s, batch):
-            xb, yb = batch
+        if distributed:
+            # Process mode: local jitted grads; cross-rank averaging happens
+            # in opt.update through the eager collective plane.
+            params = hvd.broadcast_parameters(params, root_rank=0)
 
-            def objective(q):
-                return loss_fn(model.apply(q, xb), yb)
+            @jax.jit
+            def grad_step(p, xb, yb):
+                return jax.value_and_grad(
+                    lambda q: loss_fn(model.apply(q, xb), yb))(p)
 
-            l, g = jax.value_and_grad(objective)(p)
-            updates, s = opt.update(g, s, p)
-            p = optax.apply_updates(p, updates)
-            return p, s, hvd.allreduce(l, op=hvd.Average)
+            apply = jax.jit(optax.apply_updates)
 
-        step = hvd.data_parallel_step(train_step, donate_state=False)
+            def run_batch(p, s, xb, yb):
+                l, g = grad_step(p, jnp.asarray(xb), jnp.asarray(yb))
+                updates, s = opt.update(g, s, p)
+                return apply(p, updates), s, float(np.asarray(
+                    hvd.allreduce(np.asarray(l), op=hvd.Average)))
+        else:
+            def train_step(p, s, batch):
+                xb, yb = batch
 
-        # Batches must tile the mesh's data axis evenly; trim the remainder
-        # (the reference's Petastorm loader repartitions for the same reason).
-        n_shards = hvd.size()
-        bs = max(self.batch_size // n_shards * n_shards, n_shards)
+                def objective(q):
+                    return loss_fn(model.apply(q, xb), yb)
+
+                l, g = jax.value_and_grad(objective)(p)
+                updates, s = opt.update(g, s, p)
+                p = optax.apply_updates(p, updates)
+                return p, s, hvd.allreduce(l, op=hvd.Average)
+
+            step = hvd.data_parallel_step(train_step, donate_state=False)
+
+            def run_batch(p, s, xb, yb):
+                batch = hvd.shard_batch((jnp.asarray(xb), jnp.asarray(yb)))
+                p, s, l = step(p, s, batch)
+                return p, s, float(l)
+
         history = []
-        best = (float("inf"), None)
+        best = float("inf")
         for epoch in range(self.epochs):
             epoch_losses = []
-            for i in range(0, len(x) - bs + 1, bs):
-                batch = hvd.shard_batch((jnp.asarray(x[i:i + bs]),
-                                         jnp.asarray(y[i:i + bs])))
-                params, opt_state, l = step(params, opt_state, batch)
-                epoch_losses.append(float(l))
+            it = batches(epoch)
+            if steps_per_epoch is not None:
+                it = itertools.islice(it, steps_per_epoch)
+            for xb, yb in it:
+                params, opt_state, l = run_batch(params, opt_state, xb, yb)
+                epoch_losses.append(l)
             epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
             history.append(epoch_loss)
-            if epoch_loss < best[0]:
-                host_params = jax.tree.map(np.asarray, params)
-                best = (epoch_loss, host_params)
+            if epoch_loss < best:
+                best = epoch_loss
                 if hvd.rank() == 0:
+                    host_params = jax.tree.map(np.asarray, params)
                     self.store.save(self.run_id, pickle.dumps(
                         {"params": host_params, "history": history}))
-
-        return EstimatorModel(self.model, best[1], self.run_id, history)
+        return history

@@ -120,6 +120,12 @@ class KVStoreServer:
         with self._server.kv_lock:  # type: ignore[attr-defined]
             return self._server.kv_store.get(key)  # type: ignore[attr-defined]
 
+    def keys(self, prefix: str = "") -> list:
+        """Keys under ``prefix`` (driver-side membership scans)."""
+        with self._server.kv_lock:  # type: ignore[attr-defined]
+            return [k for k in self._server.kv_store  # type: ignore[attr-defined]
+                    if k.startswith(prefix)]
+
 
 class KVStoreClient:
     """HTTP client for the KV store (reference: http_client.py). ``secret``

@@ -19,7 +19,11 @@ import socket
 import time
 from typing import Any, Callable, Optional
 
-__all__ = ["run", "run_elastic"]
+__all__ = ["run", "run_elastic", "Store", "LocalStore", "FilesystemStore",
+           "HDFSStore", "DBFSLocalStore"]
+
+from .store import (Store, LocalStore, FilesystemStore,  # noqa: E402,F401
+                    HDFSStore, DBFSLocalStore)
 
 _POLL_S = 0.25
 
@@ -55,6 +59,41 @@ def _wait_kv(client, key: str, deadline: float) -> bytes:
         time.sleep(_POLL_S)
 
 
+def _require_spark_context(what: str):
+    """Import-gate pyspark and fetch the active SparkContext."""
+    try:
+        from pyspark import SparkContext
+    except ImportError as e:
+        raise ImportError(
+            f"horovod_tpu.spark.{what} requires pyspark "
+            "(pip install pyspark)") from e
+    sc = SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError("no active SparkContext; create a SparkSession "
+                           f"before calling horovod_tpu.spark.{what}")
+    return sc
+
+
+def _cloudpickle_payload(fn, args, kwargs) -> bytes:
+    """cloudpickle (shipped with pyspark): plain pickle cannot serialize the
+    nested/closure functions users normally pass as ``fn``."""
+    try:
+        from pyspark import cloudpickle as _cp
+    except ImportError:  # very old pyspark layouts
+        import pyspark.cloudpickle as _cp
+    return _cp.dumps((fn, args, dict(kwargs or {})))
+
+
+def _env_with_job_secret(env: Optional[dict]) -> dict:
+    """One HMAC secret shared by the KV server and every task; the
+    caller-supplied env wins so both sides always agree."""
+    import secrets as _secrets
+    env = dict(env or {})
+    env["HVDTPU_SECRET"] = env.get("HVDTPU_SECRET") or \
+        os.environ.get("HVDTPU_SECRET") or _secrets.token_hex(16)
+    return env
+
+
 def _rank_layout(hosts: list, rank: int):
     """local/cross rank assignment from the per-rank host list (reference:
     common/util/hosts.py get_host_assignments)."""
@@ -62,6 +101,31 @@ def _rank_layout(hosts: list, rank: int):
     unique_hosts = list(dict.fromkeys(hosts))
     return (same.index(rank), len(same),
             unique_hosts.index(hosts[rank]), len(unique_hosts))
+
+
+class _scoped_environ:
+    """Apply env updates for the task body and restore the previous values on
+    exit — pyspark reuses python worker processes across tasks
+    (``spark.python.worker.reuse``), so leaked ``HVDTPU_*`` would flip a
+    later, unrelated task into process/elastic mode."""
+
+    def __init__(self, updates: dict):
+        self._updates = dict(updates)
+        self._saved: dict = {}
+
+    def __enter__(self):
+        for k, v in self._updates.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, prev in self._saved.items():
+            if prev is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = prev
+        return False
 
 
 def _spark_task(rank: int, num_proc: int, kv_addr: str, kv_port: int,
@@ -90,7 +154,7 @@ def _spark_task(rank: int, num_proc: int, kv_addr: str, kv_port: int,
     ctrl = _wait_kv(client, "/spark/controller", deadline).decode()
     ctrl_addr, ctrl_port = ctrl.rsplit(":", 1)
 
-    os.environ.update({
+    task_env = {
         "HVDTPU_RANK": str(rank), "HVDTPU_SIZE": str(num_proc),
         "HVDTPU_LOCAL_RANK": str(local_rank),
         "HVDTPU_LOCAL_SIZE": str(local_size),
@@ -99,18 +163,19 @@ def _spark_task(rank: int, num_proc: int, kv_addr: str, kv_port: int,
         "HVDTPU_CONTROLLER_ADDR": ctrl_addr,
         "HVDTPU_CONTROLLER_PORT": ctrl_port,
         "HVDTPU_HOSTNAME": me,
-    })
-    os.environ.update(env or {})
+    }
+    task_env.update(env or {})
 
     import horovod_tpu as hvd
 
     fn, args, kwargs = pickle.loads(payload)
-    hvd.shutdown()
-    hvd.init()
-    try:
-        result = fn(*args, **kwargs)
-    finally:
+    with _scoped_environ(task_env):
         hvd.shutdown()
+        hvd.init()
+        try:
+            result = fn(*args, **kwargs)
+        finally:
+            hvd.shutdown()
     return rank, result
 
 
@@ -123,37 +188,15 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     returns the list of results ordered by rank. ``num_proc`` defaults to
     ``sc.defaultParallelism`` like the reference.
     """
-    try:
-        import pyspark  # noqa: F401
-        from pyspark import SparkContext
-    except ImportError as e:
-        raise ImportError(
-            "horovod_tpu.spark.run requires pyspark "
-            "(pip install pyspark)") from e
+    sc = _require_spark_context("run")
+    n = num_proc or sc.defaultParallelism
+    payload = _cloudpickle_payload(fn, args, kwargs)
+    env = _env_with_job_secret(env)
 
     from horovod_tpu.runner.http_kv import KVStoreServer
     from horovod_tpu.utils import logging as log
 
-    sc = SparkContext._active_spark_context
-    if sc is None:
-        raise RuntimeError("no active SparkContext; create a SparkSession "
-                           "before calling horovod_tpu.spark.run")
-    n = num_proc or sc.defaultParallelism
-    # cloudpickle (shipped with pyspark): plain pickle cannot serialize the
-    # nested/closure functions users normally pass as `fn`.
-    try:
-        from pyspark import cloudpickle as _cp
-    except ImportError:  # very old pyspark layouts
-        import pyspark.cloudpickle as _cp
-    payload = _cp.dumps((fn, args, dict(kwargs or {})))
-
-    import secrets as _secrets
-    env = dict(env or {})
-    # Caller-supplied env wins so the KV server and the tasks always agree.
-    job_secret = env.get("HVDTPU_SECRET") or \
-        os.environ.get("HVDTPU_SECRET") or _secrets.token_hex(16)
-    env["HVDTPU_SECRET"] = job_secret
-    server = KVStoreServer(port=0, secret=job_secret)
+    server = KVStoreServer(port=0, secret=env["HVDTPU_SECRET"])
     server.start()
     kv_addr, kv_port = _local_addr(), server.port
     if verbose:
@@ -169,12 +212,102 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     return [result for _rank, result in sorted(results)]
 
 
-def run_elastic(*_args, **_kwargs):
-    """Reference: ``horovod.spark.run_elastic`` (runner.py:303). Elastic
-    placement via Spark dynamic allocation is not implemented; use the
-    elastic driver (:mod:`horovod_tpu.runner.elastic`) with a host-discovery
-    script over the cluster instead."""
-    raise NotImplementedError(
-        "horovod_tpu.spark.run_elastic is not implemented; use "
-        "horovod_tpu.runner.elastic with a host discovery script "
-        "(see docs/quickstart.md)")
+def _elastic_spark_task(index: int, kv_addr: str, kv_port: int,
+                        payload: bytes, env: Optional[dict]):
+    """Body of one elastic Spark task: heartbeat membership into the driver's
+    KV store, then run the (elastic-wrapped) training function under the
+    standard worker-side elastic protocol — the runtime polls
+    ``/rendezvous/*`` for its assignment exactly as under ``hvdrun``
+    (``horovod_tpu/runtime.py:_elastic_assignment``)."""
+    import threading
+
+    from horovod_tpu.runner.http_kv import KVStoreClient
+    from horovod_tpu.spark.elastic import heartbeat_loop
+
+    me = _local_addr()
+    worker_id = f"{me}:task{index}"
+    secret = (env or {}).get("HVDTPU_SECRET") or \
+        os.environ.get("HVDTPU_SECRET")
+    client = KVStoreClient(kv_addr, kv_port, timeout=10.0, secret=secret)
+    stop_beat = threading.Event()
+    threading.Thread(target=heartbeat_loop,
+                     args=(client, worker_id, me),
+                     kwargs={"stop": stop_beat}, daemon=True).start()
+
+    task_env = {
+        "HVDTPU_RENDEZVOUS_ADDR": kv_addr,
+        "HVDTPU_RENDEZVOUS_PORT": str(kv_port),
+        "HVDTPU_WORKER_ID": worker_id,
+        "HVDTPU_HOSTNAME": me,
+    }
+    task_env.update(env or {})
+
+    import horovod_tpu as hvd
+    from horovod_tpu import runtime as _rt
+
+    fn, args, kwargs = pickle.loads(payload)
+    with _scoped_environ(task_env):
+        hvd.shutdown()
+        hvd.init()  # blocks in rendezvous until this worker is assigned
+        try:
+            result = fn(*args, **kwargs)
+        finally:
+            rank = hvd.rank()
+            hvd.shutdown()
+            stop_beat.set()
+            # Reused pyspark workers outlive tasks: a later run_elastic()
+            # in this process starts its epochs at 1 again, which the
+            # stale-epoch guard would otherwise reject.
+            _rt._elastic_last_epoch = 0
+    return rank, result
+
+
+def run_elastic(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
+                num_proc: Optional[int] = None,
+                min_np: Optional[int] = None, max_np: Optional[int] = None,
+                start_timeout: float = 600.0, env: Optional[dict] = None,
+                verbose: bool = False) -> list:
+    """Elastic variant of :func:`run` (reference: ``horovod.spark.run_elastic``,
+    ``horovod/spark/runner.py:303``): Spark supervises the workers (task
+    retries / dynamic allocation); the driver only runs membership +
+    rank-assignment rendezvous. ``fn`` should be an
+    ``hvd.elastic.run``-wrapped training function taking an
+    ``hvd.elastic.State``.
+
+    Returns per-rank results of the final epoch's membership, ordered by rank.
+    """
+    sc = _require_spark_context("run_elastic")
+
+    from horovod_tpu.spark.elastic import HeartbeatRendezvous
+    from horovod_tpu.utils import logging as log
+
+    n = num_proc or sc.defaultParallelism
+    min_np = min_np or n
+    max_np = max_np or n
+    if n > max_np:
+        # Excess tasks would never get an assignment, exit "scaled away",
+        # and Spark's task-retry accounting would abort the healthy stage.
+        raise ValueError(f"num_proc ({n}) must be <= max_np ({max_np}): "
+                         "every launched Spark task is a training worker")
+
+    payload = _cloudpickle_payload(fn, args, kwargs)
+    env = _env_with_job_secret(env)
+    env.setdefault("HVDTPU_ELASTIC_TIMEOUT", str(start_timeout))
+
+    driver = HeartbeatRendezvous(min_np=min_np, max_np=max_np,
+                                 secret=env["HVDTPU_SECRET"])
+    driver.start()
+    kv_addr = _local_addr()
+    if verbose:
+        log.info("spark elastic: rendezvous at %s:%d, np=[%d..%d]",
+                 kv_addr, driver.port, min_np, max_np)
+    try:
+        rdd = sc.parallelize(range(n), n)
+        results = rdd.mapPartitionsWithIndex(
+            lambda index, _it: [_elastic_spark_task(index, kv_addr,
+                                                    driver.port, payload,
+                                                    env)],
+            preservesPartitioning=True).collect()
+    finally:
+        driver.stop()
+    return [result for _rank, result in sorted(results)]

@@ -29,9 +29,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def subprocess_env() -> dict:
     """Env for worker subprocesses: repo importable from anywhere (workers run
-    as ``python <script>``, so sys.path[0] is the script dir, not the repo)."""
+    as ``python <script>``, so sys.path[0] is the script dir, not the repo).
+
+    JAX_PLATFORMS=cpu must be present at interpreter START: the axon
+    sitecustomize imports jax before the worker script runs, so a script-level
+    ``os.environ.setdefault`` is too late and the worker silently initializes
+    the axon TPU backend — hanging forever whenever the tunnel is down."""
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     prev = env.get("PYTHONPATH")
     env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prev if prev else "")
     return env
@@ -66,9 +71,16 @@ def launch_world(n: int, script: str, extra_env=None, timeout=180):
                                       stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
     results = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
-        results.append((p.returncode, out, err))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            results.append((p.returncode, out, err))
+    finally:
+        for p in procs:  # never leak hung workers past the test
+            if p.poll() is None:
+                p.kill()
+                out, err = p.communicate()
+                results.append((-9, out, f"[killed after timeout]\n{err}"))
     return results
 
 

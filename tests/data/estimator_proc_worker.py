@@ -1,0 +1,41 @@
+"""Worker: the estimator's distributed training body (_remote_fit) in
+process mode — what each Spark task executes on its parquet shard
+(reference: spark/keras/remote.py remote trainer)."""
+import faulthandler
+import os
+import sys
+
+faulthandler.dump_traceback_later(120, exit=True, file=sys.stderr)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import optax  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.integrations import Estimator, LocalStore  # noqa: E402
+from horovod_tpu.integrations.estimator import _remote_fit  # noqa: E402
+from horovod_tpu.models import MLP  # noqa: E402
+
+data_dir = os.environ["EST_DATA_DIR"]
+store_dir = os.environ["EST_STORE_DIR"]
+
+
+def mse(pred, target):
+    return ((pred[:, 0] - target) ** 2).mean()
+
+
+est = Estimator(model=MLP(features=(16, 1)), optimizer=optax.adam(5e-2),
+                loss=mse, store=LocalStore(store_dir), epochs=8,
+                batch_size=32, run_id="proc1",
+                feature_cols=["f0", "f1"], label_col="label")
+hvd.init()
+history = _remote_fit(est, data_dir)
+assert history[-1] < history[0] * 0.8, history
+if hvd.rank() == 0:
+    assert os.path.exists(
+        est.store.get_checkpoint_path("proc1")), "rank 0 must checkpoint"
+hvd.shutdown()
+print("ALL OK")

@@ -1,0 +1,43 @@
+"""Worker: drives horovod_tpu.spark._elastic_spark_task directly (no Spark)
+— heartbeat membership + rendezvous assignment + elastic training loop, the
+exact body an elastic Spark task runs. Args: <index> <kv_port>."""
+import os
+import pickle
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+
+index, kv_port = int(sys.argv[1]), int(sys.argv[2])
+
+from horovod_tpu.spark import _elastic_spark_task  # noqa: E402
+
+TARGET = int(os.environ.get("SPARK_ELASTIC_TARGET", "3"))
+
+
+def train():
+    import horovod_tpu as hvd
+
+    state = hvd.elastic.ObjectState(batches=0, total=0.0)
+
+    @hvd.elastic.run
+    def loop(state):
+        while state.batches < TARGET:
+            out = hvd.allreduce(np.ones(4, np.float32),
+                                name=f"spark.e{state.batches}", op=hvd.Sum)
+            state.total += float(np.asarray(out)[0])  # == world size
+            state.batches += 1
+            state.commit()
+        return hvd.size()
+
+    return loop(state)
+
+
+payload = pickle.dumps((train, (), {}))
+rank, result = _elastic_spark_task(index, "127.0.0.1", kv_port, payload,
+                                   env=None)
+print(f"RESULT rank={rank} size={result}")
+print("ALL OK")
