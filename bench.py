@@ -149,11 +149,55 @@ def _microbench(hvd, jnp, jax):
                 results.append({"op": name, "mbytes": nbytes >> 20,
                                 "error": f"{type(exc).__name__}: "
                                          f"{str(exc)[:120]}"})
+    results.extend(_quantize_kernel_bench(jnp, jax))
     return {"world_size": n,
             "note": ("dispatch-bound: world size 1 moves no fabric bytes; "
                      "ms is per-call overhead, a regression canary only")
             if n == 1 else "per-op wall time across the fabric",
             "ops": results}
+
+
+def _quantize_kernel_bench(jnp, jax):
+    """Pallas quantize kernels vs the XLA fallback at 16 MB (round-2
+    verdict #9: the stochastic kernel must be benchmarked on the real
+    chip). Direct kernel calls, so a lowering failure shows up as an
+    explicit error entry instead of silently timing the fallback."""
+    from horovod_tpu.compression import MaxMinQuantizer
+    from horovod_tpu.compression import pallas_kernels as pk
+
+    # Random data passed as an ARGUMENT: a closed-over constant would be
+    # constant-folded by XLA and time nothing.
+    x = jax.random.normal(jax.random.PRNGKey(1), (4 << 20,), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    seed = jnp.zeros((), jnp.int32)
+    xla_det = MaxMinQuantizer(bits=4, use_pallas=False)
+    xla_sto = MaxMinQuantizer(bits=4, stochastic=True, use_pallas=False)
+    det_fn = jax.jit(lambda v: xla_det.compress(v)[0]["q"])
+    sto_fn = jax.jit(lambda v, k: xla_sto.compress(v, k)[0]["q"])
+    cases = {
+        "quantize_pallas":
+            lambda: pk.maxmin_quantize_pallas(x, 4, 512)[0],
+        "quantize_xla": lambda: det_fn(x),
+        "quantize_stochastic_pallas":
+            lambda: pk.maxmin_quantize_stochastic_pallas(x, 4, 512, seed)[0],
+        "quantize_stochastic_xla": lambda: sto_fn(x, key),
+    }
+    out = []
+    for name, fn in cases.items():
+        try:
+            _fence(jax, fn())
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                r = fn()
+            _fence(jax, r)
+            out.append({"op": name, "mbytes": 16,
+                        "ms": round((time.perf_counter() - t0) / reps * 1e3,
+                                    3)})
+        except Exception as exc:
+            out.append({"op": name, "mbytes": 16,
+                        "error": f"{type(exc).__name__}: {str(exc)[:120]}"})
+    return out
 
 
 def _run():

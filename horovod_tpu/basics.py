@@ -34,6 +34,12 @@ _DTYPES = {"uint8": 0, "int8": 1, "int32": 4, "int64": 5, "float16": 6,
 
 
 def _ensure_built() -> str:
+    # HVDTPU_NATIVE_LIB points at an alternative build of the core — the
+    # sanitizer CI (native/Makefile `tsan`/`asan` targets, SURVEY.md §5)
+    # reruns the process-mode suite against the instrumented .so this way.
+    override = os.environ.get("HVDTPU_NATIVE_LIB")
+    if override:
+        return override
     if not os.path.exists(_LIB_PATH):
         log.info("building native core in %s", _NATIVE_DIR)
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,

@@ -73,6 +73,116 @@ def maxmin_quantize_pallas(flat: jnp.ndarray, bits: int, bucket_size: int,
     return (q[:n_buckets], mn[:n_buckets, 0], unit[:n_buckets, 0])
 
 
+def _quantize_stochastic_kernel(levels: int, x_ref, seed_ref, q_ref, mn_ref,
+                                unit_ref):
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Decorrelate grid blocks: same seed + program id.
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    x = x_ref[:]
+    mn = jnp.min(x, axis=1, keepdims=True)
+    mx = jnp.max(x, axis=1, keepdims=True)
+    unit = (mx - mn) / levels
+    safe = jnp.where(unit == 0, 1.0, unit)
+    scaled = (x - mn) / safe
+    # Uniform [0,1) from 24 PRNG bits (reference: the fork's xorshift path,
+    # cuda_rand.h + GPU_RAND in cuda_compression_functions.cu).
+    # prng_random_bits returns SIGNED int32: mask (not shift) — an
+    # arithmetic shift would put u in [-0.5, 0.5) and bias every rounding
+    # down by half a unit.
+    bits = pltpu.prng_random_bits(x.shape)
+    u = (bits & 0xffffff).astype(jnp.float32) * (1.0 / (1 << 24))
+    q = jnp.clip(jnp.floor(scaled + u), 0, levels)
+    q_ref[:] = q.astype(jnp.uint8)
+    mn_ref[:] = mn
+    unit_ref[:] = unit
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def maxmin_quantize_stochastic_pallas(flat: jnp.ndarray, bits: int,
+                                      bucket_size: int, seed: jnp.ndarray):
+    """Stochastic-rounding max-min quantization on the TPU PRNG
+    (reference: ``cuda_rand.h`` xorshift + ``QUANTIZE`` kernels in
+    ``cuda_compression_functions.cu``). TPU-only: CPU-mesh tests use the
+    XLA fallback (``pltpu.prng_*`` has no CPU lowering).
+
+    Returns (q [n_buckets, bucket_size] uint8, min [n_buckets],
+    unit [n_buckets]).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = flat.shape[0]
+    n_buckets = -(-n // bucket_size)
+    grid = -(-n_buckets // BUCKET_BLOCK)
+    padded_buckets = grid * BUCKET_BLOCK
+    padded = jnp.zeros((padded_buckets * bucket_size,), jnp.float32)
+    padded = padded.at[:n].set(flat)
+    x = padded.reshape(padded_buckets, bucket_size)
+    levels = (1 << bits) - 1
+
+    q, mn, unit = pl.pallas_call(
+        functools.partial(_quantize_stochastic_kernel, levels),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BUCKET_BLOCK, bucket_size), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((BUCKET_BLOCK, bucket_size), lambda i: (i, 0)),
+            pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
+            pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
+        ],
+    )(x, seed.reshape(1).astype(jnp.int32))
+    return (q[:n_buckets], mn[:n_buckets, 0], unit[:n_buckets, 0])
+
+
+def _dequantize_sum_kernel(x_ref, mn_ref, unit_ref, out_ref):
+    # x: [n_ranks, BLOCK, bucket] uint8; accumulate all ranks' dequantized
+    # values in one VMEM pass (reference: the dequant+add inner loops of
+    # the compressed reducers, cuda_compression_functions.cu).
+    x = x_ref[:].astype(jnp.float32)
+    total = jnp.sum(x * unit_ref[:], axis=0) + jnp.sum(mn_ref[:], axis=0)
+    out_ref[:] = total
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def maxmin_dequantize_sum_pallas(q: jnp.ndarray, mn: jnp.ndarray,
+                                 unit: jnp.ndarray, interpret: bool = False):
+    """Fused dequantize-and-sum over the ranks axis:
+    ``q [n_ranks, n_buckets, bucket]`` uint8 + per-rank ``mn``/``unit``
+    ``[n_ranks, n_buckets]`` -> fp32 ``[n_buckets, bucket]`` summed over
+    ranks — one kernel instead of n dequantize programs + n adds."""
+    n_ranks, n_buckets, bucket = q.shape
+    grid = -(-n_buckets // BUCKET_BLOCK)
+    padded_buckets = grid * BUCKET_BLOCK
+    qp = jnp.zeros((n_ranks, padded_buckets, bucket), jnp.uint8)\
+        .at[:, :n_buckets].set(q)
+    mnp = jnp.zeros((n_ranks, padded_buckets, 1), jnp.float32)\
+        .at[:, :n_buckets, 0].set(mn)
+    up = jnp.zeros((n_ranks, padded_buckets, 1), jnp.float32)\
+        .at[:, :n_buckets, 0].set(unit)
+
+    out = pl.pallas_call(
+        _dequantize_sum_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((n_ranks, BUCKET_BLOCK, bucket),
+                         lambda i: (0, i, 0)),
+            pl.BlockSpec((n_ranks, BUCKET_BLOCK, 1), lambda i: (0, i, 0)),
+            pl.BlockSpec((n_ranks, BUCKET_BLOCK, 1), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((BUCKET_BLOCK, bucket), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded_buckets, bucket), jnp.float32),
+        interpret=interpret,
+    )(qp, mnp, up)
+    return out[:n_buckets]
+
+
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def maxmin_dequantize_pallas(q: jnp.ndarray, mn: jnp.ndarray,
                              unit: jnp.ndarray, bucket_size: int,

@@ -58,6 +58,18 @@ def unpack_bits(p: jnp.ndarray, bits: int, count: int) -> jnp.ndarray:
     return vals.reshape(-1)[:count].astype(jnp.uint8)
 
 
+def _seed_from_key(key: Optional[jax.Array]) -> jnp.ndarray:
+    """An int32 seed for the TPU hardware PRNG from a JAX PRNG key (typed or
+    raw uint32 data); zero when no key is given (deterministic noise)."""
+    if key is None:
+        return jnp.zeros((), jnp.int32)
+    try:
+        data = jax.random.key_data(key)
+    except Exception:
+        data = key
+    return data.reshape(-1)[-1].astype(jnp.int32)
+
+
 def _bucketize(flat: jnp.ndarray, bucket_size: int) -> Tuple[jnp.ndarray, int]:
     """Pad + reshape a flat vector into (n_buckets, bucket_size)."""
     n = flat.shape[0]
@@ -125,13 +137,19 @@ class MaxMinQuantizer:
                            count=int(np.prod(x.shape)) if x.shape else 1,
                            bits=self.bits, bucket_size=self.bucket_size)
         flat = x.reshape(-1).astype(jnp.float32)
-        # The Pallas kernel rounds deterministically; honor stochastic=True by
-        # staying on the XLA path (TODO: pltpu.stochastic_round kernel).
-        if self._pallas_enabled() and not self.stochastic:
+        if self._pallas_enabled():
             from . import pallas_kernels as pk
             try:
-                q, mn, unit = pk.maxmin_quantize_pallas(
-                    flat, self.bits, self.bucket_size)
+                if self.stochastic:
+                    # TPU-PRNG stochastic rounding (reference: the fork's
+                    # xorshift CUDA path, cuda_rand.h); TPU-only — the CPU
+                    # mesh has no pltpu PRNG lowering and falls back below.
+                    q, mn, unit = pk.maxmin_quantize_stochastic_pallas(
+                        flat, self.bits, self.bucket_size,
+                        _seed_from_key(key))
+                else:
+                    q, mn, unit = pk.maxmin_quantize_pallas(
+                        flat, self.bits, self.bucket_size)
                 payload = {"q": pack_bits(q.reshape(-1), self.bits),
                            "min": mn, "unit": unit}
                 return payload, ctx

@@ -41,6 +41,36 @@ def _tree_index(tree, i):
     return jax.tree.map(lambda leaf: leaf[i], tree)
 
 
+def _dequant_sum_stacked(compressor, gathered, ctx, n: int):
+    """Sum of decompressed payloads over a stacked leading ranks axis.
+
+    Max-min payloads route through the fused Pallas dequantize-sum kernel
+    (one VMEM pass over all ranks; reference: the dequant+add inner loops in
+    ``cuda_compression_functions.cu``); everything else takes the generic
+    decompress-and-add loop, which XLA fuses on its own.
+    """
+    from .quantize import MaxMinQuantizer, unpack_bits
+    if isinstance(compressor, MaxMinQuantizer) and \
+            compressor._pallas_enabled():
+        try:
+            from . import pallas_kernels as pk
+            padded = -(-ctx.count // ctx.bucket_size) * ctx.bucket_size
+            q = jax.vmap(lambda p: unpack_bits(p, ctx.bits, padded))(
+                gathered["q"])
+            q = q.reshape(n, -1, ctx.bucket_size)
+            mn = gathered["min"].reshape(n, -1)
+            unit = gathered["unit"].reshape(n, -1)
+            out = pk.maxmin_dequantize_sum_pallas(q, mn, unit)
+            return out.reshape(-1)[:ctx.count].reshape(ctx.shape)
+        except Exception:
+            pass  # unsupported backend: generic loop below
+    total = jnp.zeros(ctx.shape, jnp.float32)
+    for i in range(n):
+        total = total + compressor.decompress(
+            _tree_index(gathered, i), ctx).astype(jnp.float32)
+    return total
+
+
 def _uplink_gather_sum(x, compressor, ax: str, residual, key):
     """Shared uplink: compress locally (with error feedback when a residual
     is given), allgather payloads, decompress + sum — returns the float32
@@ -53,10 +83,7 @@ def _uplink_gather_sum(x, compressor, ax: str, residual, key):
     else:
         payload, ctx = compressor.compress(x, key)
     gathered = _tree_allgather_stacked(payload, ax)
-    total = jnp.zeros(ctx.shape, jnp.float32)
-    for i in range(n):
-        total = total + compressor.decompress(
-            _tree_index(gathered, i), ctx).astype(jnp.float32)
+    total = _dequant_sum_stacked(compressor, gathered, ctx, n)
     return total, residual
 
 
@@ -104,10 +131,7 @@ def scatter_allgather_reducer_p(x, compressor, axis: Optional[str] = None,
         lambda leaf: lax.all_to_all(leaf, ax, split_axis=0, concat_axis=0,
                                     tiled=False),
         row_payload)
-    my_chunk_sum = jnp.zeros((chunk,), jnp.float32)
-    for i in range(n):
-        my_chunk_sum = my_chunk_sum + compressor.decompress(
-            _tree_index(exchanged, i), row_ctx).astype(jnp.float32)
+    my_chunk_sum = _dequant_sum_stacked(compressor, exchanged, row_ctx, n)
 
     # Compress the reduced chunk and allgather it.
     payload2, ctx2 = compressor.compress(my_chunk_sum)

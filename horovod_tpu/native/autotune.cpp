@@ -175,20 +175,21 @@ double ToUnit(double v, double lo, double hi) {
 }  // namespace
 
 void ParameterManager::Initialize(double cycle_time_ms,
-                                  int64_t fusion_threshold,
+                                  int64_t fusion_threshold, bool cache_enabled,
                                   const std::string& log_path,
                                   int warmup_samples, int cycles_per_sample,
                                   int max_samples, double gp_noise) {
-  current_ = {cycle_time_ms, fusion_threshold};
+  current_ = {cycle_time_ms, fusion_threshold, cache_enabled};
   warmup_samples_ = warmup_samples;
   warmup_left_ = warmup_samples;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
-  opt_ = BayesianOptimizer(2, gp_noise);
+  opt_ = BayesianOptimizer(3, gp_noise);
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_ != nullptr) {
-      fputs("cycle_time_ms,fusion_threshold_bytes,score_bytes_per_sec\n",
+      fputs("cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
+            "score_bytes_per_sec\n",
             log_);
     }
   }
@@ -204,21 +205,31 @@ ParameterManager::~ParameterManager() {
 }
 
 std::vector<double> ParameterManager::ToVector(const Params& p) {
+  // Dim 2 is the categorical cache switch: a {0,1}-valued coordinate the
+  // candidate sweep explores continuously and SetFromVector thresholds
+  // (the GP analog of the reference's CategoricalParameter).
   return {ToUnit(p.cycle_time_ms, kCycleMinMs, kCycleMaxMs),
           ToUnit(static_cast<double>(p.fusion_threshold), kFusionMin,
-                 kFusionMax)};
+                 kFusionMax),
+          p.cache_enabled ? 1.0 : 0.0};
 }
 
 void ParameterManager::SetFromVector(const std::vector<double>& x) {
   current_.cycle_time_ms = FromUnit(x[0], kCycleMinMs, kCycleMaxMs);
+  // llround, not truncation: FromUnit(ToUnit(v)) can land at v - 1e-7 and
+  // a truncating cast would log the frozen best point one byte off the
+  // sampled row it was chosen from.
   current_.fusion_threshold =
-      static_cast<int64_t>(FromUnit(x[1], kFusionMin, kFusionMax));
+      static_cast<int64_t>(std::llround(FromUnit(x[1], kFusionMin,
+                                                 kFusionMax)));
+  current_.cache_enabled = x[2] >= 0.5;
 }
 
 void ParameterManager::LogSample(double score) {
   if (log_ == nullptr) return;
-  fprintf(log_, "%.3f,%lld,%.1f\n", current_.cycle_time_ms,
-          static_cast<long long>(current_.fusion_threshold), score);
+  fprintf(log_, "%.3f,%lld,%d,%.1f\n", current_.cycle_time_ms,
+          static_cast<long long>(current_.fusion_threshold),
+          current_.cache_enabled ? 1 : 0, score);
   fflush(log_);
 }
 

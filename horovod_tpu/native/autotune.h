@@ -69,20 +69,25 @@ class BayesianOptimizer {
   uint64_t rng_ = 0x9e3779b97f4a7c15ull;  // deterministic across ranks/runs
 };
 
-// Tunes cycle time and fusion threshold online, scored by bytes/sec.
-// Coordinator-only; winning values are broadcast to workers by the core
-// (reference: ParameterManager lives in HorovodGlobalState and is driven
-// from the background loop, operations.cc:615-643).
+// Tunes cycle time, fusion threshold, and the response-cache on/off switch
+// online, scored by bytes/sec. Coordinator-only; winning values are
+// broadcast to workers by the core (reference: ParameterManager lives in
+// HorovodGlobalState and is driven from the background loop,
+// operations.cc:615-643; the cache switch mirrors the reference's
+// CategoricalParameter dimensions, parameter_manager.h:165/:225 —
+// represented here as a thresholded third GP dimension).
 class ParameterManager {
  public:
   struct Params {
     double cycle_time_ms;
     int64_t fusion_threshold;
+    bool cache_enabled;
   };
 
   void Initialize(double cycle_time_ms, int64_t fusion_threshold,
-                  const std::string& log_path, int warmup_samples,
-                  int cycles_per_sample, int max_samples, double gp_noise);
+                  bool cache_enabled, const std::string& log_path,
+                  int warmup_samples, int cycles_per_sample, int max_samples,
+                  double gp_noise);
   ~ParameterManager();
 
   bool active() const { return active_; }
@@ -103,8 +108,8 @@ class ParameterManager {
 
   bool active_ = false;
   bool frozen_ = false;
-  Params current_{1.0, 64 << 20};
-  BayesianOptimizer opt_{2};
+  Params current_{1.0, 64 << 20, true};
+  BayesianOptimizer opt_{3};
   int warmup_samples_ = 3;
   int cycles_per_sample_ = 50;
   int max_samples_ = 30;

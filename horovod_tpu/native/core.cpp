@@ -130,7 +130,16 @@ bool SameRequest(const Request& a, const Request& b) {
 class RequestCache {
  public:
   void SetCapacity(int64_t cap) { capacity_ = cap; }
-  bool enabled() const { return capacity_ > 0; }
+  // Autotune's categorical switch (reference: CategoricalParameter cache
+  // on/off, parameter_manager.h:225). capacity==0 (user opt-out) always
+  // wins. Toggling only gates the WIRE fast path (enabled()); both sides
+  // keep tracking() entries while disabled — otherwise a request that
+  // changed during a disabled window would leave a stale-but-valid entry
+  // behind, and a later bare-name hit on it is NOT repaired by NEED_FULL
+  // (that round trip only covers absent entries).
+  void SetEnabled(bool on) { on_ = on; }
+  bool enabled() const { return on_ && capacity_ > 0; }
+  bool tracking() const { return capacity_ > 0; }
 
   // Worker side: true if `q` matches the cached entry for its name (-> the
   // bare name suffices on the wire). Updates/inserts the entry otherwise.
@@ -204,6 +213,7 @@ class RequestCache {
   }
 
   int64_t capacity_ = 1024;  // reference default: HOROVOD_CACHE_CAPACITY
+  bool on_ = true;
   Map map_;
   std::list<std::string> lru_;
 };
@@ -382,6 +392,7 @@ Status Core::Start() {
   cache_.SetCapacity(cfg_.cache_capacity);
   if (cfg_.autotune && cfg_.rank == 0) {
     param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
+                              cfg_.cache_capacity > 0,
                               cfg_.autotune_log, cfg_.autotune_warmup_samples,
                               cfg_.autotune_cycles_per_sample,
                               cfg_.autotune_max_samples,
@@ -727,7 +738,11 @@ void Core::PumpControlPlane() {
       std::vector<Request> fulls;
       std::vector<std::string> cached;
       for (auto& q : reqs) {
-        if (cache_.enabled() && cache_.CheckAndPut(q)) {
+        // CheckAndPut always tracks (keeps this side's entry fresh across
+        // autotune cache toggles); enabled() only gates the bare-name wire
+        // fast path.
+        bool hit = cache_.tracking() && cache_.CheckAndPut(q);
+        if (hit && cache_.enabled()) {
           cached.push_back(q.name);
         } else {
           fulls.push_back(std::move(q));
@@ -790,16 +805,20 @@ void Core::PumpControlPlane() {
             fulls.push_back(std::move(q));
           }
         }
-        for (auto& q : fulls) cache_.CheckAndPut(q);  // refresh local entry
+        if (cache_.tracking()) {
+          for (auto& q : fulls) cache_.CheckAndPut(q);  // refresh local entry
+        }
         if (!fulls.empty()) WorkerSendReady(std::move(fulls), {});
         continue;
       }
       if (type == CtrlMsg::PARAMS) {
         double cycle = r.F64();
         int64_t fusion = r.I64();
+        bool cache_on = r.I32() != 0;
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = cycle;
         cfg_.fusion_threshold = fusion;
+        cache_.SetEnabled(cache_on);
         continue;
       }
       if (type != CtrlMsg::RESPONSES) continue;
@@ -868,7 +887,7 @@ void Core::CoordinatorIngest() {
         for (int64_t i = 0; i < n && r.ok(); ++i) {
           Request q = DeserializeRequest(&r);
           if (!r.ok()) break;
-          if (cache_.enabled()) cache_.PutRank(q);
+          if (cache_.tracking()) cache_.PutRank(q);
           reqs.push_back(std::move(q));
         }
         // Cache-hit names: re-materialize the full request this rank last
@@ -1235,12 +1254,14 @@ void Core::CoordinatorEmitResponses() {
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = p.cycle_time_ms;
         cfg_.fusion_threshold = p.fusion_threshold;
+        cache_.SetEnabled(p.cache_enabled);
       }
       if (cfg_.size > 1) {
         Writer w;
         w.I32(static_cast<int32_t>(CtrlMsg::PARAMS));
         w.F64(p.cycle_time_ms);
         w.I64(p.fusion_threshold);
+        w.I32(p.cache_enabled ? 1 : 0);
         std::vector<uint8_t> payload = w.Take();
         for (int rank = 1; rank < cfg_.size; ++rank) {
           if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);

@@ -144,7 +144,8 @@ class Reader {
   }
   void Raw(void* p, size_t n) {
     if (pos_ + n > buf_.size()) { ok_ = false; return; }
-    memcpy(p, buf_.data() + pos_, n);
+    if (n > 0)  // memcpy with null dst is UB even for n == 0
+      memcpy(p, buf_.data() + pos_, n);
     pos_ += n;
   }
   const std::vector<uint8_t>& buf_;

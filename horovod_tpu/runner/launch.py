@@ -38,6 +38,17 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("-p", "--start-port", type=int, default=0,
                    help="controller port (default: free ephemeral port)")
     p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--controller-advertise-address", default=None,
+                   help="address workers dial for the rank-0 controller "
+                        "(multi-NIC escape hatch; reference analog: "
+                        "--network-interface NIC selection)")
+    p.add_argument("--no-preflight", action="store_true",
+                   help="skip the multi-host connectivity preflight "
+                        "(reference analog: driver_service.py NIC probing)")
+    p.add_argument("--preflight-timeout", type=float, default=30.0)
+    p.add_argument("--remote-python", default="python3",
+                   help="python executable on remote hosts (used by the "
+                        "connectivity preflight)")
     p.add_argument("--timeline", default=None,
                    help="write per-rank Chrome-trace timelines to "
                         "FILE.rank.json (reference: --timeline-filename)")
@@ -254,6 +265,27 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
                        verbose=args.verbose)
 
 
+def _preflight_spawn(args):
+    """Build the per-host probe spawner for the connectivity preflight:
+    same local/SSH exec path the real workers use."""
+    def spawn(host: str, env: dict):
+        cmd = [sys.executable if _is_local(host) else args.remote_python,
+               "-m", "horovod_tpu.runner.preflight"]
+        if _is_local(host):
+            full_env = dict(os.environ)
+            full_env.update(env)
+            return safe_exec.WorkerProcess(cmd, full_env,
+                                           f"preflight@{host}")
+        stdin = None
+        secret = env.get(ev.HVDTPU_SECRET)
+        if secret:
+            stdin = (secret + "\n").encode()
+        return safe_exec.WorkerProcess(
+            _ssh_wrap(host, args.ssh_port, env, cmd), dict(os.environ),
+            f"preflight@{host}", stdin_data=stdin)
+    return spawn
+
+
 def run_launcher(args: argparse.Namespace) -> int:
     if args.host_discovery_script:
         return run_elastic_launcher(args)
@@ -261,8 +293,24 @@ def run_launcher(args: argparse.Namespace) -> int:
                  else hosts_mod.parse_hosts(args.hosts or
                                             f"localhost:{args.num_proc}"))
     slots = hosts_mod.get_host_assignments(host_list, args.num_proc)
-    controller_host = slots[0].hostname
+    controller_host = args.controller_advertise_address or slots[0].hostname
     controller_port = args.start_port or _free_port()
+
+    # Multi-host job: probe reachability BEFORE spawning workers so a
+    # wrong-NIC / firewalled setup fails fast with a named host instead of
+    # hanging in controller rendezvous (reference:
+    # driver_service.py:193 NIC probing; round-2 verdict #6).
+    hostnames = [s.hostname for s in slots]
+    if not args.no_preflight and any(not _is_local(h) for h in hostnames):
+        from .preflight import check_connectivity
+        _apply_tuning_env({}, args)  # ensure args._job_secret exists
+        # listen_host = the slot that will actually run rank 0 (it binds the
+        # port); controller_host may be an advertise ADDRESS of that host.
+        check_connectivity(hostnames, controller_host, controller_port,
+                           _preflight_spawn(args),
+                           timeout=args.preflight_timeout,
+                           secret=args._job_secret,
+                           listen_host=slots[0].hostname)
 
     commands, envs, names, stdins = [], [], [], []
     for slot in slots:

@@ -1,0 +1,224 @@
+"""Connectivity preflight for multi-host process-mode jobs.
+
+Reference: ``horovod/runner/driver/driver_service.py:193`` — before launching
+workers, the reference's driver service probes mutual reachability and
+intersects usable network interfaces; a wrong-NIC setup fails fast with a
+named host instead of hanging in rendezvous.
+
+TPU-native redesign: the single coordination endpoint is rank 0's TCP
+controller, so the preflight checks exactly the two paths a worker will use:
+
+1. every host can reach the launcher's KV store (proves SSH exec + the
+   launcher's advertised address);
+2. every non-controller host can open a TCP connection to the controller
+   endpoint (a throwaway listener bound by the controller host's preflight
+   process on the real controller port).
+
+Failures name the unreachable host and the address tried, and point at
+``--controller-advertise-address`` / ``HVDTPU_ADVERTISE_ADDR``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils import envvars as ev
+
+_POLL_S = 0.2
+
+
+def local_addr() -> str:
+    """An address other hosts can reach this one on.
+
+    ``HVDTPU_ADVERTISE_ADDR`` overrides (the multi-NIC escape hatch);
+    otherwise the default-route NIC is picked via a connectionless UDP
+    socket (reference: driver-service address collection)."""
+    override = os.environ.get(ev.HVDTPU_ADVERTISE_ADDR)
+    if override:
+        return override
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 53))  # no traffic sent; picks the default NIC
+        addr = s.getsockname()[0]
+        s.close()
+        return addr
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _wait_key(client, key: str, deadline: float) -> Optional[bytes]:
+    while time.monotonic() < deadline:
+        try:
+            val = client.get(key)
+        except Exception:
+            val = None
+        if val:
+            return val
+        time.sleep(_POLL_S)
+    return None
+
+
+def probe_main() -> int:
+    """Per-host probe body (run as ``python -m horovod_tpu.runner.preflight``
+    on each job host). Role and endpoints come from the environment."""
+    from .http_kv import KVStoreClient
+
+    kv_addr = os.environ["HVDTPU_PREFLIGHT_KV_ADDR"]
+    kv_port = int(os.environ["HVDTPU_PREFLIGHT_KV_PORT"])
+    host = os.environ["HVDTPU_PREFLIGHT_HOST"]
+    role = os.environ["HVDTPU_PREFLIGHT_ROLE"]  # "listen" | "connect"
+    ctrl_host, ctrl_port = os.environ["HVDTPU_PREFLIGHT_CONTROLLER"]\
+        .rsplit(":", 1)
+    ctrl_port = int(ctrl_port)
+    timeout = float(os.environ.get("HVDTPU_PREFLIGHT_TIMEOUT", "30"))
+    deadline = time.monotonic() + timeout
+    secret = os.environ.get(ev.HVDTPU_SECRET) or None
+    client = KVStoreClient(kv_addr, kv_port, timeout=5.0, secret=secret)
+
+    if role == "listen":
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind(("", ctrl_port))
+            srv.listen(64)
+        except OSError as e:
+            client.put(f"/preflight/result/{host}",
+                       f"bind-failed on port {ctrl_port}: {e}".encode())
+            return 1
+        srv.settimeout(_POLL_S)
+        client.put("/preflight/listening", b"1")
+        client.put(f"/preflight/result/{host}", b"ok")
+        # Accept (and drop) probe connections until the launcher says done.
+        while time.monotonic() < deadline:
+            try:
+                conn, _ = srv.accept()
+                conn.close()
+            except socket.timeout:
+                pass
+            try:
+                if client.get("/preflight/done"):
+                    break
+            except Exception:
+                pass
+        srv.close()
+        return 0
+
+    # role == "connect"
+    if _wait_key(client, "/preflight/listening", deadline) is None:
+        client.put(f"/preflight/result/{host}",
+                   b"timeout waiting for the controller-side listener")
+        return 1
+    err = None
+    for _ in range(3):
+        try:
+            with socket.create_connection((ctrl_host, ctrl_port),
+                                          timeout=5.0):
+                err = None
+                break
+        except OSError as e:
+            err = e
+            time.sleep(0.5)
+    if err is None:
+        client.put(f"/preflight/result/{host}", b"ok")
+        return 0
+    client.put(f"/preflight/result/{host}",
+               f"cannot connect to controller {ctrl_host}:{ctrl_port}: "
+               f"{err}".encode())
+    return 1
+
+
+def check_connectivity(hostnames: List[str], controller_host: str,
+                       controller_port: int,
+                       spawn: Callable[[str, Dict[str, str]], object],
+                       timeout: float = 30.0,
+                       secret: Optional[str] = None,
+                       listen_host: Optional[str] = None) -> None:
+    """Launcher side: probe every host before spawning real workers.
+
+    ``spawn(host, env) -> WorkerProcess`` runs the probe on ``host`` (SSH or
+    local — the launcher's existing exec path, so the preflight also proves
+    SSH works). ``controller_host`` is the address workers DIAL (possibly an
+    advertise address); ``listen_host`` is the slot hostname that will run
+    rank 0 and therefore binds the listener (defaults to ``controller_host``
+    — they differ exactly when ``--controller-advertise-address`` is set).
+    Raises ``RuntimeError`` naming every unreachable host.
+    """
+    from .http_kv import KVStoreServer
+
+    uniq = list(dict.fromkeys(hostnames))
+    listen_host = listen_host if listen_host is not None else controller_host
+    server = KVStoreServer(secret=secret)
+    server.start()
+    kv_addr = local_addr()
+    procs: Dict[str, object] = {}
+    try:
+        for host in uniq:
+            env = {
+                "HVDTPU_PREFLIGHT_KV_ADDR": kv_addr,
+                "HVDTPU_PREFLIGHT_KV_PORT": str(server.port),
+                "HVDTPU_PREFLIGHT_HOST": host,
+                "HVDTPU_PREFLIGHT_ROLE":
+                    "listen" if host == listen_host else "connect",
+                "HVDTPU_PREFLIGHT_CONTROLLER":
+                    f"{controller_host}:{controller_port}",
+                "HVDTPU_PREFLIGHT_TIMEOUT": str(timeout),
+            }
+            if secret:
+                env[ev.HVDTPU_SECRET] = secret
+            procs[host] = spawn(host, env)
+
+        deadline = time.monotonic() + timeout
+        results: Dict[str, str] = {}
+        while time.monotonic() < deadline and len(results) < len(uniq):
+            for host in uniq:
+                if host in results:
+                    continue
+                val = server.get(f"/preflight/result/{host}")
+                if val:
+                    results[host] = val.decode()
+            time.sleep(_POLL_S)
+        server.put("/preflight/done", b"1")
+
+        failures = []
+        for host in uniq:
+            got = results.get(host)
+            if got is None:
+                failures.append(
+                    f"  {host}: no response — the host cannot reach the "
+                    f"launcher KV at {kv_addr}:{server.port} (or SSH/python "
+                    "failed there)")
+            elif got != "ok":
+                failures.append(f"  {host}: {got}")
+        if failures:
+            raise RuntimeError(
+                "connectivity preflight failed (reference behavior: "
+                "driver_service.py NIC probing):\n" + "\n".join(failures) +
+                "\nIf a host is multi-homed, set "
+                "--controller-advertise-address / HVDTPU_ADVERTISE_ADDR to "
+                "an address reachable from every worker.")
+
+        # Wait for the listen probe to exit and release the REAL controller
+        # port before the launcher spawns rank 0 — terminating the local ssh
+        # client would orphan the remote probe holding the bind for up to
+        # the probe timeout, and rank 0 would then fail with EADDRINUSE on
+        # a cluster the preflight just declared healthy.
+        listener = procs.get(listen_host)
+        if listener is not None:
+            exit_deadline = time.monotonic() + 10.0
+            while time.monotonic() < exit_deadline and \
+                    listener.poll() is None:
+                time.sleep(_POLL_S)
+    finally:
+        for p in procs.values():
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        server.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(probe_main())

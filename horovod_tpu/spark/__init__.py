@@ -29,16 +29,11 @@ _POLL_S = 0.25
 
 
 def _local_addr() -> str:
-    """An address executors can reach the driver on (reference:
-    driver_service address collection, horovod/runner/driver/driver_service.py)."""
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 53))  # no traffic sent; picks the default NIC
-        addr = s.getsockname()[0]
-        s.close()
-        return addr
-    except OSError:
-        return socket.gethostbyname(socket.gethostname())
+    """An address executors can reach the driver on, overridable via
+    HVDTPU_ADVERTISE_ADDR (reference: driver_service address collection,
+    horovod/runner/driver/driver_service.py)."""
+    from horovod_tpu.runner.preflight import local_addr
+    return local_addr()
 
 
 def _free_port() -> int:

@@ -26,6 +26,9 @@ HVDTPU_CROSS_RANK = "HVDTPU_CROSS_RANK"
 HVDTPU_CROSS_SIZE = "HVDTPU_CROSS_SIZE"
 HVDTPU_HOSTNAME = "HVDTPU_HOSTNAME"
 HVDTPU_SECRET = "HVDTPU_SECRET"  # shared job secret (reference: secret.py)
+# Multi-NIC escape hatch: the address this process advertises to peers
+# (reference analog: driver_service.py NIC intersection).
+HVDTPU_ADVERTISE_ADDR = "HVDTPU_ADVERTISE_ADDR"
 HVDTPU_RENDEZVOUS_ADDR = "HVDTPU_RENDEZVOUS_ADDR"
 HVDTPU_RENDEZVOUS_PORT = "HVDTPU_RENDEZVOUS_PORT"
 HVDTPU_CONTROLLER_ADDR = "HVDTPU_CONTROLLER_ADDR"

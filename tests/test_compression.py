@@ -117,6 +117,40 @@ class TestPallasKernels:
                                    np.asarray(expect), atol=1e-5)
 
 
+class TestDequantSumKernel:
+    def test_matches_per_rank_loop(self):
+        """Fused dequantize-sum kernel == sum of individual dequants
+        (interpret mode on the CPU mesh)."""
+        from horovod_tpu.compression.pallas_kernels import (
+            maxmin_dequantize_sum_pallas)
+        rng = np.random.RandomState(5)
+        n, nb, bs = 4, 7, 64
+        q = rng.randint(0, 256, size=(n, nb, bs)).astype(np.uint8)
+        mn = rng.randn(n, nb).astype(np.float32)
+        unit = rng.rand(n, nb).astype(np.float32)
+        out = maxmin_dequantize_sum_pallas(
+            jnp.asarray(q), jnp.asarray(mn), jnp.asarray(unit), True)
+        expect = (q.astype(np.float32) * unit[:, :, None]
+                  + mn[:, :, None]).sum(axis=0)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+class TestStochasticRounding:
+    def test_xla_fallback_unbiased(self):
+        """E[stochastic quantize] == x (the property the pltpu kernel must
+        preserve; the kernel itself needs a real TPU — CPU has no pltpu
+        PRNG lowering, so this pins the fallback the chip path must match)."""
+        q = MaxMinQuantizer(bits=2, bucket_size=64, stochastic=True,
+                            use_pallas=False)
+        x = jnp.asarray(np.random.RandomState(6).randn(64).astype(np.float32))
+        acc = np.zeros(64, np.float64)
+        trials = 300
+        for i in range(trials):
+            p, ctx = q.compress(x, jax.random.PRNGKey(i))
+            acc += np.asarray(q.decompress(p, ctx))
+        np.testing.assert_allclose(acc / trials, np.asarray(x), atol=0.2)
+
+
 class TestNormalized:
     @pytest.mark.parametrize("kind,bound", [("uni", 0.06), ("exp", 0.35)])
     def test_roundtrip_reasonable(self, kind, bound):

@@ -1,0 +1,80 @@
+"""Sanitizer CI for the native core (SURVEY.md §5: the reference ships no
+TSAN/ASAN CI; the rebuild adds it — round-2 verdict #7: ~2,900 LoC of
+hand-rolled threaded C++ was guarded only by Python-level tests).
+
+Strategy: build the core with -fsanitize={thread|address,undefined}
+(``make tsan`` / ``make asan``), point workers at the instrumented .so via
+``HVDTPU_NATIVE_LIB``, LD_PRELOAD the sanitizer runtime (the python host
+binary is uninstrumented), and drive the full process-mode op menu
+(``proc_worker.py``: queue, controller negotiation, fusion, TCP ring data
+plane, join) across 2 real ranks. Any report fails the run: TSan/ASan exit
+66 on findings, and UBSan "runtime error" lines are scanned explicitly.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+from conftest import assert_all_ok, launch_world
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "horovod_tpu", "native")
+WORKER = os.path.join(REPO, "tests", "data", "proc_worker.py")
+
+
+def _gcc_file(name: str) -> str:
+    out = subprocess.run(["g++", f"-print-file-name={name}"],
+                         capture_output=True, text=True)
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) else ""
+
+
+def _build(target: str) -> str:
+    lib = os.path.join(NATIVE, f"build-{target}", "libhvdtpu_core.so")
+    r = subprocess.run(["make", "-C", NATIVE, target], capture_output=True,
+                       text=True)
+    if r.returncode != 0 or not os.path.exists(lib):
+        pytest.skip(f"sanitizer build '{target}' unavailable: "
+                    f"{r.stderr[-300:]}")
+    return lib
+
+
+def _scan(results, *markers):
+    assert_all_ok(results)
+    for rank, (_rc, _out, err) in enumerate(results):
+        for line in err.splitlines():
+            if any(m in line for m in markers):
+                raise AssertionError(f"rank {rank} sanitizer report: {line}")
+
+
+def test_tsan_process_mode():
+    rt = _gcc_file("libtsan.so")
+    if not rt:
+        pytest.skip("libtsan.so not found")
+    lib = _build("tsan")
+    results = launch_world(2, WORKER, extra_env={
+        "HVDTPU_NATIVE_LIB": lib,
+        "LD_PRELOAD": rt,
+        # exitcode=66 turns any data-race report into a worker failure.
+        "TSAN_OPTIONS": "exitcode=66 report_thread_leaks=0",
+    }, timeout=240)
+    _scan(results, "ThreadSanitizer")
+
+
+def test_asan_ubsan_process_mode():
+    rt = _gcc_file("libasan.so")
+    stdcxx = _gcc_file("libstdc++.so")
+    if not rt or not stdcxx:
+        pytest.skip("libasan.so/libstdc++.so not found")
+    lib = _build("asan")
+    results = launch_world(2, WORKER, extra_env={
+        "HVDTPU_NATIVE_LIB": lib,
+        # libstdc++ preloaded too: ASan's __cxa_throw interceptor cannot
+        # bind when the (python) host loads libstdc++ lazily.
+        "LD_PRELOAD": f"{rt} {stdcxx}",
+        # detect_leaks=0: the python host leaks by design; we care about
+        # memory errors in the core, which still abort with exitcode 66.
+        "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=0,exitcode=66",
+    }, timeout=240)
+    _scan(results, "AddressSanitizer", "runtime error")
