@@ -38,11 +38,13 @@ class EstimatorModel:
     """Trained-model wrapper (reference: TransformerModel — holds the best
     checkpoint and serves ``transform``)."""
 
-    def __init__(self, model, params, run_id: str, history):
+    def __init__(self, model, params, run_id: str, history,
+                 val_history=None):
         self.model = model
         self.params = params
         self.run_id = run_id
-        self.history = history  # list of per-epoch losses
+        self.history = history  # list of per-epoch train losses
+        self.val_history = val_history  # per-epoch val losses, or None
 
     def transform(self, x):
         """Predict on a host batch (reference: model.transform(df))."""
@@ -54,10 +56,12 @@ class EstimatorModel:
         import jax
         blob = pickle.loads(store.load(run_id))
         params = jax.tree.map(lambda a: a, blob["params"])
-        return cls(model, params, run_id, blob.get("history", []))
+        return cls(model, params, run_id, blob.get("history", []),
+                   val_history=blob.get("val_history"))
 
 
-def _remote_fit(estimator: "Estimator", train_path: str) -> list:
+def _remote_fit(estimator: "Estimator", train_path: str,
+                val_path: Optional[str] = None):
     """Per-rank training body for the distributed (Spark) path: read this
     rank's parquet shard, train with cross-rank gradient averaging through
     the eager collectives, rank 0 checkpoints the best epoch
@@ -75,8 +79,18 @@ def _remote_fit(estimator: "Estimator", train_path: str) -> list:
     # run the same number of steps; shards can be uneven (fragment sizes,
     # dropped partials) — agree on the minimum full-batch count.
     local_steps = reader.rows() // estimator.batch_size
+    val_batches = val_local_steps = None
+    if val_path:
+        val_reader = ParquetShardReader(
+            val_path, estimator.feature_cols, estimator.label_col,
+            batch_size=estimator.batch_size, rank=hvd.rank(),
+            size=hvd.size())
+        val_batches = lambda: val_reader.batches()  # noqa: E731
+        val_local_steps = val_reader.rows() // estimator.batch_size
     return estimator._fit_loop(lambda _epoch: reader.batches(),
-                               distributed=True, local_steps=local_steps)
+                               distributed=True, local_steps=local_steps,
+                               val_batches=val_batches,
+                               val_local_steps=val_local_steps)
 
 
 class Estimator:
@@ -109,14 +123,35 @@ class Estimator:
         self.sample_input = sample_input
 
     # ------------------------------------------------------------------
-    def fit(self, data, num_proc: Optional[int] = None) -> EstimatorModel:
+    def fit(self, data, num_proc: Optional[int] = None,
+            validation=None) -> EstimatorModel:
         """Train and return the best-checkpoint model. ``num_proc`` > 0 with
-        a Spark DataFrame trains distributed via ``horovod_tpu.spark.run``."""
+        a Spark DataFrame trains distributed via ``horovod_tpu.spark.run``.
+
+        ``validation`` selects the best epoch by validation loss
+        (reference: the estimators' ``validation`` param,
+        spark/common/params.py): a ``(x, y)`` pair or float fraction for
+        array data, a Spark DataFrame with a DataFrame input, a parquet
+        directory path with a path input.
+        """
         spark_df = self._as_spark_df(data)
         if spark_df is None and not isinstance(data, str) and num_proc:
             raise ValueError(
                 "num_proc requires a Spark DataFrame or a parquet directory "
                 "path; in-memory (x, y) data trains on the local mesh only")
+        # The validation form must match the data form — a mismatch would
+        # otherwise die deep inside pyarrow/Spark with an opaque error.
+        if validation is not None:
+            if spark_df is not None and \
+                    self._as_spark_df(validation) is None:
+                raise ValueError(
+                    "validation must be a Spark DataFrame when fitting a "
+                    "Spark DataFrame")
+            if spark_df is None and isinstance(data, str) and \
+                    not isinstance(validation, str):
+                raise ValueError(
+                    "validation must be a parquet directory path when "
+                    "fitting a parquet directory")
         if spark_df is not None:
             from ..spark.util import prepare_data
             if not self.feature_cols or not self.label_col:
@@ -125,16 +160,19 @@ class Estimator:
                     "label_col (reference estimators require the same "
                     "params)")
             meta = prepare_data(spark_df, self.store, self.run_id,
-                                partitions=num_proc)
+                                validation=validation, partitions=num_proc)
             return self.fit_on_parquet(meta["train_data_path"],
-                                       num_proc=num_proc)
+                                       num_proc=num_proc,
+                                       val_path=meta.get("val_data_path"))
         if isinstance(data, str):
-            return self.fit_on_parquet(data, num_proc=num_proc)
+            return self.fit_on_parquet(data, num_proc=num_proc,
+                                       val_path=validation)
         x, y = data
-        return self._fit_arrays(x, y)
+        return self._fit_arrays(x, y, validation=validation)
 
     def fit_on_parquet(self, train_path: str,
-                       num_proc: Optional[int] = None) -> EstimatorModel:
+                       num_proc: Optional[int] = None,
+                       val_path: Optional[str] = None) -> EstimatorModel:
         """Train from a materialized parquet directory. With ``num_proc``,
         fan out over Spark tasks (process mode); otherwise read locally and
         train over the SPMD mesh."""
@@ -143,9 +181,10 @@ class Estimator:
                              "label_col")
         if num_proc:
             from .. import spark as hvd_spark
-            histories = hvd_spark.run(_remote_fit, args=(self, train_path),
+            histories = hvd_spark.run(_remote_fit,
+                                      args=(self, train_path, val_path),
                                       num_proc=num_proc)
-            history = histories[0]
+            history, val_history = histories[0]
         else:
             import horovod_tpu as hvd
             from ..spark.util import ParquetShardReader
@@ -158,11 +197,18 @@ class Estimator:
             reader = ParquetShardReader(
                 train_path, self.feature_cols, self.label_col,
                 batch_size=bs, rank=0, size=1)
-            history = self._fit_loop(lambda _e: reader.batches(),
-                                     distributed=False)
+            val_batches = None
+            if val_path:
+                val_reader = ParquetShardReader(
+                    val_path, self.feature_cols, self.label_col,
+                    batch_size=bs, rank=0, size=1)
+                val_batches = lambda: val_reader.batches()  # noqa: E731
+            history, val_history = self._fit_loop(
+                lambda _e: reader.batches(), distributed=False,
+                val_batches=val_batches)
         blob = pickle.loads(self.store.load(self.run_id))
         return EstimatorModel(self.model, blob["params"], self.run_id,
-                              history)
+                              history, val_history=val_history)
 
     # ------------------------------------------------------------------
     def _as_spark_df(self, data):
@@ -172,7 +218,7 @@ class Estimator:
             return None
         return data if isinstance(data, SparkDataFrame) else None
 
-    def _fit_arrays(self, x, y) -> EstimatorModel:
+    def _fit_arrays(self, x, y, validation=None) -> EstimatorModel:
         import numpy as np
 
         import horovod_tpu as hvd
@@ -180,6 +226,23 @@ class Estimator:
             hvd.init()
         x = np.asarray(x)
         y = np.asarray(y)
+        val_xy = None
+        if isinstance(validation, float):
+            # Fraction split (reference: validation as a ratio,
+            # spark/common/params.py validation docs).
+            n_val = int(len(x) * validation)
+            if not 0 < n_val < len(x):
+                raise ValueError(f"validation fraction {validation} leaves "
+                                 "no train or no val rows")
+            val_xy = (x[-n_val:], y[-n_val:])
+            x, y = x[:-n_val], y[:-n_val]
+        elif validation is not None:
+            if not (isinstance(validation, (tuple, list))
+                    and len(validation) == 2):
+                raise ValueError(
+                    "validation for array data must be a float fraction or "
+                    "an (x, y) pair")
+            val_xy = (np.asarray(validation[0]), np.asarray(validation[1]))
         # Batches must tile the mesh's data axis evenly; trim the remainder
         # (the reference's Petastorm loader repartitions for the same
         # reason).
@@ -190,20 +253,37 @@ class Estimator:
             for i in range(0, len(x) - bs + 1, bs):
                 yield x[i:i + bs], y[i:i + bs]
 
-        history = self._fit_loop(batches, distributed=False)
+        val_batches = None
+        if val_xy is not None:
+            xv, yv = val_xy
+            nv = len(xv) // n_shards * n_shards
+            if nv == 0:
+                raise ValueError("validation set smaller than the mesh")
+
+            def val_batches():
+                yield xv[:nv], yv[:nv]
+
+        history, val_history = self._fit_loop(batches, distributed=False,
+                                              val_batches=val_batches)
         blob = pickle.loads(self.store.load(self.run_id))
         return EstimatorModel(self.model, blob["params"], self.run_id,
-                              history)
+                              history, val_history=val_history)
 
     def _fit_loop(self, batches: Callable, distributed: bool,
-                  local_steps: Optional[int] = None) -> list:
-        """Shared epoch loop. ``batches(epoch)`` yields host ``(x, y)``
-        pairs — the full global batch in SPMD mode (sharded over the mesh),
-        this rank's local batch in distributed (process) mode (reduced
-        through the eager collectives). In distributed mode
-        ``local_steps`` (this rank's full-batch count) is MIN-agreed across
-        ranks and the epoch is truncated to it: every step runs blocking
-        collectives, so a rank with extra batches would deadlock the world."""
+                  local_steps: Optional[int] = None,
+                  val_batches: Optional[Callable] = None,
+                  val_local_steps: Optional[int] = None):
+        """Shared epoch loop; returns ``(history, val_history)``.
+
+        ``batches(epoch)`` yields host ``(x, y)`` pairs — the full global
+        batch in SPMD mode (sharded over the mesh), this rank's local batch
+        in distributed (process) mode (reduced through the eager
+        collectives). In distributed mode ``local_steps`` (this rank's
+        full-batch count) is MIN-agreed across ranks and the epoch is
+        truncated to it: every step runs blocking collectives, so a rank
+        with extra batches would deadlock the world. ``val_batches()``
+        yields validation pairs evaluated after each epoch (same MIN
+        agreement via ``val_local_steps``)."""
         import itertools
 
         import jax
@@ -216,7 +296,7 @@ class Estimator:
         if not hvd.is_initialized():
             hvd.init()
 
-        steps_per_epoch = None
+        steps_per_epoch = val_steps_per_epoch = None
         if distributed and local_steps is not None:
             agreed = hvd.allreduce(np.asarray([local_steps], np.int64),
                                    op=hvd.Min, name="estimator.steps")
@@ -226,6 +306,15 @@ class Estimator:
                     "a rank has zero full batches (shard smaller than "
                     "batch_size); use more data, fewer ranks, or a smaller "
                     "batch_size")
+        if distributed and val_local_steps is not None:
+            agreed = hvd.allreduce(np.asarray([val_local_steps], np.int64),
+                                   op=hvd.Min, name="estimator.val_steps")
+            val_steps_per_epoch = int(np.asarray(agreed)[0])
+            if val_steps_per_epoch == 0:
+                raise ValueError(
+                    "a rank has zero full validation batches (val shard "
+                    "smaller than batch_size); use a bigger validation set "
+                    "or a smaller batch_size")
 
         if self.sample_input is not None:
             sample = np.asarray(self.sample_input)
@@ -280,7 +369,28 @@ class Estimator:
                 p, s, l = step(p, s, batch)
                 return p, s, float(l)
 
+        # Eval step (no update): local jitted loss, averaged across ranks in
+        # distributed mode (the SPMD-local val batch is replicated).
+        eval_loss = jax.jit(
+            lambda p, xb, yb: loss_fn(model.apply(p, xb), yb))
+
+        def run_val(p, it):
+            losses = []
+            for xv, yv in it:
+                l = eval_loss(p, jnp.asarray(xv), jnp.asarray(yv))
+                if distributed:
+                    l = hvd.allreduce(np.asarray(l), op=hvd.Average)
+                losses.append(float(np.asarray(l)))
+            if not losses:
+                # A silent 0.0 would win best-epoch selection at epoch 0
+                # and freeze the untrained params.
+                raise ValueError(
+                    "validation produced zero full batches (val set smaller "
+                    "than batch_size)")
+            return float(np.mean(losses))
+
         history = []
+        val_history = [] if val_batches is not None else None
         best = float("inf")
         for epoch in range(self.epochs):
             epoch_losses = []
@@ -292,10 +402,22 @@ class Estimator:
                 epoch_losses.append(l)
             epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
             history.append(epoch_loss)
-            if epoch_loss < best:
-                best = epoch_loss
+            # Best-epoch selection on validation loss when given, training
+            # loss otherwise (reference: estimators checkpoint on the
+            # monitored metric, BestModelCheckpoint).
+            monitored = epoch_loss
+            if val_batches is not None:
+                vit = val_batches()
+                if val_steps_per_epoch is not None:
+                    vit = itertools.islice(vit, val_steps_per_epoch)
+                val_loss = run_val(params, vit)
+                val_history.append(val_loss)
+                monitored = val_loss
+            if monitored < best:
+                best = monitored
                 if hvd.rank() == 0:
                     host_params = jax.tree.map(np.asarray, params)
                     self.store.save(self.run_id, pickle.dumps(
-                        {"params": host_params, "history": history}))
-        return history
+                        {"params": host_params, "history": history,
+                         "val_history": val_history}))
+        return history, val_history

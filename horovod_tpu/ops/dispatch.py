@@ -1,0 +1,90 @@
+"""Priority-ordered collective backend registry.
+
+Reference: ``horovod/common/ops/operation_manager.{h,cc}`` — an ordered list
+of op implementations per collective type; the first whose ``Enabled()``
+returns true executes (priority order fixed in ``CreateOperationManager``,
+``operations.cc:151-269``: compressed → NCCL-hierarchical → NCCL → Gloo →
+CCL → MPI).
+
+TPU-native redesign: there is one fabric per execution context — XLA
+collectives in-step, the native TCP core in process mode, cached compiled
+programs for SPMD eager — so the built-in list is three backends gated by
+context rather than six gated by build flags. The registry keeps the
+reference's *mechanism*: backends are priority-ordered, ``enabled(ctx)``
+picks the first match, and users can register their own (e.g. a logging
+wrapper or an experimental fabric) above or below the built-ins, which is
+what the reference's priority list exists for.
+
+Built-in priorities: in-step 300, native process 200, SPMD eager 100
+(the fallback; always enabled).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchContext:
+    """Execution context a backend is selected against."""
+    in_step: bool     # inside a shard_map/pmap trace binding the axis
+    mode: str         # runtime mode: "spmd" | "process"
+    axis: Optional[str]
+
+
+class CollectiveBackend:
+    """Base backend (reference: ``HorovodOp`` subclasses +
+    ``OperationManager`` entries). Subclasses implement ``enabled`` and any
+    of: ``allreduce``, ``grouped_allreduce``, ``allgather``, ``broadcast``,
+    ``alltoall``, ``reducescatter`` — a missing method falls through to the
+    next enabled backend, mirroring per-op manager lists."""
+
+    name: str = "backend"
+    priority: int = 0
+
+    def enabled(self, ctx: DispatchContext) -> bool:
+        raise NotImplementedError
+
+
+_lock = threading.Lock()
+_registry: List[CollectiveBackend] = []
+
+
+def register_backend(backend: CollectiveBackend) -> None:
+    """Insert a backend by priority (highest first; stable among equals —
+    reference: the fixed construction order in CreateOperationManager)."""
+    with _lock:
+        if any(b.name == backend.name for b in _registry):
+            raise ValueError(f"backend {backend.name!r} already registered")
+        _registry.append(backend)
+        _registry.sort(key=lambda b: -b.priority)
+
+
+def unregister_backend(name: str) -> None:
+    with _lock:
+        for b in list(_registry):
+            if b.name == name:
+                _registry.remove(b)
+                return
+    raise KeyError(name)
+
+
+def backends() -> List[CollectiveBackend]:
+    """Registered backends in dispatch order (for introspection/tests)."""
+    with _lock:
+        return list(_registry)
+
+
+def resolve(op: str, ctx: DispatchContext) -> CollectiveBackend:
+    """First enabled backend implementing ``op``
+    (reference: ``OperationManager::ExecuteOperation`` trying ops in
+    order)."""
+    with _lock:
+        candidates = list(_registry)
+    for b in candidates:
+        if hasattr(b, op) and b.enabled(ctx):
+            return b
+    raise RuntimeError(
+        f"no enabled collective backend implements {op!r} for {ctx}")

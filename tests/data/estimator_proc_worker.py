@@ -32,7 +32,7 @@ est = Estimator(model=MLP(features=(16, 1)), optimizer=optax.adam(5e-2),
                 batch_size=32, run_id="proc1",
                 feature_cols=["f0", "f1"], label_col="label")
 hvd.init()
-history = _remote_fit(est, data_dir)
+history, _val_history = _remote_fit(est, data_dir)
 assert history[-1] < history[0] * 0.8, history
 if hvd.rank() == 0:
     assert os.path.exists(
