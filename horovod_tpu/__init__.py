@@ -35,6 +35,9 @@ from .ops.collectives import (
 from .parallel.optimizer import (DistributedOptimizer, DistributedGradientTape,
                                  allreduce_gradients, broadcast_parameters,
                                  broadcast_optimizer_state)
+# ZeRO-style cross-replica sharded weight update (arXiv:2004.13336;
+# TPU-first extension, no reference analog).
+from .parallel.sharded_optimizer import ShardedDistributedOptimizer
 
 # Sequence/context parallelism (TPU-first; no reference analog — SURVEY.md §2.7).
 from .parallel.ring_attention import (ring_attention, ring_attention_p,
