@@ -81,14 +81,27 @@ class ShardedDistributedOptimizer:
         return -(-total // n)
 
     def init(self, params: Any):
-        """Host-side init: inner state over the FULL flattened parameter
-        vector (padded to n*shard, with n the GLOBAL mesh's dp extent —
-        update() must run over that same axis). Passed through the step
-        with ``state_spec`` so each device holds exactly its shard."""
+        """Init the inner state over the FULL flattened parameter vector
+        (padded to n*shard, with n the GLOBAL mesh's dp extent — update()
+        must run over that same axis). The state is born SHARDED: init runs
+        under jit with dp-sharded out_shardings, so the full fp32 moments
+        never materialize on one device (the whole point of the paper is
+        that replicated state may not fit)."""
+        from jax.sharding import NamedSharding
+
         leaves = jax.tree.leaves(params)
         total = sum(_flat_sizes(leaves))
         padded = self._shard_len(total) * self._n()
-        return self._inner.init(_flatten_pad(leaves, padded))
+
+        def _init(leaves_):
+            return self._inner.init(_flatten_pad(leaves_, padded))
+
+        abstract = jax.eval_shape(_init, leaves)
+        mesh = runtime.mesh()
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self.state_spec(abstract),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(_init, out_shardings=shardings)(leaves)
 
     def state_spec(self, state: Any):
         """PartitionSpec pytree for threading the state through
@@ -121,23 +134,28 @@ class ShardedDistributedOptimizer:
         shard_len = -(-total // n)
         padded = shard_len * n
 
-        flat_g = _flatten_pad(leaves, padded)
-        if C._dp_invariant(flat_g, ax):
-            # Gradients of replicated params under check_vma arrive already
-            # cross-rank psummed (autodiff inserts it): reduce-scatter would
-            # re-sum n identical sums. Take the local shard and normalize
-            # only — same contract as allreduce_p's invariant branch.
+        # Invariance is a PER-LEAF property: gradients of replicated params
+        # under check_vma arrive already cross-rank psummed (autodiff
+        # inserts it), while pvary'd params yield per-rank grads. Checking
+        # only the fused buffer would double-reduce the invariant leaves of
+        # a mixed tree — same contract as allreduce_p's per-tensor branch.
+        inv = [C._dp_invariant(g, ax) for g in leaves]
+        if all(inv):
+            # Everything already reduced: the "reduce-scatter" is a slice.
+            flat_g = _flatten_pad(leaves, padded)
             g_shard = lax.dynamic_slice(flat_g, (idx * shard_len,),
                                         (shard_len,))
-            if self._op == C.ReduceOp.AVERAGE:
-                g_shard = g_shard / n
         else:
-            # Bandwidth-optimal reduction to shards (the all-reduce's first
-            # half); Average divides once here.
+            # Pre-divide invariant leaves by n and mark them varying, so one
+            # reduce-scatter (the all-reduce's bandwidth-optimal first half)
+            # gives SUM semantics uniformly across the mixed tree.
+            norm = [C.pvary(g.astype(jnp.float32) / n, ax) if f else g
+                    for g, f in zip(leaves, inv)]
+            flat_g = _flatten_pad(norm, padded)
             g_shard = lax.psum_scatter(flat_g, ax, scatter_dimension=0,
                                        tiled=True)
-            if self._op == C.ReduceOp.AVERAGE:
-                g_shard = g_shard / n
+        if self._op == C.ReduceOp.AVERAGE:
+            g_shard = g_shard / n
 
         flat_p = _flatten_pad(jax.tree.leaves(params), padded)
         p_shard = lax.dynamic_slice(flat_p, (idx * shard_len,), (shard_len,))

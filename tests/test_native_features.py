@@ -68,3 +68,17 @@ def test_runtime_timeline(tmp_path):
         2, os.path.join(DATA, "timeline_worker.py"),
         extra_env={"TEST_TIMELINE_PATH": str(tmp_path / "tl")})
     assert_all_ok(results)
+
+
+def test_native_unit_tests():
+    """Build and run the C++ unit-test binary (SURVEY.md §4: the reference
+    tests its native core only through Python; the rebuild adds direct
+    native-layer tests — wire roundtrips, truncation safety, half floats,
+    reduction ops, GP/Bayesian-optimizer math)."""
+    import subprocess
+    native = os.path.abspath(os.path.join(DATA, "..", "..", "horovod_tpu",
+                                          "native"))
+    r = subprocess.run(["make", "-C", native, "check"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "ALL OK" in r.stdout
