@@ -167,7 +167,7 @@ def _quantize_kernel_bench(jnp, jax):
     verdict #9: the stochastic kernel must be benchmarked on the real
     chip). Direct kernel calls, so a lowering failure shows up as an
     explicit error entry instead of silently timing the fallback."""
-    from horovod_tpu.compression import MaxMinQuantizer
+    from horovod_tpu.compression import MaxMinQuantizer, NormalizedQuantizer
     from horovod_tpu.compression import pallas_kernels as pk
 
     # Random data passed as an ARGUMENT: a closed-over constant would be
@@ -187,6 +187,12 @@ def _quantize_kernel_bench(jnp, jax):
             lambda: pk.maxmin_quantize_stochastic_pallas(x, 4, 512, seed)[0],
         "quantize_stochastic_xla": lambda: sto_fn(x, key),
     }
+    norm_x = NormalizedQuantizer(bits=8, use_pallas=False)
+    norm_fn = jax.jit(lambda v: norm_x.compress(v)[0]["q"])
+    levels = norm_x._levels()
+    cases["norm_quantize_pallas"] = \
+        lambda: pk.norm_quantize_pallas(x, levels, 512, False)[0]
+    cases["norm_quantize_xla"] = lambda: norm_fn(x)
     out = []
     for name, fn in cases.items():
         try:

@@ -36,6 +36,120 @@ def _dequantize_kernel(x_ref, mn_ref, unit_ref, out_ref):
     out_ref[:] = mn_ref[:] + x_ref[:].astype(jnp.float32) * unit_ref[:]
 
 
+def _norm_quantize_kernel(use_l2: bool, n_levels: int, x_ref, levels_ref,
+                          q_ref, norm_ref):
+    """Nearest-level norm quantization (reference: CPUNormalizedQuantizer,
+    compressor.h:219). The level search runs as an L-iteration running
+    argmin over the block in VMEM — the XLA fallback materializes the full
+    [block, bucket, L] distance tensor instead (L x the HBM traffic)."""
+    x = x_ref[:]
+    if use_l2:
+        norm = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    else:
+        norm = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    safe = jnp.where(norm == 0, 1.0, norm)
+    ratio = jnp.abs(x) / safe
+
+    def body(i, carry):
+        best_d, best_i = carry
+        d = jnp.abs(ratio - levels_ref[i])
+        take = d < best_d
+        return (jnp.where(take, d, best_d),
+                jnp.where(take, i, best_i))
+
+    best_d0 = jnp.abs(ratio - levels_ref[0])
+    best_i0 = jnp.zeros(x.shape, jnp.int32)
+    _, best_i = jax.lax.fori_loop(1, n_levels, body, (best_d0, best_i0))
+    sign = (x < 0).astype(jnp.uint8)
+    q_ref[:] = ((best_i.astype(jnp.uint8) << 1) | sign)
+    norm_ref[:] = norm
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def norm_quantize_pallas(flat: jnp.ndarray, levels: jnp.ndarray,
+                         bucket_size: int, use_l2: bool,
+                         interpret: bool = False):
+    """Bucket-wise norm quantization on the TPU; returns
+    (q [n_buckets, bucket_size] uint8 with sign in bit 0, norm [n_buckets]).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = flat.shape[0]
+    n_buckets = -(-n // bucket_size)
+    grid = -(-n_buckets // BUCKET_BLOCK)
+    padded_buckets = grid * BUCKET_BLOCK
+    padded = jnp.zeros((padded_buckets * bucket_size,), jnp.float32)
+    padded = padded.at[:n].set(flat)
+    x = padded.reshape(padded_buckets, bucket_size)
+
+    q, norm = pl.pallas_call(
+        functools.partial(_norm_quantize_kernel, use_l2,
+                          int(levels.shape[0])),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BUCKET_BLOCK, bucket_size), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((BUCKET_BLOCK, bucket_size), lambda i: (i, 0)),
+            pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, levels.astype(jnp.float32))
+    return q[:n_buckets], norm[:n_buckets, 0]
+
+
+def _norm_dequantize_kernel(n_levels: int, q_ref, levels_ref, norm_ref,
+                            out_ref):
+    q = q_ref[:]
+    # Clamp like the XLA fallback (quantize.py decompress): a payload from a
+    # larger table decompressed after set_quantization_levels installed a
+    # smaller one must reconstruct at the last level, not silently as 0.
+    idx = jnp.clip((q >> 1).astype(jnp.int32), 0, n_levels - 1)
+    sign = 1.0 - 2.0 * (q & 1).astype(jnp.float32)
+
+    def body(i, acc):
+        return acc + jnp.where(idx == i, levels_ref[i], 0.0)
+
+    vals = jax.lax.fori_loop(0, n_levels, body,
+                             jnp.zeros(q.shape, jnp.float32))
+    out_ref[:] = sign * vals * norm_ref[:]
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def norm_dequantize_pallas(q: jnp.ndarray, levels: jnp.ndarray,
+                           norm: jnp.ndarray, interpret: bool = False):
+    """Inverse of :func:`norm_quantize_pallas`:
+    [n_buckets, bucket] uint8 -> fp32 via an L-iteration table expansion."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_buckets, bucket = q.shape
+    grid = -(-n_buckets // BUCKET_BLOCK)
+    padded_buckets = grid * BUCKET_BLOCK
+    qp = jnp.zeros((padded_buckets, bucket), jnp.uint8).at[:n_buckets].set(q)
+    np_ = jnp.zeros((padded_buckets, 1), jnp.float32)\
+        .at[:n_buckets, 0].set(norm)
+
+    out = pl.pallas_call(
+        functools.partial(_norm_dequantize_kernel, int(levels.shape[0])),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BUCKET_BLOCK, bucket), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((BUCKET_BLOCK, bucket), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded_buckets, bucket),
+                                       jnp.float32),
+        interpret=interpret,
+    )(qp, levels.astype(jnp.float32), np_)
+    return out[:n_buckets]
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def maxmin_quantize_pallas(flat: jnp.ndarray, bits: int, bucket_size: int,
                            interpret: bool = False):

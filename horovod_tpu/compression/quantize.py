@@ -58,6 +58,14 @@ def unpack_bits(p: jnp.ndarray, bits: int, count: int) -> jnp.ndarray:
     return vals.reshape(-1)[:count].astype(jnp.uint8)
 
 
+def _pallas_backend_enabled(override: Optional[bool]) -> bool:
+    """Shared use-Pallas gate: explicit override wins, else the backend must
+    be a TPU (the kernels have no CPU lowering outside interpret mode)."""
+    if override is not None:
+        return override
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def _seed_from_key(key: Optional[jax.Array]) -> jnp.ndarray:
     """An int32 seed for the TPU hardware PRNG from a JAX PRNG key (typed or
     raw uint32 data); zero when no key is given (deterministic noise)."""
@@ -128,9 +136,7 @@ class MaxMinQuantizer:
             other._cache_key() == self._cache_key()
 
     def _pallas_enabled(self) -> bool:
-        if self._use_pallas is not None:
-            return self._use_pallas
-        return jax.default_backend() in ("tpu", "axon")
+        return _pallas_backend_enabled(self._use_pallas)
 
     def compress(self, x: jnp.ndarray, key: Optional[jax.Array] = None):
         ctx = QuantContext(shape=tuple(x.shape), dtype=x.dtype,
@@ -220,19 +226,25 @@ class NormalizedQuantizer:
     uniform ("uni") or exponential ("exp")."""
 
     def __init__(self, bits: int = 4, bucket_size: int = DEFAULT_BUCKET_SIZE,
-                 levels: str = "uni", norm: str = "linf"):
+                 levels: str = "uni", norm: str = "linf",
+                 use_pallas: Optional[bool] = None):
         if bits not in (2, 4, 8):
             raise ValueError("bits must be 2, 4 or 8")
         self.bits = bits
         self.bucket_size = bucket_size
         self.kind = levels
         self.norm = norm
+        self._use_pallas = use_pallas
+
+    def _pallas_enabled(self) -> bool:
+        return _pallas_backend_enabled(self._use_pallas)
 
     def _cache_key(self):
         # The user level table is part of identity: set_quantization_levels
         # must invalidate cached compiled programs that baked the old table.
         lv = _user_levels.get(self.kind)
         return ("norm", self.bits, self.bucket_size, self.kind, self.norm,
+                self._use_pallas,
                 None if lv is None else lv.tobytes())
 
     def __hash__(self):
@@ -259,6 +271,17 @@ class NormalizedQuantizer:
                            int(np.prod(x.shape)) if x.shape else 1,
                            self.bits, self.bucket_size)
         flat = x.reshape(-1).astype(jnp.float32)
+        if self._pallas_enabled():
+            from . import pallas_kernels as pk
+            try:
+                q, norms = pk.norm_quantize_pallas(
+                    flat, self._levels(), self.bucket_size,
+                    self.norm == "l2")
+                payload = {"q": pack_bits(q.reshape(-1), self.bits),
+                           "norm": norms}
+                return payload, ctx
+            except Exception:
+                pass  # fall back to the XLA path (unsupported backend)
         buckets, _ = _bucketize(flat, self.bucket_size)
         if self.norm == "l2":
             norms = jnp.sqrt(jnp.sum(buckets * buckets, axis=1, keepdims=True))
@@ -280,6 +303,16 @@ class NormalizedQuantizer:
     def decompress(self, payload, ctx: QuantContext) -> jnp.ndarray:
         padded = -(-ctx.count // ctx.bucket_size) * ctx.bucket_size
         q = unpack_bits(payload["q"], ctx.bits, padded)
+        if self._pallas_enabled():
+            from . import pallas_kernels as pk
+            try:
+                out = pk.norm_dequantize_pallas(
+                    q.reshape(-1, ctx.bucket_size), self._levels(),
+                    payload["norm"].reshape(-1))
+                return out.reshape(-1)[:ctx.count].reshape(ctx.shape)\
+                    .astype(ctx.dtype)
+            except Exception:
+                pass  # XLA fallback below
         sign = 1.0 - 2.0 * (q & 1).astype(jnp.float32)
         idx = (q >> 1).astype(jnp.int32)
         levels = self._levels()

@@ -117,6 +117,36 @@ class TestPallasKernels:
                                    np.asarray(expect), atol=1e-5)
 
 
+class TestNormQuantizeKernel:
+    @pytest.mark.parametrize("norm,bits", [("linf", 4), ("l2", 4),
+                                           ("linf", 8)])
+    def test_matches_xla_path(self, norm, bits):
+        """Pallas norm-quantize/dequantize (interpret mode) == the XLA
+        argmin path, including sign handling and tie-breaking."""
+        from horovod_tpu.compression.pallas_kernels import (
+            norm_dequantize_pallas, norm_quantize_pallas)
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(1500).astype(np.float32))
+        ref = NormalizedQuantizer(bits=bits, bucket_size=128, norm=norm,
+                                  use_pallas=False)
+        payload, ctx = ref.compress(x)
+        expect = ref.decompress(payload, ctx)
+
+        q, norms = norm_quantize_pallas(x, ref._levels(), 128,
+                                        norm == "l2", True)
+        # Quantized codes and norms agree with the XLA path bit-for-bit.
+        from horovod_tpu.compression.quantize import unpack_bits
+        padded = -(-1500 // 128) * 128
+        np.testing.assert_array_equal(
+            np.asarray(q).reshape(-1)[:1500],
+            np.asarray(unpack_bits(payload["q"], bits, padded))[:1500])
+        np.testing.assert_allclose(np.asarray(norms),
+                                   np.asarray(payload["norm"]), rtol=1e-6)
+        out = norm_dequantize_pallas(q, ref._levels(), norms, True)
+        np.testing.assert_allclose(np.asarray(out).reshape(-1)[:1500],
+                                   np.asarray(expect), rtol=1e-5)
+
+
 class TestDequantSumKernel:
     def test_matches_per_rank_loop(self):
         """Fused dequantize-sum kernel == sum of individual dequants
