@@ -16,9 +16,12 @@ index, kv_port = int(sys.argv[1]), int(sys.argv[2])
 from horovod_tpu.spark import _elastic_spark_task  # noqa: E402
 
 TARGET = int(os.environ.get("SPARK_ELASTIC_TARGET", "3"))
+BATCH_SLEEP = float(os.environ.get("SPARK_ELASTIC_BATCH_SLEEP", "0"))
 
 
 def train():
+    import time
+
     import horovod_tpu as hvd
 
     state = hvd.elastic.ObjectState(batches=0, total=0.0)
@@ -31,6 +34,10 @@ def train():
             state.total += float(np.asarray(out)[0])  # == world size
             state.batches += 1
             state.commit()
+            if BATCH_SLEEP:
+                # Pace the loop so membership changes land mid-run
+                # deterministically (scale-up tests race otherwise).
+                time.sleep(BATCH_SLEEP)
         return hvd.size()
 
     return loop(state)

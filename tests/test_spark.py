@@ -253,6 +253,53 @@ def test_spark_elastic_task_rendezvous_without_spark():
         drv.stop()
 
 
+def test_spark_elastic_scale_up_mid_run():
+    """A third worker joining mid-run triggers a new rendezvous epoch; the
+    running workers hit HostsUpdatedInterrupt at commit() and re-form at
+    size 3 (reference flow: spark elastic under dynamic allocation adding
+    executors)."""
+    import time
+    from horovod_tpu.spark.elastic import HeartbeatRendezvous
+
+    drv = HeartbeatRendezvous(min_np=2, max_np=3, interval_s=0.1)
+    drv.start()
+    worker = os.path.join(REPO, "tests", "data", "spark_elastic_worker.py")
+    env = dict(subprocess_env())
+    # Generous target: the joiner's interpreter+jax cold start must land
+    # BEFORE the 2-worker world finishes, even on a loaded box.
+    env.update({"SPARK_ELASTIC_TARGET": "40",
+                "SPARK_ELASTIC_BATCH_SLEEP": "0.5"})
+    procs = []
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(i), str(drv.port)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            for i in range(2)]
+        # Let the 2-worker world form and train a few batches, then join.
+        deadline = time.monotonic() + 60
+        while drv.epoch < 1 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert drv.epoch >= 1, "initial rendezvous never happened"
+        time.sleep(1.5)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, "2", str(drv.port)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = []
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker {i}:\n{err}\n{out}"
+            assert "ALL OK" in out
+            outs.append(out)
+        # Everyone finished in the grown world.
+        assert all("size=3" in o for o in outs), outs
+        assert drv.epoch >= 2  # initial + at least one growth rendezvous
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        drv.stop()
+
+
 def test_estimator_remote_fit_process_mode(tmp_path):
     """The estimator's distributed training body across 2 process-mode
     ranks, each reading its parquet shard — the Spark-task execution path
