@@ -60,7 +60,7 @@ from .exceptions import (HvdTpuInternalError, HostsUpdatedInterrupt,
                          DuplicateNameError, NotInitializedError)
 
 from .callbacks import (average_metrics, warmup_schedule,  # noqa: E402
-                        BestModelCheckpoint)
+                        lr_schedule, BestModelCheckpoint)
 from . import elastic  # noqa: E402  (reference: horovod/torch/elastic.py)
 
 

@@ -57,6 +57,60 @@ def warmup_schedule(base_lr: float, warmup_steps: int,
     return schedule
 
 
+def lr_schedule(base_lr: float, multiplier, start_epoch: int = 0,
+                end_epoch: Optional[int] = None,
+                steps_per_epoch: Optional[int] = None,
+                staircase: bool = True, scale_to_world: bool = False):
+    """Epoch-windowed learning-rate multiplier schedule
+    (reference: ``LearningRateScheduleCallbackImpl``,
+    ``_keras/callbacks.py:66+`` — lr = initial_lr * multiplier(epoch) within
+    [start_epoch, end_epoch), constant multipliers allowed, ``staircase``
+    switches between per-epoch jumps and smooth per-step interpolation).
+
+    Returns an optax-style ``schedule(step) -> lr``; ``steps_per_epoch``
+    converts the step counter to epochs (required whenever an epoch matters:
+    callable multipliers or any non-default window).
+
+    Unlike the reference's Python-per-epoch callback, the schedule runs
+    under jit, so a callable ``multiplier`` receives a TRACED epoch value
+    and must be jax-traceable — write ``jnp.where(epoch < 50, 0.1, 0.01)``,
+    not ``0.1 if epoch < 50 else 0.01``.
+
+    Compose with :func:`warmup_schedule` via its ``after`` hook; pass
+    ``scale_to_world=True`` to both so the post-warmup LR stays at
+    ``base_lr * size`` (the linear-scaling rule) instead of cliffing back
+    to ``base_lr`` outside the window.
+    """
+    needs_epochs = callable(multiplier) or start_epoch > 0 or \
+        end_epoch is not None
+    if needs_epochs and not steps_per_epoch:
+        raise ValueError(
+            "steps_per_epoch (> 0) is required to map the step counter to "
+            "epochs (callable multiplier or epoch window in use)")
+    if not callable(multiplier):
+        mult_value = float(multiplier)
+        multiplier = lambda _epoch: mult_value  # noqa: E731
+    world = runtime.size() if (scale_to_world and
+                               runtime.is_initialized()) else 1
+    eff_base = base_lr * world
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        if steps_per_epoch:
+            epoch = step / steps_per_epoch
+            if staircase:
+                epoch = jnp.floor(epoch)
+        else:
+            epoch = jnp.zeros_like(step)
+        lr = eff_base * jnp.asarray(multiplier(epoch), jnp.float32)
+        in_window = epoch >= start_epoch
+        if end_epoch is not None:
+            in_window = jnp.logical_and(in_window, epoch < end_epoch)
+        return jnp.where(in_window, lr, jnp.asarray(eff_base, jnp.float32))
+
+    return schedule
+
+
 class BestModelCheckpoint:
     """Keep the best checkpoint by a monitored metric, saving on rank 0 only
     (reference: ``horovod/keras/callbacks.py:157``). Uses orbax when

@@ -61,3 +61,56 @@ class TestCallbacks:
         assert ckpt(dict(loss=0.5), state2) is True
         loaded = ckpt.load()
         np.testing.assert_allclose(np.asarray(loaded["w"]), 7.0)
+
+
+class TestLrSchedule:
+    def test_constant_multiplier_window(self, spmd8):
+        """lr = base * m inside [start, end), base outside (reference:
+        LearningRateScheduleCallbackImpl with a constant multiplier)."""
+        sched = hvd.lr_schedule(0.1, multiplier=0.5, start_epoch=2,
+                                end_epoch=4, steps_per_epoch=10)
+        np.testing.assert_allclose(float(sched(5)), 0.1)     # epoch 0
+        np.testing.assert_allclose(float(sched(25)), 0.05)   # epoch 2
+        np.testing.assert_allclose(float(sched(39)), 0.05)   # epoch 3
+        np.testing.assert_allclose(float(sched(45)), 0.1)    # epoch 4
+    
+    def test_callable_multiplier_staircase(self, spmd8):
+        """Exponential decay per epoch, staircase vs smooth."""
+        stair = hvd.lr_schedule(1.0, multiplier=lambda e: 0.5 ** e,
+                                steps_per_epoch=10, staircase=True)
+        smooth = hvd.lr_schedule(1.0, multiplier=lambda e: 0.5 ** e,
+                                 steps_per_epoch=10, staircase=False)
+        np.testing.assert_allclose(float(stair(15)), 0.5)    # epoch floor 1
+        np.testing.assert_allclose(float(smooth(15)), 0.5 ** 1.5, rtol=1e-6)
+
+    def test_callable_requires_steps_per_epoch(self, spmd8):
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            hvd.lr_schedule(0.1, multiplier=lambda e: 1.0)
+
+    def test_composes_with_warmup(self, spmd8):
+        decay = hvd.lr_schedule(0.1, multiplier=0.1, start_epoch=0,
+                                steps_per_epoch=5)
+        sched = hvd.warmup_schedule(0.1, warmup_steps=10, after=decay)
+        assert float(sched(0)) == pytest.approx(0.1)
+        assert float(sched(20)) == pytest.approx(0.01)
+
+    def test_window_requires_steps_per_epoch(self, spmd8):
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            hvd.lr_schedule(0.1, multiplier=0.5, start_epoch=2)
+
+    def test_traceable_multiplier_under_jit(self, spmd8):
+        sched = hvd.lr_schedule(
+            1.0, multiplier=lambda e: jnp.where(e < 2, 1.0, 0.1),
+            steps_per_epoch=10)
+        lr = jax.jit(sched)(jnp.asarray(25))
+        np.testing.assert_allclose(float(lr), 0.1)
+
+    def test_scale_to_world_no_cliff(self, spmd8):
+        """Composed warmup -> windowed decay must not drop from base*size
+        back to base outside the window (review regression)."""
+        decay = hvd.lr_schedule(0.1, multiplier=0.5, start_epoch=30,
+                                steps_per_epoch=10, scale_to_world=True)
+        sched = hvd.warmup_schedule(0.1, warmup_steps=10, after=decay)
+        assert float(sched(10)) == pytest.approx(0.8)   # warmup done: 0.1*8
+        assert float(sched(50)) == pytest.approx(0.8)   # pre-window: no cliff
+        assert float(sched(350)) == pytest.approx(0.4)  # in window: *0.5
