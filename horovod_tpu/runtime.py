@@ -190,6 +190,56 @@ def _elastic_assignment() -> Optional[dict]:
     raise TimeoutError("elastic rendezvous timed out")
 
 
+_jax_distributed_done = False
+
+
+def _maybe_init_jax_distributed() -> None:
+    """Multi-host SPMD bootstrap: call ``jax.distributed.initialize`` so
+    every host sees the GLOBAL device set before the mesh is built
+    (the control-plane role MPI_Init / gloo rendezvous plays in the
+    reference, SURVEY §2.7 — on TPU pods the coordinator rides DCN).
+
+    Opt-in: explicit coordinator via ``HVDTPU_COORDINATOR_ADDR`` (+
+    ``HVDTPU_NUM_PROCESSES`` / ``HVDTPU_PROCESS_ID``), or
+    ``HVDTPU_AUTO_DISTRIBUTED=1`` for Cloud-TPU metadata auto-detection.
+    Single-host runs (the default) skip it entirely — calling initialize
+    on a lone CPU host would hang waiting for a coordinator.
+    """
+    global _jax_distributed_done
+    if _jax_distributed_done:
+        return
+    import jax
+
+    coord = ev.get_str(ev.HVDTPU_COORDINATOR_ADDR)
+    auto = ev.get_bool(ev.HVDTPU_AUTO_DISTRIBUTED)
+    if not coord and not auto:
+        return
+    kwargs = {}
+    if coord:
+        # Explicit coordinator: the full triple is REQUIRED. A missing
+        # HVDTPU_PROCESS_ID would silently default every host to process 0
+        # and the job would hang deep inside the coordinator with no hint
+        # which env var is missing.
+        nproc = ev.get_int(ev.HVDTPU_NUM_PROCESSES, 0)
+        pid = ev.get_str(ev.HVDTPU_PROCESS_ID)
+        if not nproc or pid is None or pid == "":
+            raise ValueError(
+                "HVDTPU_COORDINATOR_ADDR requires HVDTPU_NUM_PROCESSES and "
+                "HVDTPU_PROCESS_ID to be set explicitly on every host "
+                "(or use HVDTPU_AUTO_DISTRIBUTED=1 on managed clusters)")
+        kwargs["coordinator_address"] = coord
+        kwargs["num_processes"] = nproc
+        kwargs["process_id"] = int(pid)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already" not in str(e).lower():
+            raise
+    _jax_distributed_done = True
+    log.info("init: jax.distributed ready (process %d/%d, %d global devices)",
+             jax.process_index(), jax.process_count(), len(jax.devices()))
+
+
 def _build_mesh(mesh_shape, axis_names, devices):
     import jax
     from jax.sharding import Mesh
@@ -307,14 +357,18 @@ def init(comm: Optional[Sequence[int]] = None,
                       st.rank, st.size, st.local_rank, st.local_size)
         else:
             import jax
+            _maybe_init_jax_distributed()
             st.mesh, st.axis_names = _build_mesh(mesh_shape, axis_names, devices)
             st.dp_axis = dp_axis if dp_axis in st.axis_names else st.axis_names[0]
             st.size = int(np.prod(list(st.mesh.shape.values())))
-            n_local = len([d for d in st.mesh.devices.flat
-                           if d.process_index == jax.process_index()])
-            st.local_size = max(n_local, 1)
+            local_idx = [i for i, d in enumerate(st.mesh.devices.flat)
+                         if d.process_index == jax.process_index()]
+            st.local_size = max(len(local_idx), 1)
             st.local_rank = 0
-            st.rank = jax.process_index() * st.local_size
+            # rank() == the first LOCAL device's global mesh index (not
+            # process_index * local_size, which collides across hosts with
+            # unequal device counts).
+            st.rank = local_idx[0] if local_idx else 0
             st.cross_rank = jax.process_index()
             st.cross_size = jax.process_count()
             log.debug("init: spmd mode mesh=%s size=%d", st.mesh.shape, st.size)

@@ -29,6 +29,12 @@ HVDTPU_SECRET = "HVDTPU_SECRET"  # shared job secret (reference: secret.py)
 # Multi-NIC escape hatch: the address this process advertises to peers
 # (reference analog: driver_service.py NIC intersection).
 HVDTPU_ADVERTISE_ADDR = "HVDTPU_ADVERTISE_ADDR"
+# Multi-host SPMD bootstrap (jax.distributed; the MPI_Init/gloo-rendezvous
+# role for the compiled path — SURVEY §2.7 control plane).
+HVDTPU_COORDINATOR_ADDR = "HVDTPU_COORDINATOR_ADDR"
+HVDTPU_NUM_PROCESSES = "HVDTPU_NUM_PROCESSES"
+HVDTPU_PROCESS_ID = "HVDTPU_PROCESS_ID"
+HVDTPU_AUTO_DISTRIBUTED = "HVDTPU_AUTO_DISTRIBUTED"
 HVDTPU_RENDEZVOUS_ADDR = "HVDTPU_RENDEZVOUS_ADDR"
 HVDTPU_RENDEZVOUS_PORT = "HVDTPU_RENDEZVOUS_PORT"
 HVDTPU_CONTROLLER_ADDR = "HVDTPU_CONTROLLER_ADDR"

@@ -179,3 +179,38 @@ def test_single_rank_without_native_core(monkeypatch):
         hvd.join()
     finally:
         hvd.shutdown()
+
+
+def test_spmd_multihost_bootstrap():
+    """REAL multi-host SPMD: two processes bootstrap via jax.distributed
+    (HVDTPU_COORDINATOR_ADDR), build ONE global mesh, and run cross-host
+    in-step collectives (the compiled-path control plane; SURVEY §2.7 —
+    the role MPI_Init/gloo rendezvous plays in the reference)."""
+    import subprocess
+    import sys
+
+    from conftest import free_port, subprocess_env
+
+    port = free_port()
+    worker = os.path.join(REPO, "tests", "data", "spmd_multihost_worker.py")
+    procs = []
+    for pid in range(2):
+        env = subprocess_env()
+        env.pop("XLA_FLAGS", None)  # the worker sets its own device count
+        env.update({
+            "HVDTPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            "HVDTPU_NUM_PROCESSES": "2",
+            "HVDTPU_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    try:
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"process {i}:\n{err}\n{out}"
+            assert "ALL OK" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
