@@ -211,6 +211,65 @@ def _quantize_kernel_bench(jnp, jax):
     return out
 
 
+def _gpt_bench(jax, jnp):
+    """Secondary metric: GPT training throughput (tokens/sec/chip, bf16) —
+    broadens the perf evidence beyond convnets. Fully guarded: any failure
+    becomes an error note without costing the headline metric. Size knobs
+    are env-overridable for quick local (CPU) smokes."""
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import gpt
+
+    layers = int(os.environ.get("HVDTPU_BENCH_GPT_LAYERS", 6))
+    embed = int(os.environ.get("HVDTPU_BENCH_GPT_EMBED", 512))
+    cfg = gpt.GPTConfig(vocab_size=32000, num_layers=layers, num_heads=8,
+                        head_dim=embed // 8, embed_dim=embed,
+                        mlp_dim=4 * embed, dtype=jnp.bfloat16, tp_axis=None,
+                        sp_axis=None, attention="dense")
+    B = int(os.environ.get("HVDTPU_BENCH_GPT_BATCH", 8))
+    S = int(os.environ.get("HVDTPU_BENCH_GPT_SEQ", 1024))
+    params = gpt.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1).at[:, -1].set(-1)
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    opt = optax.sgd(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets, positions):
+        loss, grads = jax.value_and_grad(
+            lambda p: gpt.loss_fn(p, tokens, targets, positions, cfg))(
+                params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for _ in range(3):  # warmup + compile
+        params, opt_state, loss = step(params, opt_state, tokens, targets,
+                                       positions)
+    _fence(jax, loss)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens, targets,
+                                       positions)
+    _fence(jax, loss)
+    dt = time.perf_counter() - t0
+    tok_s = B * S * iters / dt
+    # Standard training-FLOPs estimate: ~6 * params per token (fwd+bwd).
+    peak = _peak_flops_per_chip(jax.devices()[0])
+    mfu = round(6.0 * n_params * tok_s / peak, 4) if peak else None
+    entry = {"model": f"GPT {n_params / 1e6:.0f}M (L{cfg.num_layers} "
+                      f"d{cfg.embed_dim} seq {S} bs {B})",
+             "tokens_per_sec_per_chip": round(tok_s, 1), "mfu": mfu}
+    if mfu is not None and mfu > 1.0:
+        entry["error"] = f"mfu={mfu} exceeds 1.0 — measurement invalid"
+    return entry
+
+
 def _run():
     import jax
     # Local-validation escape hatch: the axon sitecustomize force-overrides
@@ -316,6 +375,10 @@ def _run():
     mfu = round(achieved / peak, 4) if peak else None
 
     micro = _microbench(hvd, jnp, jax)
+    try:
+        gpt_metric = _gpt_bench(jax, jnp)
+    except Exception as exc:
+        gpt_metric = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
 
     result = {
         "metric": "ResNet-50 synthetic training throughput per chip "
@@ -329,6 +392,7 @@ def _run():
         "loss": loss_value,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         "microbench": micro,
+        "gpt": gpt_metric,
     }
     if mfu is not None and mfu > 1.0:
         # >100% of peak is physically impossible: the measurement is broken

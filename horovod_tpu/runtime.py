@@ -367,8 +367,11 @@ def init(comm: Optional[Sequence[int]] = None,
             st.local_rank = 0
             # rank() == the first LOCAL device's global mesh index (not
             # process_index * local_size, which collides across hosts with
-            # unequal device counts).
-            st.rank = local_idx[0] if local_idx else 0
+            # unequal device counts). A host contributing NO devices to the
+            # mesh still needs a unique rank (rank-0 gates must not fire on
+            # every such host): give it a slot past the device ranks.
+            st.rank = local_idx[0] if local_idx else \
+                st.size + jax.process_index()
             st.cross_rank = jax.process_index()
             st.cross_size = jax.process_count()
             log.debug("init: spmd mode mesh=%s size=%d", st.mesh.shape, st.size)
