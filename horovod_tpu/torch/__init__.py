@@ -30,6 +30,11 @@ from ..ops.collectives import ReduceOp, Average, Sum, Adasum, Min, Max, Product
 from ..runtime import (init, shutdown, is_initialized, rank, size, local_rank,
                        local_size, cross_rank, cross_size, is_homogeneous,
                        start_timeline, stop_timeline)
+# Build/feature introspection (reference: horovod/torch re-exports the
+# *_built/*_enabled checks from horovod.common.util).
+from .. import (mpi_threads_supported, mpi_enabled, mpi_built,  # noqa: F401
+                gloo_enabled, gloo_built, nccl_built, ddl_built, ccl_built,
+                cuda_built, rocm_built)
 from .optimizer import DistributedOptimizer
 from .compression import Compression
 from .sync_batch_norm import SyncBatchNorm
@@ -47,6 +52,9 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer", "Compression",
     "SyncBatchNorm",
+    "mpi_threads_supported", "mpi_enabled", "mpi_built", "gloo_enabled",
+    "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+    "rocm_built",
 ]
 
 
