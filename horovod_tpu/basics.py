@@ -41,9 +41,18 @@ def _ensure_built() -> str:
     if override:
         return override
     if not os.path.exists(_LIB_PATH):
-        log.info("building native core in %s", _NATIVE_DIR)
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True)
+        # Serialize across processes: a cold start under a multi-worker
+        # launcher has every worker discover the missing .so at once, and
+        # concurrent `make` runs corrupt each other's objects (observed as
+        # a worker dlopen-ing a half-linked library).
+        import fcntl
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(_LIB_PATH):
+                log.info("building native core in %s", _NATIVE_DIR)
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True)
     return _LIB_PATH
 
 
