@@ -27,13 +27,16 @@ def _quantize_kernel(levels: int, x_ref, q_ref, mn_ref, unit_ref):
     unit = (mx - mn) / levels
     safe = jnp.where(unit == 0, 1.0, unit)
     q = jnp.clip(jnp.round((x - mn) / safe), 0, levels)
-    q_ref[:] = q.astype(jnp.uint8)
+    # Mosaic has no f32->u8 cast; hop through i32 (verified on v5e).
+    q_ref[:] = q.astype(jnp.int32).astype(jnp.uint8)
     mn_ref[:] = mn
     unit_ref[:] = unit
 
 
 def _dequantize_kernel(x_ref, mn_ref, unit_ref, out_ref):
-    out_ref[:] = mn_ref[:] + x_ref[:].astype(jnp.float32) * unit_ref[:]
+    # u8 -> i32 -> f32: Mosaic supports no direct 8-bit <-> f32 casts.
+    codes = x_ref[:].astype(jnp.int32).astype(jnp.float32)
+    out_ref[:] = mn_ref[:] + codes * unit_ref[:]
 
 
 def _norm_quantize_kernel(use_l2: bool, n_levels: int, x_ref, levels_ref,
@@ -60,8 +63,9 @@ def _norm_quantize_kernel(use_l2: bool, n_levels: int, x_ref, levels_ref,
     best_d0 = jnp.abs(ratio - levels_ref[0])
     best_i0 = jnp.zeros(x.shape, jnp.int32)
     _, best_i = jax.lax.fori_loop(1, n_levels, body, (best_d0, best_i0))
-    sign = (x < 0).astype(jnp.uint8)
-    q_ref[:] = ((best_i.astype(jnp.uint8) << 1) | sign)
+    # Pack in i32 (8-bit shifts/ors don't lower on Mosaic), cast last.
+    sign = (x < 0).astype(jnp.int32)
+    q_ref[:] = ((best_i << 1) | sign).astype(jnp.uint8)
     norm_ref[:] = norm
 
 
@@ -105,11 +109,11 @@ def norm_quantize_pallas(flat: jnp.ndarray, levels: jnp.ndarray,
 
 def _norm_dequantize_kernel(n_levels: int, q_ref, levels_ref, norm_ref,
                             out_ref):
-    q = q_ref[:]
+    q = q_ref[:].astype(jnp.int32)  # widen first: no 8-bit bit-ops on Mosaic
     # Clamp like the XLA fallback (quantize.py decompress): a payload from a
     # larger table decompressed after set_quantization_levels installed a
     # smaller one must reconstruct at the last level, not silently as 0.
-    idx = jnp.clip((q >> 1).astype(jnp.int32), 0, n_levels - 1)
+    idx = jnp.clip(q >> 1, 0, n_levels - 1)
     sign = 1.0 - 2.0 * (q & 1).astype(jnp.float32)
 
     def body(i, acc):
@@ -207,7 +211,7 @@ def _quantize_stochastic_kernel(levels: int, x_ref, seed_ref, q_ref, mn_ref,
     bits = pltpu.prng_random_bits(x.shape)
     u = (bits & 0xffffff).astype(jnp.float32) * (1.0 / (1 << 24))
     q = jnp.clip(jnp.floor(scaled + u), 0, levels)
-    q_ref[:] = q.astype(jnp.uint8)
+    q_ref[:] = q.astype(jnp.int32).astype(jnp.uint8)
     mn_ref[:] = mn
     unit_ref[:] = unit
 
@@ -259,7 +263,7 @@ def _dequantize_sum_kernel(x_ref, mn_ref, unit_ref, out_ref):
     # x: [n_ranks, BLOCK, bucket] uint8; accumulate all ranks' dequantized
     # values in one VMEM pass (reference: the dequant+add inner loops of
     # the compressed reducers, cuda_compression_functions.cu).
-    x = x_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.int32).astype(jnp.float32)
     total = jnp.sum(x * unit_ref[:], axis=0) + jnp.sum(mn_ref[:], axis=0)
     out_ref[:] = total
 

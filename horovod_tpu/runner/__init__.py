@@ -13,9 +13,12 @@ try:
     import cloudpickle as _fn_pickler  # function serialization by value
 except ImportError:  # pragma: no cover
     _fn_pickler = pickle
-import sys
-import tempfile
 from typing import Any, Callable, List, Optional
+
+# Protocol env consumed by task_runner (forwarded over ssh automatically:
+# safe_exec.ssh_wrap exports every HVDTPU_* variable).
+_KV_ADDR_ENV = "HVDTPU_RUN_KV_ADDR"
+_KV_PORT_ENV = "HVDTPU_RUN_KV_PORT"
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
@@ -26,29 +29,32 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     (reference signature: ``horovod.run``, horovod/runner/__init__.py:99;
     ``use_gloo``/``use_mpi`` accepted for parity — the native TCP controller
     always fills the gloo role, there is no MPI).
+
+    The pickled function travels to workers through the launcher's
+    HMAC-authenticated HTTP KV store and per-rank results travel back the
+    same way, so ``hosts`` may name remote machines (they need ssh access
+    and ``horovod_tpu`` importable by ``remote_python``) — no shared
+    filesystem required.
     """
     from .launch import parse_args, run_launcher
-    from . import hosts as hosts_mod
-
-    if hosts:
-        import socket as _socket
-        local_names = {"localhost", "127.0.0.1", _socket.gethostname()}
-        remote = [h for h, _ in hosts_mod.parse_hosts(hosts)
-                  if h not in local_names]
-        if remote:
-            # The pickled fn and per-rank result files live in a
-            # launcher-local temp dir, which remote workers can't see.
-            raise NotImplementedError(
-                f"programmatic run() is local-only (remote hosts {remote} "
-                "would need a shared filesystem); use the hvdrun CLI for "
-                "multi-host jobs")
+    from .http_kv import KVStoreServer
+    from .preflight import local_addr
+    from .safe_exec import PYTHON_PLACEHOLDER
+    from ..utils import envvars as ev
 
     kwargs = kwargs or {}
-    with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as tmp:
-        fn_path = os.path.join(tmp, "fn.pkl")
-        out_path = os.path.join(tmp, "out")
-        with open(fn_path, "wb") as f:
-            _fn_pickler.dump((fn, args, kwargs), f)
+    secret = os.environ.get(ev.HVDTPU_SECRET) or __import__(
+        "secrets").token_hex(16)
+    server = KVStoreServer(secret=secret)
+    server.start()
+    server.put("/run/fn", _fn_pickler.dumps((fn, args, kwargs)))
+
+    saved = {k: os.environ.get(k)
+             for k in (ev.HVDTPU_SECRET, _KV_ADDR_ENV, _KV_PORT_ENV)}
+    os.environ[ev.HVDTPU_SECRET] = secret
+    os.environ[_KV_ADDR_ENV] = local_addr()
+    os.environ[_KV_PORT_ENV] = str(server.port)
+    try:
         argv = ["-np", str(np)]
         if hosts:
             argv += ["-H", hosts]
@@ -60,13 +66,26 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 argv.append(flag)
             elif v is not False and v is not None:
                 argv += [flag, str(v)]
-        argv += [sys.executable, "-m", "horovod_tpu.runner.task_runner",
-                 fn_path, out_path]
+        # Per-slot interpreter: the spawn site substitutes the launcher's
+        # sys.executable on local slots and --remote-python on ssh slots
+        # (a mixed local+remote job has no single correct literal).
+        argv += [PYTHON_PLACEHOLDER, "-m", "horovod_tpu.runner.task_runner",
+                 "--kv"]
         rc = run_launcher(parse_args(argv))
         if rc != 0:
             raise RuntimeError(f"hvdrun job failed with exit code {rc}")
         results = []
         for rank in range(np):
-            with open(f"{out_path}.{rank}", "rb") as f:
-                results.append(pickle.load(f))
+            val = server.get(f"/run/result/{rank}")
+            if val is None:
+                raise RuntimeError(
+                    f"worker rank {rank} exited 0 but posted no result")
+            results.append(pickle.loads(val))
         return results
+    finally:
+        server.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -196,8 +196,10 @@ class ElasticDriver:
                 f"{env['HVDTPU_TIMELINE']}.{worker_id.replace(':', '_')}.json")
         if self._verbose:
             log.info("elastic: spawning %s", worker_id)
-        if safe_exec.is_local_host(hostname):
-            command = self._command
+        local = safe_exec.is_local_host(hostname)
+        cmd = safe_exec.resolve_python(self._command, local)
+        if local:
+            command = cmd
             stdin_data = None
         else:
             stdin_data = None
@@ -206,7 +208,7 @@ class ElasticDriver:
             # the remote rank-0 host are possible but unlikely (ephemeral
             # range); rank 0 fails fast and re-rendezvouses if so.
             env["HVDTPU_RENDEZVOUS_ADDR"] = socket.gethostname()
-            command = safe_exec.ssh_wrap(hostname, 22, env, self._command)
+            command = safe_exec.ssh_wrap(hostname, 22, env, cmd)
             if self._secret:
                 stdin_data = (self._secret + "\n").encode()
         proc = safe_exec.WorkerProcess(command, env, worker_id,

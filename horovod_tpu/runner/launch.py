@@ -315,13 +315,16 @@ def run_launcher(args: argparse.Namespace) -> int:
     commands, envs, names, stdins = [], [], [], []
     for slot in slots:
         env = _build_env(slot, args, controller_host, controller_port)
-        if _is_local(slot.hostname):
-            commands.append(list(args.command))
+        local = _is_local(slot.hostname)
+        cmd = safe_exec.resolve_python(args.command, local,
+                                       args.remote_python)
+        if local:
+            commands.append(cmd)
             envs.append(env)
             stdins.append(None)
         else:
             commands.append(_ssh_wrap(slot.hostname, args.ssh_port, env,
-                                      args.command))
+                                      cmd))
             envs.append(dict(os.environ))
             # Secret travels over ssh stdin, never the command line.
             secret = env.get(ev.HVDTPU_SECRET)

@@ -49,6 +49,30 @@ def local_addr() -> str:
         return socket.gethostbyname(socket.gethostname())
 
 
+def _local_addresses() -> List[str]:
+    """All IPv4 addresses configured on this host (reference:
+    driver_service.py interface enumeration — used to tell the operator
+    which advertise addresses exist when the default one is unreachable)."""
+    import subprocess
+    addrs = set()
+    try:
+        out = subprocess.run(["ip", "-o", "-4", "addr", "show"],
+                             capture_output=True, text=True,
+                             timeout=5).stdout
+        for line in out.splitlines():
+            parts = line.split()
+            if "inet" in parts:
+                addrs.add(parts[parts.index("inet") + 1].split("/")[0])
+    except Exception:
+        pass
+    try:
+        addrs.update(i[4][0] for i in socket.getaddrinfo(
+            socket.gethostname(), None, socket.AF_INET))
+    except OSError:
+        pass
+    return sorted(addrs)
+
+
 def _wait_key(client, key: str, deadline: float) -> Optional[bytes]:
     while time.monotonic() < deadline:
         try:
@@ -89,6 +113,11 @@ def probe_main() -> int:
                        f"bind-failed on port {ctrl_port}: {e}".encode())
             return 1
         srv.settimeout(_POLL_S)
+        # Publish this host's addresses: when a connector can't reach the
+        # controller, these are the candidate --controller-advertise-address
+        # values (reference: driver_service interface intersection).
+        client.put("/preflight/controller_addrs",
+                   ", ".join(_local_addresses()).encode())
         client.put("/preflight/listening", b"1")
         client.put(f"/preflight/result/{host}", b"ok")
         # Accept (and drop) probe connections until the launcher says done.
@@ -126,7 +155,8 @@ def probe_main() -> int:
         return 0
     client.put(f"/preflight/result/{host}",
                f"cannot connect to controller {ctrl_host}:{ctrl_port}: "
-               f"{err}".encode())
+               f"{err} (this host's addresses: "
+               f"{', '.join(_local_addresses()) or 'unknown'})".encode())
     return 1
 
 
@@ -193,12 +223,17 @@ def check_connectivity(hostnames: List[str], controller_host: str,
             elif got != "ok":
                 failures.append(f"  {host}: {got}")
         if failures:
+            cands = server.get("/preflight/controller_addrs")
+            hint = ""
+            if cands:
+                hint = ("\nController-host candidate addresses: "
+                        f"{cands.decode()}")
             raise RuntimeError(
                 "connectivity preflight failed (reference behavior: "
                 "driver_service.py NIC probing):\n" + "\n".join(failures) +
                 "\nIf a host is multi-homed, set "
                 "--controller-advertise-address / HVDTPU_ADVERTISE_ADDR to "
-                "an address reachable from every worker.")
+                "an address reachable from every worker." + hint)
 
         # Wait for the listen probe to exit and release the REAL controller
         # port before the launcher spawns rank 0 — terminating the local ssh

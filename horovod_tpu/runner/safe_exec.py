@@ -16,6 +16,24 @@ import time
 from typing import Dict, List, Optional
 
 
+# Worker-command interpreter placeholder. A literal interpreter string
+# cannot be right for every slot of a mixed local+remote job (the
+# launcher's venv python does not exist on remote hosts, and the remote
+# python may lack the launcher's venv), so callers that build worker
+# commands programmatically pass this and the spawn site resolves it
+# per slot.
+PYTHON_PLACEHOLDER = "{python}"
+
+
+def resolve_python(command: List[str], local: bool,
+                   remote_python: str = "python3") -> List[str]:
+    """Substitute :data:`PYTHON_PLACEHOLDER` at command[0]: the launcher's
+    own interpreter for local slots, ``remote_python`` for ssh slots."""
+    if command and command[0] == PYTHON_PLACEHOLDER:
+        return [sys.executable if local else remote_python] + command[1:]
+    return list(command)
+
+
 def ssh_wrap(host: str, ssh_port: int, env: Dict[str, str],
              command: List[str]) -> List[str]:
     """Build an SSH remote command with HVDTPU_* env forwarding

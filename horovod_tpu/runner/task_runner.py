@@ -1,20 +1,24 @@
-"""Worker-side entry for the programmatic ``run()`` API: unpickle the function,
-run it under the initialized runtime, pickle the result back.
+"""Worker-side entry for the programmatic ``run()`` API: fetch the pickled
+function, run it under the initialized runtime, and post the result back.
 
 Reference: the remote-exec side of ``horovod.run`` (``horovod/runner/__init__.py:99``
-+ ``run/__init__.py`` wrapped-function temp-file protocol).
++ ``run/__init__.py`` wrapped-function protocol). Two transports:
+
+- ``--kv`` (the launcher default): fetch ``/run/fn`` from the launcher's
+  HMAC-authenticated KV store and PUT ``/run/result/<rank>`` — works across
+  hosts with no shared filesystem.
+- ``<fn_path> <out_path>``: the original temp-file protocol, kept for
+  same-host tooling.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import sys
 
 
-def main() -> int:
-    fn_path, out_path = sys.argv[1], sys.argv[2]
-    with open(fn_path, "rb") as f:
-        fn, args, kwargs = pickle.load(f)
+def _run_under_runtime(fn, args, kwargs):
     import horovod_tpu as hvd
     hvd.init()
     try:
@@ -22,6 +26,30 @@ def main() -> int:
     finally:
         rank = hvd.rank()
         hvd.shutdown()
+    return rank, result
+
+
+def main() -> int:
+    if sys.argv[1] == "--kv":
+        from horovod_tpu.runner import _KV_ADDR_ENV, _KV_PORT_ENV
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        from horovod_tpu.utils import envvars as ev
+
+        client = KVStoreClient(
+            os.environ[_KV_ADDR_ENV], int(os.environ[_KV_PORT_ENV]),
+            timeout=30.0, secret=os.environ.get(ev.HVDTPU_SECRET) or None)
+        payload = client.get("/run/fn")
+        if payload is None:
+            raise RuntimeError("launcher KV store has no /run/fn payload")
+        fn, args, kwargs = pickle.loads(payload)
+        rank, result = _run_under_runtime(fn, args, kwargs)
+        client.put(f"/run/result/{rank}", pickle.dumps(result))
+        return 0
+
+    fn_path, out_path = sys.argv[1], sys.argv[2]
+    with open(fn_path, "rb") as f:
+        fn, args, kwargs = pickle.load(f)
+    rank, result = _run_under_runtime(fn, args, kwargs)
     with open(f"{out_path}.{rank}", "wb") as f:
         pickle.dump(result, f)
     return 0

@@ -76,6 +76,30 @@ class TestArgParsing:
             parse_args(["-np", "2"])
 
 
+class TestPythonPlaceholder:
+    """Per-slot interpreter substitution (a mixed local+remote job cannot
+    use one literal: the launcher's venv python is absent on remote hosts)."""
+
+    def test_local_resolves_to_launcher_interpreter(self):
+        import sys
+        from horovod_tpu.runner.safe_exec import (PYTHON_PLACEHOLDER,
+                                                  resolve_python)
+        cmd = resolve_python([PYTHON_PLACEHOLDER, "-m", "mod"], local=True)
+        assert cmd == [sys.executable, "-m", "mod"]
+
+    def test_remote_resolves_to_remote_python(self):
+        from horovod_tpu.runner.safe_exec import (PYTHON_PLACEHOLDER,
+                                                  resolve_python)
+        cmd = resolve_python([PYTHON_PLACEHOLDER, "x.py"], local=False,
+                             remote_python="/opt/py/bin/python3")
+        assert cmd == ["/opt/py/bin/python3", "x.py"]
+
+    def test_plain_commands_pass_through(self):
+        from horovod_tpu.runner.safe_exec import resolve_python
+        assert resolve_python(["python", "t.py"], local=False) == \
+            ["python", "t.py"]
+
+
 class TestDuplicateHosts:
     def test_repeated_hostname_merged(self):
         slots = hosts.get_host_assignments([("h", 1), ("h", 1)], 2)
