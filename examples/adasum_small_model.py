@@ -1,0 +1,80 @@
+"""Adasum data parallelism on a small model
+(reference: the upstream repo's examples/adasum_small_model.py).
+
+Adasum combines gradients with an orthogonality-aware pairwise rule instead
+of a plain average, which tolerates much larger effective learning rates at
+high worker counts (reference: docs/adasum_user_guide.rst). This example
+trains the same small regression model twice — once with ``op=Average``,
+once with ``op=Adasum`` — and prints both loss curves.
+
+    python examples/adasum_small_model.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+
+
+def train(op, x, y, epochs, lr, per_rank_grads=False):
+    model = MLP(features=(64, 1))
+    params = model.init(jax.random.PRNGKey(0), x[:1])
+    opt = hvd.DistributedOptimizer(optax.sgd(lr), op=op)
+    state = opt.init(params)
+
+    def train_step(params, state, batch):
+        def loss_fn(p):
+            return ((model.apply(p, batch[0]) - batch[1]) ** 2).mean()
+
+        # hvd.pvary keeps gradients per-rank (autodiff would otherwise
+        # pre-sum gradients of replicated params), so Adasum combines the
+        # actual per-rank gradients — the reference's semantics. Without it
+        # the optimizer falls back to Adasum's aligned limit (= average).
+        diff_wrt = hvd.pvary(params) if per_rank_grads else params
+        loss, grads = jax.value_and_grad(loss_fn)(diff_wrt)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, \
+            hvd.allreduce(loss, op=hvd.Average)
+
+    step = hvd.run_step(
+        train_step,
+        in_specs=(hvd.REPLICATED, hvd.REPLICATED,
+                  (hvd.batch_spec(), hvd.batch_spec())),
+        out_specs=hvd.REPLICATED)
+    batch = hvd.shard_batch((jnp.asarray(x), jnp.asarray(y)))
+    losses = []
+    for _ in range(epochs):
+        params, state, loss = step(params, state, batch)
+        losses.append(float(loss))
+    return losses
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=30)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+
+    hvd.init()
+    rng = np.random.RandomState(0)
+    n = 512 * hvd.size()
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x @ rng.randn(16, 1) + 0.01 * rng.randn(n, 1)).astype(np.float32)
+
+    avg = train(hvd.Average, x, y, args.epochs, args.lr)
+    ada = train(hvd.Adasum, x, y, args.epochs, args.lr,
+                per_rank_grads=True)
+    if hvd.rank() == 0:
+        print(f"world size {hvd.size()}, lr {args.lr}")
+        print(f"average: loss {avg[0]:.4f} -> {avg[-1]:.4f}")
+        print(f"adasum:  loss {ada[0]:.4f} -> {ada[-1]:.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

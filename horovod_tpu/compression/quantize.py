@@ -66,6 +66,23 @@ def _pallas_backend_enabled(override: Optional[bool]) -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+_warned_pallas_fallback = set()
+
+
+def _warn_pallas_fallback(what: str, exc: Exception) -> None:
+    """A Pallas kernel that fails to lower silently degrades to the XLA
+    path; warn once per kernel so the degradation is observable (it
+    previously hid a Mosaic lowering break on real TPUs for a full round
+    of benchmarking)."""
+    if what in _warned_pallas_fallback:
+        return
+    _warned_pallas_fallback.add(what)
+    from ..utils import logging as log
+    log.warning("pallas %s kernel failed (%s: %s); using the XLA fallback "
+                "(pass use_pallas=False to silence)", what,
+                type(exc).__name__, str(exc)[:200])
+
+
 def _seed_from_key(key: Optional[jax.Array]) -> jnp.ndarray:
     """An int32 seed for the TPU hardware PRNG from a JAX PRNG key (typed or
     raw uint32 data); zero when no key is given (deterministic noise)."""
@@ -159,8 +176,8 @@ class MaxMinQuantizer:
                 payload = {"q": pack_bits(q.reshape(-1), self.bits),
                            "min": mn, "unit": unit}
                 return payload, ctx
-            except Exception:
-                pass  # fall back to the XLA path (e.g. unsupported backend)
+            except Exception as exc:
+                _warn_pallas_fallback("maxmin_quantize", exc)
         buckets, n = _bucketize(flat, self.bucket_size)
         mn = jnp.min(buckets, axis=1, keepdims=True)
         mx = jnp.max(buckets, axis=1, keepdims=True)
@@ -280,8 +297,8 @@ class NormalizedQuantizer:
                 payload = {"q": pack_bits(q.reshape(-1), self.bits),
                            "norm": norms}
                 return payload, ctx
-            except Exception:
-                pass  # fall back to the XLA path (unsupported backend)
+            except Exception as exc:
+                _warn_pallas_fallback("norm_quantize", exc)
         buckets, _ = _bucketize(flat, self.bucket_size)
         if self.norm == "l2":
             norms = jnp.sqrt(jnp.sum(buckets * buckets, axis=1, keepdims=True))
@@ -311,8 +328,8 @@ class NormalizedQuantizer:
                     payload["norm"].reshape(-1))
                 return out.reshape(-1)[:ctx.count].reshape(ctx.shape)\
                     .astype(ctx.dtype)
-            except Exception:
-                pass  # XLA fallback below
+            except Exception as exc:
+                _warn_pallas_fallback("norm_dequantize", exc)
         sign = 1.0 - 2.0 * (q & 1).astype(jnp.float32)
         idx = (q >> 1).astype(jnp.int32)
         levels = self._levels()

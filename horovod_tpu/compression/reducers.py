@@ -62,8 +62,9 @@ def _dequant_sum_stacked(compressor, gathered, ctx, n: int):
             unit = gathered["unit"].reshape(n, -1)
             out = pk.maxmin_dequantize_sum_pallas(q, mn, unit)
             return out.reshape(-1)[:ctx.count].reshape(ctx.shape)
-        except Exception:
-            pass  # unsupported backend: generic loop below
+        except Exception as exc:
+            from .quantize import _warn_pallas_fallback
+            _warn_pallas_fallback("maxmin_dequantize_sum", exc)
     total = jnp.zeros(ctx.shape, jnp.float32)
     for i in range(n):
         total = total + compressor.decompress(

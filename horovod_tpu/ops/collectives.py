@@ -163,8 +163,17 @@ def allreduce_p(x, op: ReduceOp = ReduceOp.SUM, axis: Optional[str] = None,
         # psums under check_vma): only normalize. See _dp_invariant.
         if op == ReduceOp.AVERAGE:
             y = _apply_scale(x, 1.0 / lax.axis_size(ax))
-        elif op in (ReduceOp.SUM, ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT,
-                    ReduceOp.ADASUM):
+        elif op == ReduceOp.ADASUM:
+            # The input is the SUM of per-rank contributions; the per-rank
+            # decomposition Adasum needs is gone. Use Adasum's
+            # aligned-gradients limit (= average) — exact when the per-rank
+            # tensors were equal, and stable otherwise. Returning x here
+            # (pre-fix behavior) silently applied an axis_size-times-larger
+            # step and diverged. For true per-rank Adasum differentiate
+            # against ``hvd.pvary(params)`` so gradients stay varying.
+            y = _apply_scale(x, 1.0 / lax.axis_size(ax))
+        elif op in (ReduceOp.SUM, ReduceOp.MIN, ReduceOp.MAX,
+                    ReduceOp.PRODUCT):
             y = x
         else:
             raise ValueError(f"unknown ReduceOp {op}")

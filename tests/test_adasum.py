@@ -40,6 +40,60 @@ class TestAdasum:
         np.testing.assert_allclose(out, np.arange(1, 9, dtype=np.float32),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_invariant_input_uses_aligned_limit(self, spmd8):
+        """Adasum on an INVARIANT tensor (e.g. the pre-summed gradients
+        autodiff produces for replicated params) must behave like the
+        aligned-gradients limit (= average), not return the n-times-larger
+        sum — returning the sum made op=Adasum training diverge in a few
+        steps (regression test for the optimizer blow-up)."""
+        v = np.random.RandomState(3).randn(16).astype(np.float32)
+
+        @hvd.run_step(in_specs=P(), out_specs=P())
+        def step(x):
+            # x is replicated (invariant over dp); a psum of per-rank
+            # contributions looks exactly like this inside a training step.
+            return hvd.allreduce(x, op=hvd.Adasum)
+
+        out = np.asarray(step(jnp.asarray(v * 8.0)))  # "sum of 8 aligned"
+        np.testing.assert_allclose(out, v, rtol=1e-5, atol=1e-5)
+
+    def test_optimizer_adasum_replicated_params_converges(self, spmd8):
+        """End-to-end: DistributedOptimizer(op=Adasum) with replicated
+        params (the standard DP recipe) must reduce the loss, not NaN."""
+        import optax
+
+        from horovod_tpu.models import MLP
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 8).astype(np.float32)
+        y = (x @ rng.randn(8, 1)).astype(np.float32)
+        model = MLP(features=(16, 1))
+        params = model.init(jax.random.PRNGKey(0), x[:1])
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05), op=hvd.Adasum)
+        state = opt.init(params)
+
+        def train_step(params, state, batch):
+            def loss_fn(p):
+                return ((model.apply(p, batch[0]) - batch[1]) ** 2).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, state = opt.update(grads, state)
+            return optax.apply_updates(params, updates), state, \
+                hvd.allreduce(loss, op=hvd.Average)
+
+        step = hvd.run_step(
+            train_step,
+            in_specs=(hvd.REPLICATED, hvd.REPLICATED,
+                      (hvd.batch_spec(), hvd.batch_spec())),
+            out_specs=hvd.REPLICATED)
+        batch = hvd.shard_batch((jnp.asarray(x), jnp.asarray(y)))
+        losses = []
+        for _ in range(15):
+            params, state, loss = step(params, state, batch)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all(), losses
+        assert losses[-1] < losses[0] * 0.8, losses
+
     @pytest.mark.parametrize("shape", [(17,), (4, 5), (2, 3, 4)])
     def test_random_matches_reference(self, spmd8, shape):
         rng = np.random.RandomState(42)
