@@ -35,6 +35,12 @@ ITERS = int(os.environ.get("HVDTPU_BENCH_ITERS", 20))
 # this, producing an impossible mfu=246%).
 ANALYTIC_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
 
+# Progressive result: filled in as each phase completes so the watchdog
+# (and the hard-failure path) can emit everything measured so far instead
+# of zeros — a tunnel stall during the microbench must not discard an
+# already-measured headline number.
+_partial = {}
+
 _TRANSIENT_MARKERS = (
     "UNAVAILABLE", "Connection refused", "connection refused",
     "DEADLINE_EXCEEDED", "failed to connect", "Socket closed",
@@ -360,6 +366,13 @@ def _run():
 
     images_per_sec = global_batch * ITERS / dt
     per_chip = images_per_sec / n
+    _partial.update({
+        "metric": "ResNet-50 synthetic training throughput per chip "
+                  f"(bf16, bs={BATCH_PER_CHIP}/chip, {n} chip(s))",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+    })
 
     # FLOPs: cross-check XLA cost analysis against the analytic ResNet-50
     # number; the analytic value wins when they disagree badly (the axon
@@ -374,26 +387,22 @@ def _run():
     achieved = flops_per_chip * ITERS / dt
     mfu = round(achieved / peak, 4) if peak else None
 
+    _partial.update({"mfu": mfu, "flops_per_step_per_chip": flops_per_chip,
+                     "flops_source": flops_source, "loss": loss_value,
+                     "device": getattr(jax.devices()[0], "device_kind",
+                                       "unknown")})
+
     micro = _microbench(hvd, jnp, jax)
+    _partial["microbench"] = micro
     try:
         gpt_metric = _gpt_bench(jax, jnp)
     except Exception as exc:
         gpt_metric = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+    _partial["gpt"] = gpt_metric
 
-    result = {
-        "metric": "ResNet-50 synthetic training throughput per chip "
-                  f"(bf16, bs={BATCH_PER_CHIP}/chip, {n} chip(s))",
-        "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
-        "mfu": mfu,
-        "flops_per_step_per_chip": flops_per_chip,
-        "flops_source": flops_source,
-        "loss": loss_value,
-        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
-        "microbench": micro,
-        "gpt": gpt_metric,
-    }
+    # _partial already holds every phase's keys (that is the contract the
+    # watchdog relies on); the success result IS the completed _partial.
+    result = dict(_partial)
     if mfu is not None and mfu > 1.0:
         # >100% of peak is physically impossible: the measurement is broken
         # (timing not fenced or FLOPs overcounted). Never report it as real.
@@ -412,14 +421,18 @@ def _arm_watchdog():
     deadline = float(os.environ.get("HVDTPU_BENCH_DEADLINE", 1500))
 
     def fire():
-        print(json.dumps({
+        result = {
             "metric": "ResNet-50 synthetic training throughput per chip",
             "value": 0.0,
             "unit": "images/sec/chip",
             "vs_baseline": 0.0,
-            "error": f"watchdog: bench exceeded {deadline:.0f}s "
-                     "(backend hang)",
-        }), flush=True)
+        }
+        result.update(_partial)  # keep whatever phases completed
+        result["error"] = (f"watchdog: bench exceeded {deadline:.0f}s "
+                           "(backend hang)"
+                           + ("; reporting completed phases" if _partial
+                              else ""))
+        print(json.dumps(result), flush=True)
         os._exit(1)
 
     import threading
@@ -436,13 +449,15 @@ def main():
     except BaseException as exc:  # still emit the JSON line for the record
         import traceback
         traceback.print_exc()
-        print(json.dumps({
+        result = {
             "metric": "ResNet-50 synthetic training throughput per chip",
             "value": 0.0,
             "unit": "images/sec/chip",
             "vs_baseline": 0.0,
-            "error": f"{type(exc).__name__}: {str(exc)[:500]}",
-        }))
+        }
+        result.update(_partial)  # keep whatever phases completed
+        result["error"] = f"{type(exc).__name__}: {str(exc)[:500]}"
+        print(json.dumps(result))
         return 1
     finally:
         watchdog.cancel()

@@ -30,20 +30,20 @@ def train_fn(epochs=3, lr=1e-3):
     params = model.init(jax.random.PRNGKey(0), x[:1])
     # Every rank starts from rank 0's weights (reference:
     # broadcast_parameters / BroadcastGlobalVariablesHook).
-    params = hvd.broadcast(params, root_rank=0)
+    params = hvd.broadcast_parameters(params, root_rank=0)
     opt = hvd.DistributedOptimizer(optax.adam(lr))
     state = opt.init(params)
 
-    for _ in range(epochs):
-        def loss_fn(p):
-            logits = model.apply(p, jnp.asarray(x))
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, jnp.asarray(y)).mean()
+    def loss_fn(p):
+        logits = model.apply(p, jnp.asarray(x))
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray(y)).mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+    for _ in range(epochs):
+        _, grads = jax.value_and_grad(loss_fn)(params)
         updates, state = opt.update(grads, state)
         params = optax.apply_updates(params, updates)
-    return hvd.rank(), float(loss)
+    return hvd.rank(), float(loss_fn(params))
 
 
 def main():

@@ -356,8 +356,9 @@ def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
         shard = adasum_p(shard, axis=outer_axis)
     else:
         shard = lax.psum(shard, outer_axis)
-    # allgather_p (masked-psum form) so the output is provably replicated
-    # over the inner axis under shard_map's varying-axes check.
+    # allgather_p lowers to a true all-gather with provably-replicated
+    # output (all_gather_invariant), so this leg costs gather-wire bytes,
+    # not the old masked-psum's 2x.
     full = allgather_p(shard, axis=inner_axis)
 
     if pad:
