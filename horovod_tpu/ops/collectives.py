@@ -629,9 +629,14 @@ class _InStepBackend(CollectiveBackend):
 
     def alltoall(self, x, splits, name, axis):
         if splits is not None:
+            # Fundamental XLA limit, not a TODO: per-rank output row counts
+            # differ under uneven splits, and one compiled SPMD program
+            # cannot produce differently-shaped outputs per device. The
+            # eager (host) paths support uneven splits.
             raise NotImplementedError(
-                "uneven splits are only supported on the eager path; pad to "
-                "equal splits for the compiled path")
+                "uneven splits cannot compile in-step (per-rank output "
+                "shapes differ; XLA requires static shapes) — use the eager "
+                "path, or pad to equal splits inside the step")
         return alltoall_p(x, axis=axis)
 
     def reducescatter(self, x, op, name, axis):
@@ -675,9 +680,20 @@ class _NativeProcessBackend(CollectiveBackend):
                                 root_rank=root_rank)
 
     def alltoall(self, x, splits, name, axis):
-        return _core_collective("alltoall", x, name or _auto_name("alltoall"),
-                                splits=None if splits is None
-                                else np.asarray(splits, np.int32))
+        name = name or _auto_name("alltoall")
+        if splits is None:
+            return _core_collective("alltoall", x, name)
+        sp = np.asarray(splits, np.int32)
+        out = _core_collective("alltoall", x, name, splits=sp)
+        # received_splits[i] = rows rank i sent to this rank. The controller
+        # negotiated the full matrix natively (core.cpp all_splits) but only
+        # the payload comes back; a tiny int32 allgather of every rank's
+        # send-splits reconstructs it (reference returns received_splits
+        # from the response, torch/mpi_ops.py:517+).
+        matrix = np.asarray(_core_collective(
+            "allgather", sp, f"{name}.splits")).reshape(-1, sp.size)
+        recv = matrix[:, runtime.rank()].astype(np.int32)
+        return out, (jnp.asarray(recv) if isinstance(x, jax.Array) else recv)
 
     def reducescatter(self, x, op, name, axis):
         return _core_collective("reducescatter", x,
@@ -748,8 +764,43 @@ class _SpmdEagerBackend(CollectiveBackend):
                 "eager alltoall in SPMD mode requires an array sharded over "
                 "the data-parallel axis (use hvd.shard_batch) — a replicated "
                 "input has no well-defined single-host result")
-        raise NotImplementedError(
-            "eager uneven-split alltoall requires process mode (hvdrun)")
+        if dim is None:
+            raise ValueError(
+                "eager uneven-split alltoall in SPMD mode requires an array "
+                "sharded over the data-parallel axis (use hvd.shard_batch)")
+        if dim != 0:
+            # Splits select dim-0 rows (reference semantics); a dp-sharding
+            # on another dim means per-rank shards are not row blocks and
+            # the reshuffle below would be silently wrong.
+            raise ValueError(
+                "eager uneven-split alltoall requires the array to be "
+                f"dp-sharded on dim 0 (got dim {dim})")
+        # Uneven splits, global view: the host holds every rank's shard, so
+        # the exchange is a deterministic segment reshuffle (no dynamic
+        # shapes — the limitation is only inside compiled programs). Every
+        # simulated rank applies the same send-splits vector; the returned
+        # array is the per-rank outputs concatenated in rank order, exactly
+        # like the even case's global result, plus the received-splits
+        # matrix (row r = rows rank r received from each source).
+        x = jnp.asarray(x)
+        n = runtime.size()
+        sp = np.asarray(splits, np.int64).reshape(-1)
+        if sp.size != n:
+            raise ValueError(f"splits must have one entry per rank "
+                             f"({n}), got {sp.size}")
+        shard = x.shape[0] // n
+        if sp.sum() != shard:
+            raise ValueError(
+                f"splits sum ({int(sp.sum())}) must equal the per-rank "
+                f"shard size ({shard})")
+        off = np.concatenate([[0], np.cumsum(sp)])
+        # Output for rank r = concat_i segment(i -> r); global result is
+        # ranks' outputs concatenated.
+        out = jnp.concatenate(
+            [x[i * shard + off[r]: i * shard + off[r + 1]]
+             for r in range(n) for i in range(n)], axis=0)
+        recv = np.tile(sp.astype(np.int32), (n, 1)).T  # recv[r][i] = sp[r]
+        return out, jnp.asarray(np.ascontiguousarray(recv))
 
     def reducescatter(self, x, op, name, axis):
         ax = runtime.dp_axis()
@@ -853,8 +904,16 @@ def alltoall(x, splits=None, name: Optional[str] = None,
 
     Reference: ``hvd.alltoall`` with optional uneven ``splits``
     (``operations.cc:1055-1116``; split negotiation in
-    ``collective_operations.h:216-265``). Returns ``(output, received_splits)``
-    when ``splits`` is given, else ``output`` — matching the torch binding.
+    ``collective_operations.h:216-265``).
+
+    With ``splits`` the sync eager paths return ``(output,
+    received_splits)``: process mode gives this rank's received-rows
+    vector, SPMD eager (global view) gives the global reshuffled array
+    plus the full ``[n, n]`` received matrix. Without ``splits`` the
+    return is ``output`` alone. The torch interop layer unwraps to
+    output-only (v0.20 torch parity); async handles always synchronize
+    to the payload. In-step uneven splits cannot compile (XLA static
+    shapes) and raise.
     """
     return _dispatch.resolve("alltoall", _ctx(axis)).alltoall(
         x, splits=splits, name=name, axis=axis)
@@ -970,7 +1029,12 @@ def alltoall_async(x, splits=None, name: Optional[str] = None,
         return _core_async("alltoall", x, name or _auto_name("alltoall"),
                            splits=None if splits is None
                            else np.asarray(splits, np.int32))
-    return _new_handle(alltoall(x, splits=splits, name=name, axis=axis))
+    res = alltoall(x, splits=splits, name=name, axis=axis)
+    if splits is not None:
+        res = res[0]  # async handles synchronize to the payload in EVERY
+        # mode (native async also yields only the payload) — see alltoall's
+        # docstring; received_splits is a sync-path-only feature.
+    return _new_handle(res)
 
 
 def poll(handle: int) -> bool:

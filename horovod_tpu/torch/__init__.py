@@ -278,7 +278,11 @@ def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
     """Reference: ``hvd.alltoall`` (torch/mpi_ops.py:517) with optional
     uneven splits."""
     sp = None if splits is None else _to_numpy(splits).astype(np.int32)
-    out = _C.alltoall(_to_numpy(tensor), splits=sp, name=name)
+    # async+synchronize: yields the payload alone in every mode, skipping
+    # the received_splits reconstruction (an extra splits allgather on the
+    # native path) that v0.20 torch parity would discard anyway.
+    handle = _C.alltoall_async(_to_numpy(tensor), splits=sp, name=name)
+    out = _C.synchronize(handle)
     return _to_torch(np.asarray(out), tensor)
 
 

@@ -38,6 +38,27 @@ out = np.asarray(hvd.alltoall(a, name="a1"))
 expect = np.stack([np.arange(2, dtype=np.int32) + 2 * r + 100 * i for i in range(n)])
 np.testing.assert_array_equal(out, expect)
 
+# alltoall UNEVEN splits + received_splits (reference: operations.cc:1055;
+# the controller negotiates the full splits matrix). Rank r sends r+1 rows
+# to rank 0 and 1 row to every other rank.
+sp = np.ones(n, np.int32)
+sp[0] = r + 1
+rows = int(sp.sum())
+u = (np.arange(rows, dtype=np.int32) + 1000 * r).reshape(rows, 1)
+out, recv = hvd.alltoall(u, splits=sp, name="a2")
+out = np.asarray(out)
+np.testing.assert_array_equal(np.asarray(recv),
+                              [i + 1 if r == 0 else 1 for i in range(n)])
+# Rank 0 receives each source's first i+1 rows; others receive one row at
+# offset (i+1) + (r-1) of source i's buffer.
+if r == 0:
+    expect = np.concatenate(
+        [(np.arange(i + 1, dtype=np.int32) + 1000 * i) for i in range(n)])
+else:
+    expect = np.array([(i + 1) + (r - 1) + 1000 * i for i in range(n)],
+                      np.int32)
+np.testing.assert_array_equal(out.reshape(-1), expect)
+
 # int64 min/max
 m = np.array([r, -r, 7], dtype=np.int64)
 np.testing.assert_array_equal(np.asarray(hvd.allreduce(m, name="mn", op=hvd.Min)), [0, -(n - 1), 7])

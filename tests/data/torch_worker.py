@@ -52,6 +52,22 @@ expect = torch.stack([torch.arange(2, dtype=torch.float32) + 2 * r + 100 * i
                       for i in range(n)])
 assert torch.allclose(out, expect), (out, expect)
 
+# alltoall with UNEVEN splits (v0.20 torch parity: output tensor only).
+# Rank r sends r+1 rows to rank 0 and 1 row to every other rank.
+sp = torch.ones(n, dtype=torch.int32)
+sp[0] = r + 1
+rows = int(sp.sum())
+u = (torch.arange(rows, dtype=torch.float32) + 1000 * r).reshape(rows, 1)
+out = hvd.alltoall(u, splits=sp, name="a2")
+assert isinstance(out, torch.Tensor), type(out)
+if r == 0:
+    expect = torch.cat([torch.arange(i + 1, dtype=torch.float32) + 1000 * i
+                        for i in range(n)])
+else:
+    expect = torch.tensor([float((i + 1) + (r - 1) + 1000 * i)
+                           for i in range(n)])
+assert torch.allclose(out.reshape(-1), expect), (out, expect)
+
 # autograd: gradient of allreduce is allreduce (test_torch.py:546 analog)
 t = torch.full((3,), float(r), requires_grad=True)
 z = hvd.allreduce(t, name="ad", op=hvd.Sum)

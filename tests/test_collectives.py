@@ -418,3 +418,68 @@ class TestProduct:
     def test_eager_replicated_alltoall_rejected(self, spmd8):
         with pytest.raises(ValueError):
             hvd.alltoall(jnp.arange(8.0))
+
+
+class TestUnevenAlltoall:
+    """Uneven splits on the eager SPMD path (reference: alltoall with
+    splits, operations.cc:1055-1116). The global result is the segment
+    reshuffle; received_splits is the full [n, n] matrix."""
+
+    def test_uneven_splits_global_reshuffle(self, spmd8):
+        n, shard = 8, 8
+        # splits: rank j gets sp[j] rows of each rank's 8-row shard.
+        sp = np.array([3, 1, 0, 2, 0, 1, 1, 0], np.int32)
+        x = hvd.shard_batch(jnp.arange(n * shard, dtype=jnp.int32))
+        out, recv = hvd.alltoall(x, splits=sp)
+        out = np.asarray(out)
+        # Build the expectation directly from the definition.
+        host = np.arange(n * shard, dtype=np.int32)
+        off = np.concatenate([[0], np.cumsum(sp)])
+        expect = np.concatenate(
+            [host[i * shard + off[r]: i * shard + off[r + 1]]
+             for r in range(n) for i in range(n)])
+        np.testing.assert_array_equal(out, expect)
+        recv = np.asarray(recv)
+        assert recv.shape == (n, n)
+        # Rank r receives sp[r] rows from every source.
+        for r in range(n):
+            np.testing.assert_array_equal(recv[r], np.full(n, sp[r]))
+
+    def test_uneven_splits_validation(self, spmd8):
+        x = hvd.shard_batch(jnp.arange(64, dtype=jnp.int32))
+        with pytest.raises(ValueError, match="sum"):
+            # shard size is 64/8 = 8 rows; these sum to 16
+            hvd.alltoall(x, splits=np.array([2] * 8, np.int32))
+        with pytest.raises(ValueError, match="entry per rank"):
+            hvd.alltoall(x, splits=np.array([4, 4], np.int32))
+
+    def test_async_uneven_synchronizes_to_payload(self, spmd8):
+        """Async+uneven must yield the payload alone in every mode (the
+        docstring contract); the tuple is a sync-path-only feature."""
+        n, shard = 8, 8
+        sp = np.array([3, 1, 0, 2, 0, 1, 1, 0], np.int32)
+        x = hvd.shard_batch(jnp.arange(n * shard, dtype=jnp.int32))
+        sync_out, _ = hvd.alltoall(x, splits=sp)
+        h = hvd.alltoall_async(x, splits=sp)
+        async_out = hvd.synchronize(h)
+        assert not isinstance(async_out, tuple)
+        np.testing.assert_array_equal(np.asarray(async_out),
+                                      np.asarray(sync_out))
+
+    def test_uneven_rejects_non_dim0_sharding(self, spmd8):
+        from jax.sharding import NamedSharding
+        mesh = hvd.mesh()
+        x = jax.device_put(jnp.arange(64, dtype=jnp.int32).reshape(8, 8),
+                           NamedSharding(mesh, P(None, "dp")))
+        with pytest.raises(ValueError, match="dim 0"):
+            hvd.alltoall(x, splits=np.full(8, 1, np.int32))
+
+    def test_in_step_uneven_raises(self, spmd8):
+        x = jnp.arange(64, dtype=jnp.int32)
+
+        @hvd.run_step(in_specs=P("dp"), out_specs=P("dp"))
+        def step(shard):
+            return hvd.alltoall(shard, splits=np.full(8, 1, np.int32))
+
+        with pytest.raises(NotImplementedError, match="static shapes"):
+            step(x)
