@@ -58,6 +58,12 @@ class GPTConfig:
     moe_every: int = 0
     num_experts: int = 8
     capacity_factor: float = 1.25
+    # Per-block rematerialization (jax.checkpoint) — the TPU lever trading
+    # FLOPs for HBM so long sequences fit: "none" stores every block
+    # activation; "full" stores only block inputs and recomputes the rest
+    # in backward; "dots" additionally saves matmul outputs (recompute only
+    # the cheap elementwise work).
+    remat: str = "none"                      # "none" | "full" | "dots"
 
     @property
     def kv_heads(self) -> int:
@@ -202,12 +208,28 @@ def _block(cfg: GPTConfig, layer_params, x, positions):
     return x + _tp_psum(down, cfg)
 
 
+def _block_fn(cfg: GPTConfig):
+    """The per-layer apply, optionally wrapped in ``jax.checkpoint``
+    (cfg is a frozen dataclass, so it rides static_argnums)."""
+    if cfg.remat == "none":
+        return _block
+    if cfg.remat == "full":
+        return jax.checkpoint(_block, static_argnums=(0,))
+    if cfg.remat == "dots":
+        return jax.checkpoint(
+            _block, static_argnums=(0,),
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    raise ValueError(f"unknown remat mode {cfg.remat!r} "
+                     "(expected 'none', 'full' or 'dots')")
+
+
 def forward(params, tokens, positions, cfg: GPTConfig):
     """Logits ``[B, S_local, vocab]`` (fp32). ``tokens``/``positions`` are this
     rank's sequence shard (global positions) when sp is active."""
     x = params["embed"].astype(cfg.dtype)[tokens]
+    block = _block_fn(cfg)
     for lp in params["layers"]:
-        x = _block(cfg, lp, x, positions)
+        x = block(cfg, lp, x, positions)
     x = _rmsnorm(x, params["out_norm"], cfg.dtype)
     return jnp.einsum("bse,ev->bsv", x,
                       params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -461,13 +461,18 @@ class TestCompiledFusion:
         batch = hvd.shard_batch((x, y))
         hlo = step.lower(params, state, batch).compile().as_text()
         n_leaves = len(jax.tree.leaves(params))
+        # Match the opcode regardless of result shape: single-result
+        # (uncombined) instructions are `%ar = f32[16]{0} all-reduce(`,
+        # combined ones are tuple-shaped — both must count, else the test
+        # passes vacuously in the exact regression it guards.
         ars = [l for l in hlo.splitlines()
-               if re.search(r"= (\([^)]*\) )?\S*all-reduce(-start)?\(", l)]
+               if re.search(r"\ball-reduce(-start)?\(", l)]
         assert n_leaves >= 10
         # 10 grad leaves + 1 loss: all must combine into a few instructions
         # (measured: 1 on the CPU mesh; allow headroom for partitioner
-        # variation across JAX versions).
-        assert len(ars) <= 3, (len(ars), ars)
+        # variation across JAX versions). The >= 1 floor catches the regex
+        # going stale against future HLO syntax.
+        assert 1 <= len(ars) <= 3, (len(ars), ars)
 
 
 class TestUnevenAlltoall:
