@@ -36,7 +36,7 @@ from .quantize import (DEFAULT_BUCKET_SIZE, MaxMinQuantizer,
 
 def make_compressor(name: str, bits: int = 4,
                     bucket_size: int = DEFAULT_BUCKET_SIZE,
-                    topk_ratio: float = 0.01):
+                    topk_ratio: float = 0.01, norm: str = "linf"):
     name = (name or "none").lower()
     if name in ("none", ""):
         return None
@@ -48,10 +48,10 @@ def make_compressor(name: str, bits: int = 4,
         return MaxMinQuantizer(bits=bits, bucket_size=bucket_size)
     if name == "uni":
         return NormalizedQuantizer(bits=bits, bucket_size=bucket_size,
-                                   levels="uni")
+                                   levels="uni", norm=norm)
     if name == "exp":
         return NormalizedQuantizer(bits=bits, bucket_size=bucket_size,
-                                   levels="exp")
+                                   levels="exp", norm=norm)
     if name == "topk":
         return TopKCompressor(ratio=topk_ratio)
     raise ValueError(f"unknown compressor {name!r}")
@@ -86,7 +86,8 @@ class CompressionConfig:
 
     @classmethod
     def load(cls, path: str, reduction: str = "scatter_allgather",
-             error_feedback: bool = False) -> "CompressionConfig":
+             error_feedback: bool = False,
+             norm: str = "linf") -> "CompressionConfig":
         import yaml
         with open(path) as f:
             doc = yaml.safe_load(f) or {}
@@ -95,11 +96,13 @@ class CompressionConfig:
                                   bits=int(d.get("bits", 4)),
                                   bucket_size=int(d.get("bucket_size",
                                                         DEFAULT_BUCKET_SIZE)),
-                                  topk_ratio=float(d.get("topk_ratio", 0.01)))
+                                  topk_ratio=float(d.get("topk_ratio", 0.01)),
+                                  norm=d.get("norm", norm))
         rules = []
         for r in doc.get("layers", []):
             comp = None
-            if "compressor" in r or "bits" in r or "bucket_size" in r:
+            if "compressor" in r or "bits" in r or "bucket_size" in r \
+                    or "norm" in r:
                 comp = make_compressor(
                     r.get("compressor", d.get("compressor", "maxmin")),
                     bits=int(r.get("bits", d.get("bits", 4))),
@@ -107,7 +110,8 @@ class CompressionConfig:
                                           d.get("bucket_size",
                                                 DEFAULT_BUCKET_SIZE))),
                     topk_ratio=float(r.get("topk_ratio",
-                                           d.get("topk_ratio", 0.01))))
+                                           d.get("topk_ratio", 0.01))),
+                    norm=r.get("norm", d.get("norm", norm)))
             rules.append(LayerRule(pattern=re.compile(r["pattern"]),
                                    ignore=bool(r.get("ignore", False)),
                                    compressor=comp))
@@ -123,9 +127,12 @@ def from_env() -> Optional[CompressionConfig]:
     reduction = (ev.get_str(ev.HVDTPU_REDUCTION, "scatter_allgather")
                  or "scatter_allgather").lower()
     error_feedback = ev.get_bool(ev.HVDTPU_COMPRESSION_ERROR_FEEDBACK)
+    norm = (ev.get_str(ev.HVDTPU_COMPRESSION_NORM_TYPE, "linf")
+            or "linf").lower()
     if cfg_file:
         return CompressionConfig.load(cfg_file, reduction=reduction,
-                                      error_feedback=error_feedback)
+                                      error_feedback=error_feedback,
+                                      norm=norm)
     if not name or name.lower() == "none":
         return None
     comp = make_compressor(
@@ -133,6 +140,7 @@ def from_env() -> Optional[CompressionConfig]:
         bits=ev.get_int(ev.HVDTPU_QUANTIZATION_BITS, 4),
         bucket_size=ev.get_int(ev.HVDTPU_COMPRESSION_BUCKET_SIZE,
                                DEFAULT_BUCKET_SIZE),
-        topk_ratio=ev.get_float(ev.HVDTPU_COMPRESSION_TOPK_RATIO, 0.01))
+        topk_ratio=ev.get_float(ev.HVDTPU_COMPRESSION_TOPK_RATIO, 0.01),
+        norm=norm)
     return CompressionConfig(default_compressor=comp, reduction=reduction,
                              error_feedback=error_feedback)

@@ -247,6 +247,10 @@ class NormalizedQuantizer:
                  use_pallas: Optional[bool] = None):
         if bits not in (2, 4, 8):
             raise ValueError("bits must be 2, 4 or 8")
+        if norm not in ("l2", "linf"):
+            # Fail fast like the other knobs: a typo ("l1") would otherwise
+            # silently quantize against the linf path.
+            raise ValueError(f"norm must be 'l2' or 'linf', got {norm!r}")
         self.bits = bits
         self.bucket_size = bucket_size
         self.kind = levels

@@ -86,6 +86,9 @@ HVDTPU_COMPRESSION_BUCKET_SIZE = "HVDTPU_COMPRESSION_BUCKET_SIZE"
 HVDTPU_COMPRESSION_ERROR_FEEDBACK = "HVDTPU_COMPRESSION_ERROR_FEEDBACK"
 HVDTPU_COMPRESSION_TOPK_RATIO = "HVDTPU_COMPRESSION_TOPK_RATIO"
 HVDTPU_COMPRESSION_CONFIG_FILE = "HVDTPU_COMPRESSION_CONFIG_FILE"
+# reference: HOROVOD_COMPRESSION_NORM_TYPE ("l2" | "linf") for the
+# normalized quantizers (common.h:96-108).
+HVDTPU_COMPRESSION_NORM_TYPE = "HVDTPU_COMPRESSION_NORM_TYPE"
 
 # Elastic (reference: HOROVOD_ELASTIC_TIMEOUT, HOROVOD_GLOO_TIMEOUT_SECONDS)
 HVDTPU_ELASTIC_TIMEOUT = "HVDTPU_ELASTIC_TIMEOUT"

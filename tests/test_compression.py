@@ -524,6 +524,30 @@ class TestConfig:
         assert cfg.reduction == "ring"
         monkeypatch.setenv("HVDTPU_COMPRESSION", "none")
         assert from_env() is None
+        # Norm-type knob (reference: HOROVOD_COMPRESSION_NORM_TYPE).
+        monkeypatch.setenv("HVDTPU_COMPRESSION", "uni")
+        monkeypatch.setenv("HVDTPU_COMPRESSION_NORM_TYPE", "l2")
+        assert from_env().default_compressor.norm == "l2"
+        # Typos fail fast instead of silently running the linf path.
+        monkeypatch.setenv("HVDTPU_COMPRESSION_NORM_TYPE", "l1")
+        with pytest.raises(ValueError, match="norm"):
+            from_env()
+
+    def test_env_norm_reaches_yaml_config(self, monkeypatch, tmp_path):
+        """The norm knob must also apply on the config-file path, including
+        per-layer `norm:` overrides."""
+        from horovod_tpu.compression import from_env
+
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(
+            "default:\n  compressor: uni\n  bits: 4\n"
+            "layers:\n  - pattern: 'embed'\n    norm: linf\n")
+        monkeypatch.setenv("HVDTPU_COMPRESSION", "uni")
+        monkeypatch.setenv("HVDTPU_COMPRESSION_CONFIG_FILE", str(cfg_file))
+        monkeypatch.setenv("HVDTPU_COMPRESSION_NORM_TYPE", "l2")
+        cfg = from_env()
+        assert cfg.default_compressor.norm == "l2"
+        assert cfg.for_name("embed/table").norm == "linf"
 
     def test_make_compressor_errors(self):
         with pytest.raises(ValueError):
