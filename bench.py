@@ -432,7 +432,25 @@ def _arm_watchdog():
                            "(backend hang)"
                            + ("; reporting completed phases" if _partial
                               else ""))
+        # Emit first in any case: consumers read the LAST JSON line, so
+        # this is the fallback record if a retry below never finishes.
         print(json.dumps(result), flush=True)
+        if not _partial and not os.environ.get("HVDTPU_BENCH_RETRY"):
+            # Nothing measured at all: the tunnel stalled before the first
+            # phase (observed: stalls clearing after tens of minutes). A
+            # fresh process gets a fresh libtpu client, which can land on a
+            # recovered tunnel — a successful retry prints a newer final
+            # JSON line that supersedes the fallback above.
+            print(f"bench: watchdog at {deadline:.0f}s with no phases "
+                  "complete; re-executing once with a fresh backend",
+                  file=sys.stderr, flush=True)
+            env = dict(os.environ, HVDTPU_BENCH_RETRY="1")
+            try:
+                os.execve(sys.executable, [sys.executable,
+                                           os.path.abspath(__file__)], env)
+            except OSError as exc:  # must still kill the hung process
+                print(f"bench: re-exec failed ({exc}); exiting",
+                      file=sys.stderr, flush=True)
         os._exit(1)
 
     import threading
