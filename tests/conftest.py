@@ -34,9 +34,15 @@ def subprocess_env() -> dict:
     JAX_PLATFORMS=cpu must be present at interpreter START: the axon
     sitecustomize imports jax before the worker script runs, so a script-level
     ``os.environ.setdefault`` is too late and the worker silently initializes
-    the axon TPU backend — hanging forever whenever the tunnel is down."""
+    the axon TPU backend — hanging forever whenever the tunnel is down.
+
+    PALLAS_AXON_POOL_IPS must be absent too: the sitecustomize gates on it
+    and its register() call dials the TPU relay at interpreter start —
+    before JAX_PLATFORMS is consulted — so a stalled tunnel hangs every
+    subprocess at import even with the CPU platform selected."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     prev = env.get("PYTHONPATH")
     env["PYTHONPATH"] = REPO_ROOT + (os.pathsep + prev if prev else "")
     return env
