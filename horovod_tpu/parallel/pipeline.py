@@ -23,7 +23,7 @@ from jax import lax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, axis: str = "pp",
-                   broadcast_out: bool = True):
+                   broadcast_out: bool = True, remat: bool = False):
     """Run shape-preserving ``stage_fn`` as a P-stage GPipe pipeline (in-step).
 
     Args:
@@ -36,6 +36,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, axis: str = "pp",
       axis: the pp mesh axis.
       broadcast_out: return the result on every pp rank (one extra collective);
         if False the output is only valid on the last stage's rank.
+      remat: rematerialize each tick's stage computation in backward
+        (``jax.checkpoint``). The scan otherwise stores every tick's
+        stage-INTERNAL intermediates for ``M + P - 1`` ticks (the dominant
+        term for deep stages); recomputing drops that to one tick's
+        working set. The per-tick boundary activations are still carried
+        for all ticks — the O(M) stash that true 1F1B schedules bound at
+        O(P) — so this is GPipe-with-recompute, not 1F1B.
 
     Returns ``[M, mb, ...]`` outputs of the final stage.
     """
@@ -43,6 +50,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, axis: str = "pp",
     r = lax.axis_index(axis)
     M = x.shape[0]
     params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), stage_params)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     perm = [(i, i + 1) for i in range(n - 1)]
 
     def tick(carry, t):
