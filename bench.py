@@ -40,6 +40,8 @@ ANALYTIC_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
 # of zeros — a tunnel stall during the microbench must not discard an
 # already-measured headline number.
 _partial = {}
+# Process start, for phase-skipping against the watchdog deadline.
+_T0 = time.monotonic()
 
 _TRANSIENT_MARKERS = (
     "UNAVAILABLE", "Connection refused", "connection refused",
@@ -217,11 +219,15 @@ def _quantize_kernel_bench(jnp, jax):
     return out
 
 
-def _gpt_bench(jax, jnp):
+def _gpt_bench(jax, jnp, long_context: bool = False):
     """Secondary metric: GPT training throughput (tokens/sec/chip, bf16) —
     broadens the perf evidence beyond convnets. Fully guarded: any failure
     becomes an error note without costing the headline metric. Size knobs
-    are env-overridable for quick local (CPU) smokes."""
+    are env-overridable for quick local (CPU) smokes.
+
+    ``long_context`` runs the 4096-token variant with per-block
+    rematerialization (GPTConfig remat="full") — the FLOPs-for-HBM trade
+    that makes long sequences fit."""
     import numpy as np
     import optax
 
@@ -232,9 +238,16 @@ def _gpt_bench(jax, jnp):
     cfg = gpt.GPTConfig(vocab_size=32000, num_layers=layers, num_heads=8,
                         head_dim=embed // 8, embed_dim=embed,
                         mlp_dim=4 * embed, dtype=jnp.bfloat16, tp_axis=None,
-                        sp_axis=None, attention="dense")
+                        sp_axis=None, attention="dense",
+                        remat="full" if long_context else "none")
     B = int(os.environ.get("HVDTPU_BENCH_GPT_BATCH", 8))
     S = int(os.environ.get("HVDTPU_BENCH_GPT_SEQ", 1024))
+    if long_context:
+        # Defaults scale from the short-bench knobs so a CPU smoke that
+        # shrinks the GPT bench shrinks this variant too (4x the sequence,
+        # a quarter of the batch); explicit LONG_* knobs win.
+        B = int(os.environ.get("HVDTPU_BENCH_GPT_LONG_BATCH", max(1, B // 4)))
+        S = int(os.environ.get("HVDTPU_BENCH_GPT_LONG_SEQ", 4 * S))
     params = gpt.init_params(jax.random.PRNGKey(0), cfg)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
@@ -269,7 +282,8 @@ def _gpt_bench(jax, jnp):
     peak = _peak_flops_per_chip(jax.devices()[0])
     mfu = round(6.0 * n_params * tok_s / peak, 4) if peak else None
     entry = {"model": f"GPT {n_params / 1e6:.0f}M (L{cfg.num_layers} "
-                      f"d{cfg.embed_dim} seq {S} bs {B})",
+                      f"d{cfg.embed_dim} seq {S} bs {B}"
+                      + (" remat=full" if long_context else "") + ")",
              "tokens_per_sec_per_chip": round(tok_s, 1), "mfu": mfu}
     if mfu is not None and mfu > 1.0:
         entry["error"] = f"mfu={mfu} exceeds 1.0 — measurement invalid"
@@ -394,11 +408,26 @@ def _run():
 
     micro = _microbench(hvd, jnp, jax)
     _partial["microbench"] = micro
-    try:
-        gpt_metric = _gpt_bench(jax, jnp)
-    except Exception as exc:
-        gpt_metric = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
-    _partial["gpt"] = gpt_metric
+
+    def guarded(key, fn):
+        try:
+            _partial[key] = fn()
+        except Exception as exc:
+            _partial[key] = {"error": f"{type(exc).__name__}: "
+                                      f"{str(exc)[:200]}"}
+
+    guarded("gpt", lambda: _gpt_bench(jax, jnp))
+    # Long-context variant LAST, and only with watchdog headroom: a
+    # failure/stall here must never cost the phases above (the watchdog
+    # reports _partial, but its top-level error key would still mark the
+    # run) — skip with a note when under 300 s remain.
+    deadline = float(os.environ.get("HVDTPU_BENCH_DEADLINE", 1500))
+    if time.monotonic() - _T0 > deadline - 300:
+        _partial["gpt_long_context"] = {
+            "skipped": "insufficient watchdog headroom"}
+    else:
+        guarded("gpt_long_context",
+                lambda: _gpt_bench(jax, jnp, long_context=True))
 
     # _partial already holds every phase's keys (that is the contract the
     # watchdog relies on); the success result IS the completed _partial.
