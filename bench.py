@@ -43,6 +43,20 @@ _partial = {}
 # Process start, for phase-skipping against the watchdog deadline.
 _T0 = time.monotonic()
 
+def _fallback_result(error: str) -> dict:
+    """Zero-result skeleton + every completed phase + the error — shared by
+    the watchdog and the hard-failure path so they cannot drift."""
+    result = {
+        "metric": "ResNet-50 synthetic training throughput per chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+    }
+    result.update(_partial)
+    result["error"] = error
+    return result
+
+
 _TRANSIENT_MARKERS = (
     "UNAVAILABLE", "Connection refused", "connection refused",
     "DEADLINE_EXCEEDED", "failed to connect", "Socket closed",
@@ -450,17 +464,9 @@ def _arm_watchdog():
     deadline = float(os.environ.get("HVDTPU_BENCH_DEADLINE", 1500))
 
     def fire():
-        result = {
-            "metric": "ResNet-50 synthetic training throughput per chip",
-            "value": 0.0,
-            "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
-        }
-        result.update(_partial)  # keep whatever phases completed
-        result["error"] = (f"watchdog: bench exceeded {deadline:.0f}s "
-                           "(backend hang)"
-                           + ("; reporting completed phases" if _partial
-                              else ""))
+        result = _fallback_result(
+            f"watchdog: bench exceeded {deadline:.0f}s (backend hang)"
+            + ("; reporting completed phases" if _partial else ""))
         # Emit first in any case: consumers read the LAST JSON line, so
         # this is the fallback record if a retry below never finishes.
         print(json.dumps(result), flush=True)
@@ -496,15 +502,8 @@ def main():
     except BaseException as exc:  # still emit the JSON line for the record
         import traceback
         traceback.print_exc()
-        result = {
-            "metric": "ResNet-50 synthetic training throughput per chip",
-            "value": 0.0,
-            "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
-        }
-        result.update(_partial)  # keep whatever phases completed
-        result["error"] = f"{type(exc).__name__}: {str(exc)[:500]}"
-        print(json.dumps(result))
+        print(json.dumps(_fallback_result(
+            f"{type(exc).__name__}: {str(exc)[:500]}")))
         return 1
     finally:
         watchdog.cancel()

@@ -800,7 +800,7 @@ class _SpmdEagerBackend(CollectiveBackend):
             [x[i * shard + off[r]: i * shard + off[r + 1]]
              for r in range(n) for i in range(n)], axis=0)
         recv = np.tile(sp.astype(np.int32), (n, 1)).T  # recv[r][i] = sp[r]
-        return out, jnp.asarray(np.ascontiguousarray(recv))
+        return out, jnp.asarray(recv)
 
     def reducescatter(self, x, op, name, axis):
         ax = runtime.dp_axis()

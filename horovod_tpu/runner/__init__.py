@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import secrets as _secrets
 
 try:
     import cloudpickle as _fn_pickler  # function serialization by value
@@ -43,8 +44,7 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     from ..utils import envvars as ev
 
     kwargs = kwargs or {}
-    secret = os.environ.get(ev.HVDTPU_SECRET) or __import__(
-        "secrets").token_hex(16)
+    secret = os.environ.get(ev.HVDTPU_SECRET) or _secrets.token_hex(16)
     server = KVStoreServer(secret=secret)
     server.start()
     server.put("/run/fn", _fn_pickler.dumps((fn, args, kwargs)))
