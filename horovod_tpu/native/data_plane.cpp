@@ -47,7 +47,12 @@ inline uint16_t FloatToHalf(float f) {
   uint32_t sign = (bits >> 16) & 0x8000u;
   int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
   uint32_t mant = bits & 0x7fffffu;
-  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);  // inf/overflow
+  if (exp >= 0x1f) {
+    // NaN must stay NaN (nonzero mantissa); inf and overflow saturate.
+    if (((bits >> 23) & 0xffu) == 0xffu && mant != 0)
+      return static_cast<uint16_t>(sign | 0x7e00u);
+    return static_cast<uint16_t>(sign | 0x7c00u);
+  }
   if (exp <= 0) {
     if (exp < -10) return static_cast<uint16_t>(sign);
     mant |= 0x800000u;
@@ -72,6 +77,10 @@ inline float Bf16ToFloat(uint16_t h) {
 inline uint16_t FloatToBf16(float f) {
   uint32_t bits;
   memcpy(&bits, &f, sizeof(bits));
+  // NaN first: the rounding add below would carry its mantissa into the
+  // exponent (NaN -> inf) or even the sign bit (0x7fffffff -> -0.0).
+  if ((bits & 0x7fffffffu) > 0x7f800000u)
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);  // quiet NaN
   // round-to-nearest-even
   uint32_t rounded = bits + 0x7fffu + ((bits >> 16) & 1u);
   return static_cast<uint16_t>(rounded >> 16);

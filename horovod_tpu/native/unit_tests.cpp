@@ -12,6 +12,7 @@
 #include <cmath>
 #include <cstdio>
 #include <cstring>
+#include <limits>
 #include <string>
 #include <vector>
 
@@ -119,6 +120,35 @@ void TestHalfConversionRoundtrip() {
   }
 }
 
+void TestHalfConversionSpecialValues() {
+  const float inf = std::numeric_limits<float>::infinity();
+  const float nan = std::numeric_limits<float>::quiet_NaN();
+  // Infinities survive both formats with sign.
+  CHECK_TRUE(std::isinf(HalfToFloatPublic(FloatToHalfPublic(inf))));
+  float nh = HalfToFloatPublic(FloatToHalfPublic(-inf));
+  CHECK_TRUE(std::isinf(nh) && nh < 0);
+  CHECK_TRUE(std::isinf(Bf16ToFloatPublic(FloatToBf16Public(inf))));
+  float nb = Bf16ToFloatPublic(FloatToBf16Public(-inf));
+  CHECK_TRUE(std::isinf(nb) && nb < 0);
+  // NaN stays NaN (pre-fix: fp16 silently produced inf; bf16's rounding
+  // add carried 0x7fffffff into the sign bit, producing -0.0).
+  CHECK_TRUE(std::isnan(HalfToFloatPublic(FloatToHalfPublic(nan))));
+  CHECK_TRUE(std::isnan(Bf16ToFloatPublic(FloatToBf16Public(nan))));
+  float all_ones_nan;
+  uint32_t all_ones_bits = 0x7fffffffu;
+  std::memcpy(&all_ones_nan, &all_ones_bits, sizeof(all_ones_nan));
+  CHECK_TRUE(std::isnan(Bf16ToFloatPublic(FloatToBf16Public(all_ones_nan))));
+  // Overflow saturates to inf (fp16 max normal is 65504).
+  CHECK_TRUE(std::isinf(HalfToFloatPublic(FloatToHalfPublic(1e6f))));
+  // Negative zero keeps its sign bit.
+  CHECK_TRUE(std::signbit(HalfToFloatPublic(FloatToHalfPublic(-0.0f))));
+  CHECK_TRUE(std::signbit(Bf16ToFloatPublic(FloatToBf16Public(-0.0f))));
+  // fp16 subnormal range (min normal 6.1e-5) roundtrips approximately.
+  float sub = 1e-5f;
+  float back = HalfToFloatPublic(FloatToHalfPublic(sub));
+  CHECK_TRUE(std::fabs(back - sub) < 1e-6f);
+}
+
 void TestReduceBufferOps() {
   float dst[4] = {1, 2, 3, 4};
   float src[4] = {4, 3, 2, 1};
@@ -196,6 +226,7 @@ int main() {
   TestResponseRoundtrip();
   TestReaderTruncationIsSafe();
   TestHalfConversionRoundtrip();
+  TestHalfConversionSpecialValues();
   TestReduceBufferOps();
   TestGaussianProcessInterpolates();
   TestBayesianOptimizerPicksBestSample();
