@@ -95,6 +95,26 @@ try:
 except hvd.TensorDtypeMismatchError:
     pass
 
+# error agreement: mismatched OP KIND under one name (reference:
+# ConstructResponse op validation) — every rank gets the same error.
+# (This menu always runs at n >= 2; mismatches need a second rank.)
+assert n >= 2, "error-agreement menu requires world size >= 2"
+try:
+    if r == 0:
+        hvd.allreduce(np.ones(3, np.float32), name="bad_op")
+    else:
+        hvd.allgather(np.ones(3, np.float32), name="bad_op")
+    sys.exit(1)
+except hvd.HvdTpuInternalError as e:
+    assert "Mismatched collective operations" in str(e), e
+
+# error agreement: mismatched broadcast root
+try:
+    hvd.broadcast(np.ones(2, np.float32), root_rank=r % 2, name="bad_root")
+    sys.exit(1)
+except hvd.HvdTpuInternalError as e:
+    assert "Mismatched broadcast root ranks" in str(e), e
+
 # adasum
 v = np.zeros(4, np.float32); v[r % 4] = r + 1.0
 out = np.asarray(hvd.allreduce(v, name="ad", op=hvd.Adasum))
