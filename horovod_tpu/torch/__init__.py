@@ -227,10 +227,37 @@ def allreduce_async_(tensor: torch.Tensor, name: Optional[str] = None,
                      postscale_factor=postscale_factor)
 
 
+class _AllgatherGrad(torch.autograd.Function):
+    """Differentiable allgather: sum cotangents across ranks, then take
+    this rank's row segment (reference: HorovodAllgather.backward,
+    torch/mpi_ops.py:334-343)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.scalar = tensor.dim() == 0
+        ctx.dim0 = 1 if ctx.scalar else int(tensor.shape[0])
+        out = _C.allgather(_to_numpy(tensor), name=name)
+        return _to_torch(np.asarray(out), tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        summed = np.asarray(_C.allreduce(_to_numpy(grad), op=Sum))
+        dims = np.asarray(_C.allgather(
+            np.array([ctx.dim0], np.int64))).reshape(-1)
+        offset = int(dims[:rank()].sum())
+        seg = summed.reshape((-1,) + tuple(grad.shape[1:]))[
+            offset:offset + ctx.dim0]
+        if ctx.scalar:
+            seg = seg.reshape(())  # autograd requires the input's 0-d shape
+        return _to_torch(seg, grad), None
+
+
 def allgather(tensor: torch.Tensor,
               name: Optional[str] = None) -> torch.Tensor:
     """Concatenate along dim 0 across ranks; ranks may differ in dim 0
-    (reference: ``hvd.allgather``, torch/mpi_ops.py:304)."""
+    (reference: ``hvd.allgather``, torch/mpi_ops.py:304); differentiable."""
+    if tensor.requires_grad:
+        return _AllgatherGrad.apply(tensor, name)
     out = _C.allgather(_to_numpy(tensor), name=name)
     return _to_torch(np.asarray(out), tensor)
 
@@ -243,9 +270,30 @@ def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
     return _async_op("allgather", tensor, name, finish)
 
 
+class _BroadcastGrad(torch.autograd.Function):
+    """Differentiable broadcast: cotangents sum onto the root; non-root
+    inputs get zero grads (reference: HorovodBroadcast.backward,
+    torch/mpi_ops.py:420-424)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
+        return _to_torch(np.asarray(out), tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        summed = np.asarray(_C.allreduce(_to_numpy(grad), op=Sum))
+        if rank() != ctx.root_rank:
+            summed = summed * 0
+        return _to_torch(summed.reshape(tuple(grad.shape)), grad), None, None
+
+
 def broadcast(tensor: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
-    """Reference: ``hvd.broadcast`` (torch/mpi_ops.py:387)."""
+    """Reference: ``hvd.broadcast`` (torch/mpi_ops.py:387); differentiable."""
+    if tensor.requires_grad:
+        return _BroadcastGrad.apply(tensor, root_rank, name)
     out = _C.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name)
     return _to_torch(np.asarray(out), tensor)
 
@@ -273,10 +321,50 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
     return _async_op("broadcast", tensor, name, finish, root_rank=root_rank)
 
 
+class _AlltoallGrad(torch.autograd.Function):
+    """Differentiable alltoall: backward is the inverse exchange — grads
+    route home using the received splits (reference: HorovodAlltoall.backward,
+    torch/mpi_ops.py:554-562)."""
+
+    @staticmethod
+    def forward(ctx, tensor, splits, name):
+        sp = None if splits is None else _to_numpy(splits).astype(np.int32)
+        if sp is None:
+            # Even split of THIS rank's rows — but other ranks' dim 0 may
+            # differ, so the received row counts (what backward must route
+            # back) still vary per source; derive them lazily in backward.
+            ctx.recv_splits = None
+            ctx.sent_per_peer = (int(tensor.shape[0]) // size()
+                                 if tensor.dim() else 0)
+            h = _C.alltoall_async(_to_numpy(tensor), name=name)
+            out = _C.synchronize(h)
+        else:
+            out, recv = _C.alltoall(_to_numpy(tensor), splits=sp, name=name)
+            ctx.recv_splits = np.asarray(recv, np.int32)
+        return _to_torch(np.asarray(out), tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        sp = ctx.recv_splits
+        if sp is None:
+            # rows received from source j == dims[j]; backward sends each
+            # segment home, so dims IS the backward send-splits vector.
+            sp = np.asarray(_C.allgather(
+                np.array([ctx.sent_per_peer], np.int64))).reshape(-1)
+        # async+synchronize: payload only — skips the received_splits
+        # reconstruction the sync uneven path would compute and discard.
+        h = _C.alltoall_async(_to_numpy(grad),
+                              splits=np.asarray(sp, np.int32))
+        out = np.asarray(_C.synchronize(h))
+        return _to_torch(out, grad), None, None
+
+
 def alltoall(tensor: torch.Tensor, splits: Optional[torch.Tensor] = None,
              name: Optional[str] = None) -> torch.Tensor:
     """Reference: ``hvd.alltoall`` (torch/mpi_ops.py:517) with optional
-    uneven splits."""
+    uneven splits; differentiable."""
+    if tensor.requires_grad:
+        return _AlltoallGrad.apply(tensor, splits, name)
     sp = None if splits is None else _to_numpy(splits).astype(np.int32)
     # async+synchronize: yields the payload alone in every mode, skipping
     # the received_splits reconstruction (an extra splits allgather on the

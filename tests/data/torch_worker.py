@@ -74,6 +74,52 @@ z = hvd.allreduce(t, name="ad", op=hvd.Sum)
 z.sum().backward()
 assert torch.allclose(t.grad, torch.full((3,), float(n))), t.grad
 
+# autograd: allgather backward sums cotangents and takes this rank's rows
+# (reference: HorovodAllgather.backward, test_torch.py grad menu).
+g = torch.ones((2,), requires_grad=True) * (r + 1)
+g.retain_grad()
+y = hvd.allgather(g, name="gat_grad")
+w = torch.arange(2 * n, dtype=torch.float32)
+(w * y).sum().backward()
+# cotangent w is identical on every rank; summed over ranks -> n * w; this
+# rank keeps rows [2r, 2r+2).
+assert torch.allclose(g.grad, n * w[2 * r: 2 * r + 2]), g.grad
+
+# autograd: broadcast backward sums onto the root, zeros elsewhere
+b = torch.ones((2,), requires_grad=True)
+b.retain_grad()
+y = hvd.broadcast(b, root_rank=1, name="bc_grad")
+((r + 1.0) * y).sum().backward()
+expect_g = float(n * (n + 1) // 2) if r == 1 else 0.0
+assert torch.allclose(b.grad, torch.full((2,), expect_g)), b.grad
+
+# autograd: alltoall backward routes cotangents back (row sent to rank j
+# comes back with rank j's cotangent scale)
+a2 = torch.ones((n,), requires_grad=True)
+a2.retain_grad()
+y = hvd.alltoall(a2, name="a2a_grad")
+((r + 1.0) * y).sum().backward()
+assert torch.allclose(
+    a2.grad, torch.arange(1, n + 1, dtype=torch.float32)), a2.grad
+
+# autograd: alltoall splits=None with per-rank DIFFERENT dim 0 — backward
+# must route by what was actually received, not an even split of the grad
+# (rank r sends r+1 rows to each peer).
+a3 = torch.ones((n * (r + 1),), requires_grad=True)
+a3.retain_grad()
+y = hvd.alltoall(a3, name="a2a_grad_uneven_dims")
+((r + 1.0) * y).sum().backward()
+expect = torch.repeat_interleave(
+    torch.arange(1, n + 1, dtype=torch.float32), r + 1)
+assert torch.allclose(a3.grad, expect), (a3.grad, expect)
+
+# autograd: 0-d allgather gradient keeps the scalar shape
+s = torch.tensor(float(r + 1), requires_grad=True)
+y = hvd.allgather(s, name="gat_scalar_grad")
+(torch.arange(1, n + 1, dtype=torch.float32) * y).sum().backward()
+assert s.grad.shape == torch.Size([]) and \
+    float(s.grad) == float(n * (r + 1)), s.grad
+
 # object collectives
 objs = hvd.allgather_object({"rank": r}, name="obj")
 assert [o["rank"] for o in objs] == list(range(n)), objs
