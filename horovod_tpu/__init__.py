@@ -24,7 +24,7 @@ from .ops.collectives import (
     allreduce, allreduce_async, grouped_allreduce,
     allgather, allgather_async, broadcast, broadcast_async,
     alltoall, alltoall_async, reducescatter, join, poll, synchronize,
-    release_handle, hierarchical_allreduce_p,
+    release_handle, hierarchical_allreduce_p, hierarchical_allgather_p,
     # In-step primitives (inside shard_map / run_step).
     allreduce_p, allgather_p, broadcast_p, alltoall_p, reducescatter_p,
     ppermute_p, rank_in_step, size_in_step, in_named_trace, pvary,
@@ -38,6 +38,11 @@ from .parallel.optimizer import (DistributedOptimizer, DistributedGradientTape,
 # ZeRO-style cross-replica sharded weight update (arXiv:2004.13336;
 # TPU-first extension, no reference analog).
 from .parallel.sharded_optimizer import ShardedDistributedOptimizer
+
+# Flat-vs-hierarchical calibration (reference: the parameter manager's
+# categorical hierarchical_allreduce switch, parameter_manager.h:186).
+from .parallel.strategy import (autotune_hierarchical, choose_hierarchical,
+                                clear_hierarchical_decisions)
 
 # Sequence/context parallelism (TPU-first; no reference analog — SURVEY.md §2.7).
 from .parallel.ring_attention import (ring_attention, ring_attention_p,

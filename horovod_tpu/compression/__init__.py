@@ -85,5 +85,6 @@ from .quantize import (MaxMinQuantizer, NormalizedQuantizer,  # noqa: E402
 from .error_feedback import (init_error_feedback,  # noqa: E402
                              compress_with_feedback)
 from .reducers import (compressed_allreduce,  # noqa: E402
-                       compressed_grouped_allreduce)
+                       compressed_grouped_allreduce,
+                       hierarchical_compressed_allreduce_p)
 from .config import CompressionConfig, make_compressor, from_env  # noqa: E402

@@ -294,6 +294,76 @@ _REDUCERS = {
 }
 
 
+def hierarchical_compressed_allreduce_p(
+        x, compressor, inner_axis: str = None, outer_axis: str = None,
+        reduction: str = "scatter_allgather",
+        op: C.ReduceOp = C.ReduceOp.AVERAGE, residual=None, key=None):
+    """Hierarchical allreduce with a COMPRESSED slow-fabric hop: dense
+    reduce-scatter over the fast ``inner_axis`` (ICI), compressed reducer
+    over the slow ``outer_axis`` (DCN), dense allgather back over inner.
+
+    This is where gradient compression pays on TPU: ICI bandwidth makes
+    compressing the intra-slice hop a loss, but the cross-slice DCN hop is
+    the 25 Gb/s-RoCE analog of the reference fork's target fabric (the
+    fork's wins were all on slow inter-node links; SURVEY §2.3). Each chip
+    quantizes only its 1/n_inner shard, so compression compute also shrinks
+    by n_inner.
+
+    ``residual`` (error feedback) is SHARD-shaped — state for the
+    compressed hop only; pass the previous call's returned residual, or
+    zeros of the returned residual's shape to start.
+    """
+    if inner_axis is None or outer_axis is None:
+        raise ValueError("hierarchical_compressed_allreduce_p needs explicit "
+                         "inner_axis (ICI) and outer_axis (DCN)")
+    if reduction not in _REDUCERS:
+        raise ValueError(f"unknown reduction {reduction!r}; "
+                         f"choose from {sorted(_REDUCERS)}")
+    if op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE):
+        # The compressed reducers are sum-based (like the reference's);
+        # silently returning a sum labeled MIN/MAX/PRODUCT/ADASUM would be
+        # numerically wrong with no error.
+        raise ValueError(
+            f"hierarchical_compressed_allreduce_p supports Sum/Average "
+            f"only, got {op!r}")
+    n_inner = lax.axis_size(inner_axis)
+    total = n_inner * lax.axis_size(outer_axis)
+    if C._dp_invariant(x, inner_axis) and C._dp_invariant(x, outer_axis):
+        # Already reduced over the mesh (autodiff-psummed gradients of
+        # replicated params): normalization-only, matching allreduce_p /
+        # hierarchical_allreduce_p's invariant semantics. There is nothing
+        # to compress (no bytes would move), so the residual is untouched.
+        y = (x.astype(jnp.float32) / total).astype(x.dtype) \
+            if op == C.ReduceOp.AVERAGE else x
+        return (y, residual) if residual is not None else y
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_inner
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # reducescatter_p (not raw psum_scatter): handles an input already
+    # reduced over the inner axis with consistent semantics.
+    shard = C.reducescatter_p(flat, op=C.ReduceOp.SUM, axis=inner_axis)
+    if C._dp_invariant(shard, outer_axis):
+        # Input was already reduced over the outer axis: the compressed
+        # exchange would gather n_outer identical copies and re-sum them
+        # (n_outer-times-too-large). Nothing crosses the slow fabric;
+        # the residual is untouched.
+        out, new_res = shard, residual
+    else:
+        out, new_res = _REDUCERS[reduction](shard, compressor,
+                                            axis=outer_axis,
+                                            residual=residual, key=key)
+    full = C.allgather_p(out, axis=inner_axis)
+    if pad:
+        full = full[:-pad]
+    y = full.reshape(orig_shape)
+    if op == C.ReduceOp.AVERAGE:
+        y = (y.astype(jnp.float32) / total)
+    y = y.astype(orig_dtype)
+    return (y, new_res) if residual is not None else y
+
+
 # ---------------------------------------------------------------------------
 # Fused-group form (reference: CompressionMode::Fused, common.h:164-168 —
 # the fork compresses the *fused* buffer, not each tensor)

@@ -143,10 +143,11 @@ class Estimator:
         # otherwise die deep inside pyarrow/Spark with an opaque error.
         if validation is not None:
             if spark_df is not None and \
-                    self._as_spark_df(validation) is None:
+                    self._as_spark_df(validation) is None and \
+                    not isinstance(validation, float):
                 raise ValueError(
-                    "validation must be a Spark DataFrame when fitting a "
-                    "Spark DataFrame")
+                    "validation must be a Spark DataFrame or a float "
+                    "fraction when fitting a Spark DataFrame")
             if spark_df is None and isinstance(data, str) and \
                     not isinstance(validation, str):
                 raise ValueError(

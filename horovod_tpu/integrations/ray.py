@@ -183,12 +183,27 @@ class RayExecutor:
             self._pg = placement_group(bundles, strategy="STRICT_SPREAD")
             ray.get(self._pg.ready(),
                     timeout=self.settings.placement_group_timeout_s)
+            # Modern Ray (2.x) rejects the raw placement_group/
+            # placement_group_bundle_index options in favor of
+            # scheduling_strategy=PlacementGroupSchedulingStrategy; keep the
+            # legacy options only for rays that predate it.
+            try:
+                from ray.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy)
+            except ImportError:
+                PlacementGroupSchedulingStrategy = None
             opts = []
             for host in range(self.num_hosts):
                 for _ in range(self.num_slots):
                     o = dict(base)
-                    o["placement_group"] = self._pg
-                    o["placement_group_bundle_index"] = host
+                    if PlacementGroupSchedulingStrategy is not None:
+                        o["scheduling_strategy"] = \
+                            PlacementGroupSchedulingStrategy(
+                                placement_group=self._pg,
+                                placement_group_bundle_index=host)
+                    else:
+                        o["placement_group"] = self._pg
+                        o["placement_group_bundle_index"] = host
                     opts.append(o)
             return opts
         except ImportError:

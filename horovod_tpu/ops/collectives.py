@@ -105,7 +105,9 @@ def _dp_invariant(x, ax: str) -> bool:
     if getattr(_plain_semantics, "on", False):
         return False
     try:
-        return ax not in jax.typeof(x).vma
+        vma = jax.typeof(x).vma
+        axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+        return all(a not in vma for a in axes)
     except Exception:
         return False
 
@@ -332,6 +334,21 @@ def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
         raise ValueError("hierarchical_allreduce_p needs explicit "
                          "inner_axis (ICI) and outer_axis (DCN)")
     x = _apply_scale(x, prescale_factor)
+    if _dp_invariant(x, inner_axis) and _dp_invariant(x, outer_axis):
+        # Already reduced over the mesh (e.g. autodiff-psummed gradients of
+        # replicated params under check_vma): normalization-only, the SAME
+        # semantics as allreduce_p's invariant branch — without this, the
+        # pipeline below would re-sum and return a world-size-times-larger
+        # result for the most common DistributedOptimizer usage.
+        total = lax.axis_size(inner_axis) * lax.axis_size(outer_axis)
+        if op in (ReduceOp.AVERAGE, ReduceOp.ADASUM):
+            y = _apply_scale(x, 1.0 / total)
+        elif op in (ReduceOp.SUM, ReduceOp.MIN, ReduceOp.MAX,
+                    ReduceOp.PRODUCT):
+            y = x
+        else:
+            raise ValueError(f"unknown ReduceOp {op}")
+        return _apply_scale(y, postscale_factor)
     if op in (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT):
         # No reduce-scatter form; reduce over both axes directly.
         y = allreduce_p(allreduce_p(x, op=op, axis=inner_axis),
@@ -350,12 +367,15 @@ def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
 
-    shard = lax.psum_scatter(flat, inner_axis, scatter_dimension=0, tiled=True)
+    # reducescatter_p / allreduce_p (not raw psum_scatter/psum): they
+    # handle per-axis invariance, so an input already reduced over ONE of
+    # the two axes still comes out with allreduce_p-consistent semantics.
+    shard = reducescatter_p(flat, op=ReduceOp.SUM, axis=inner_axis)
     if op == ReduceOp.ADASUM:
         from ..parallel.adasum import adasum_p
         shard = adasum_p(shard, axis=outer_axis)
     else:
-        shard = lax.psum(shard, outer_axis)
+        shard = allreduce_p(shard, op=ReduceOp.SUM, axis=outer_axis)
     # allgather_p lowers to a true all-gather with provably-replicated
     # output (all_gather_invariant), so this leg costs gather-wire bytes,
     # not the old masked-psum's 2x.
@@ -367,6 +387,35 @@ def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
     if op == ReduceOp.AVERAGE:
         y = _apply_scale(y, 1.0 / total)
     return _apply_scale(y, postscale_factor)
+
+
+def hierarchical_allgather_p(x, inner_axis: str = None,
+                             outer_axis: str = None):
+    """Hierarchical allgather over a 2D mesh: gather over the fast
+    ``inner_axis`` (ICI within a slice) first, then gather the slice-slabs
+    over the slow ``outer_axis`` (DCN across slices).
+
+    Reference: ``MPIHierarchicalAllgather``
+    (``mpi_operations.cc:236-240``) — ranks first deposit into a node-local
+    shared-memory window (the cheap fabric), then a single cross-node
+    allgather moves one contiguous node-slab per node. The TPU analog keeps
+    the slow-fabric collective confined to the outer axis and makes its
+    payload one large contiguous slab per slice (``n_inner`` tensors in one
+    DCN op) instead of interleaving small per-device chunks across both
+    fabrics.
+
+    Output ordering equals the flat gather's global rank order: the outer
+    axis is the slower-varying index, matching ``run_step``'s rank layout
+    (device ``(o, i)`` = rank ``o * n_inner + i``). The result is invariant
+    (replicated) over both axes, like :func:`allgather_p`'s.
+    """
+    if inner_axis is None or outer_axis is None:
+        raise ValueError("hierarchical_allgather_p needs explicit "
+                         "inner_axis (ICI) and outer_axis (DCN)")
+    # ICI leg: concat this slice's tensors on dim 0 (invariant over inner).
+    slab = allgather_p(x, axis=inner_axis)
+    # DCN leg: one large contiguous slab per slice crosses the slow fabric.
+    return allgather_p(slab, axis=outer_axis)
 
 
 # ---------------------------------------------------------------------------
@@ -881,11 +930,34 @@ def grouped_allreduce(tensors, name: Optional[str] = None,
     return jax.tree.unflatten(treedef, list(out))
 
 
-def allgather(x, name: Optional[str] = None, axis: Optional[str] = None):
+def allgather(x, name: Optional[str] = None, axis: Optional[str] = None,
+              hierarchical: Optional[tuple] = None):
     """Allgather: concatenate each rank's tensor along dim 0. Ranks may differ in
     dim 0 (reference: varying first dimension, ``controller.cc:812-832``) — on the
     process-mode path only; the SPMD path requires equal shards (uniform mesh).
+
+    ``hierarchical=(inner_axis, outer_axis)`` routes through
+    :func:`hierarchical_allgather_p` — ICI gather then one contiguous
+    slab per slice over DCN (reference: ``MPIHierarchicalAllgather``,
+    ``mpi_operations.cc:236-240``). In-step only, like the hierarchical
+    allreduce.
     """
+    if hierarchical is not None:
+        if len(hierarchical) != 2 or hierarchical[0] == "auto":
+            # The measured auto-choice calibrates ALLREDUCE timings; the
+            # gather has no flat-vs-hier A/B here. Catch the 3-tuple form
+            # early — in_named_trace("auto") would otherwise produce a
+            # misleading "in-step only" error for an in-step call.
+            raise ValueError(
+                "allgather takes hierarchical=(inner_axis, outer_axis); "
+                "the (\"auto\", inner, outer) form applies to "
+                "allreduce_gradients/DistributedOptimizer only")
+        if not in_named_trace(hierarchical[0]):
+            raise ValueError(
+                "hierarchical allgather is in-step only: call inside "
+                "run_step/shard_map over a mesh with both axes")
+        return hierarchical_allgather_p(x, inner_axis=hierarchical[0],
+                                        outer_axis=hierarchical[1])
     return _dispatch.resolve("allgather", _ctx(axis)).allgather(
         x, name=name, axis=axis)
 

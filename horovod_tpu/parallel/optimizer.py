@@ -20,6 +20,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from .. import runtime
@@ -41,12 +42,44 @@ def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
     :func:`~horovod_tpu.ops.collectives.hierarchical_allreduce_p` — reduce-
     scatter over the fast ICI axis, allreduce over the slow DCN axis,
     allgather back (reference: ``NCCLHierarchicalAllreduce``). In-step only.
+    ``hierarchical=("auto", inner_axis, outer_axis)`` consults the measured
+    calibration table (:func:`~horovod_tpu.parallel.strategy
+    .autotune_hierarchical`; reference: the parameter manager's categorical
+    hierarchical switch, ``parameter_manager.h:186``) keyed on the total
+    gradient bytes, falling back to flat when uncalibrated. The choice is
+    baked into the compiled program at trace time — calibrate once after
+    ``init`` and *before* building the training step; re-calibration does
+    not retrace already-compiled steps.
     """
+    if hierarchical is not None and compression is not None:
+        # Checked BEFORE the auto resolution: the auto-flat early return
+        # must not silently drop a compressor the hierarchical route would
+        # reject (behavior must not flip with calibration state).
+        raise ValueError(
+            "hierarchical allreduce does not take a compressor; use "
+            "compressed_allreduce over the slow axis instead")
+    if hierarchical is not None and len(hierarchical) == 3 and \
+            hierarchical[0] == "auto":
+        from .strategy import choose_hierarchical
+        inner, outer = hierarchical[1], hierarchical[2]
+        nbytes = sum(int(np.prod(g.shape)) * jnp.dtype(g.dtype).itemsize
+                     for g in jax.tree.leaves(grads))
+        if choose_hierarchical(inner, outer, nbytes):
+            hierarchical = (inner, outer)
+        else:
+            # Flat: ONE fused all-reduce over both axes — the same program
+            # the calibration's flat arm timed.
+            if not C.in_named_trace(inner):
+                raise ValueError(
+                    "hierarchical allreduce is in-step only: call inside "
+                    "run_step/shard_map over a mesh with both axes")
+            return jax.tree.map(
+                lambda g: C.allreduce_p(
+                    g, op=op, axis=(inner, outer),
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor),
+                grads)
     if hierarchical is not None:
-        if compression is not None:
-            raise ValueError(
-                "hierarchical allreduce does not take a compressor; use "
-                "compressed_allreduce over the slow axis instead")
         if not C.in_named_trace(hierarchical[0]):
             raise ValueError(
                 "hierarchical allreduce is in-step only: call inside "
@@ -71,7 +104,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          gradient_predivide_factor: float = 1.0,
                          prescale_factor: Optional[float] = None,
                          postscale_factor: Optional[float] = None,
-                         axis: Optional[str] = None
+                         axis: Optional[str] = None,
+                         hierarchical: Optional[Tuple] = None
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates use cross-rank-reduced gradients.
 
@@ -95,6 +129,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
       state and need per-leaf sharded out_specs (or use the eager path).
     * ``named_parameters`` is accepted for signature parity and ignored (optax is
       functional; parameter identity comes from the pytree).
+    * ``hierarchical``: ``(inner_axis, outer_axis)`` or ``("auto", inner,
+      outer)`` — gradient reduction rides the hierarchical (cross-slice)
+      path, as :func:`allreduce_gradients`; reference: the autotuned
+      ``NCCLHierarchicalAllreduce`` switch. In-step only; incompatible with
+      ``compression``.
 
     Works inside ``jit``/``shard_map`` (collective lowers to ``lax.psum``) and
     eagerly in either runtime mode.
@@ -124,14 +163,31 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         comp_cfg = compression if isinstance(compression, CompressionConfig) \
             else CompressionConfig(default_compressor=compression)
 
+    if hierarchical is not None and compression is not None:
+        raise ValueError(
+            "hierarchical gradient reduction does not take a compressor; "
+            "use compressed_allreduce over the slow axis instead "
+            "(hierarchical_compressed_allreduce_p)")
+
     def _reduce(grads):
         eff_op = op
         pre_f = 1.0 if pre is None else pre
         post_f = 1.0 if post is None else post
         if gradient_predivide_factor != 1.0:
-            n = C.size_in_step(axis) if C.in_named_trace(axis) else runtime.size()
+            if hierarchical is not None:
+                # World size spans BOTH mesh axes on the hierarchical path.
+                h_inner, h_outer = hierarchical[-2], hierarchical[-1]
+                n = C.size_in_step(h_inner) * C.size_in_step(h_outer)
+            else:
+                n = C.size_in_step(axis) if C.in_named_trace(axis) \
+                    else runtime.size()
             pre_f = gradient_predivide_factor / n
             eff_op = C.ReduceOp.SUM
+        if hierarchical is not None:
+            return allreduce_gradients(grads, op=eff_op,
+                                       prescale_factor=pre_f,
+                                       postscale_factor=post_f,
+                                       hierarchical=tuple(hierarchical))
         return C.grouped_allreduce(grads, name="grads", op=eff_op,
                                    compression=compression,
                                    prescale_factor=pre_f,

@@ -1,0 +1,159 @@
+"""Flat-vs-hierarchical allreduce autotuning for the compiled path.
+
+Reference: the parameter manager tunes ``hierarchical_allreduce`` /
+``hierarchical_allgather`` on/off as categorical Bayesian parameters jointly
+with fusion/cycle (``horovod/common/parameter_manager.h:186``; params synced
+to all ranks via ``Controller::SynchronizeParameters``, ``controller.cc:34``).
+
+TPU-native redesign: on the compiled path the choice must be static at trace
+time (XLA compiles one collective program), so instead of an online
+per-cycle tuner this is a **measured A/B calibration**: run both program
+variants on the live mesh per message size, record the winner, and let
+``hierarchical="auto"`` consult the table when the gradient-reduction
+program is built. The slow-outer-axis case (DCN across slices) is exactly
+where hierarchical wins — only 1/n_inner of the bytes cross the slow fabric
+(see :func:`~horovod_tpu.ops.collectives.hierarchical_allreduce_p`).
+
+The measurement hook is injectable so the decision logic is testable against
+a bandwidth model without real multi-fabric hardware (the same reason the
+reference unit-tests its parameter manager against synthetic scores).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import runtime
+from ..ops import collectives as C
+from ..utils import logging as log
+
+# (inner_axis, outer_axis, mesh-shape signature) -> sorted list of
+# (nbytes, "flat"|"hierarchical"). The mesh shape is part of the key so a
+# table measured on one topology never silently governs a differently-
+# shaped mesh after shutdown()/re-init with the same axis names.
+_decisions: Dict[Tuple, List[Tuple[int, str]]] = {}
+_lock = threading.Lock()
+_warned_uncalibrated = set()
+
+
+def _mesh_key(inner_axis: str, outer_axis: str) -> Tuple:
+    shape = tuple(sorted(runtime.mesh().shape.items()))
+    return (inner_axis, outer_axis, shape)
+
+
+def _variant_fn(kind: str, inner_axis: str, outer_axis: str):
+    """The jitted flat or hierarchical allreduce program the calibration
+    times (exposed so tests can assert the compiled HLO really contains
+    the collectives — a replicated input short-circuiting them would make
+    the A/B time a no-op and always pick flat)."""
+    mesh = runtime.mesh()
+
+    if kind == "flat":
+        def body(s):
+            # pvary first: a replicated input short-circuits allreduce_p's
+            # collectives entirely (_dp_invariant), timing nothing. Flat =
+            # ONE fused all-reduce over both axes (what a user writes as
+            # allreduce_p(axis=(inner, outer))), not two sequential
+            # per-axis volleys — the A/B must compare against the real
+            # alternative, not a strawman.
+            s = C.pvary(C.pvary(s, inner_axis), outer_axis)
+            return C.allreduce_p(s, op=C.ReduceOp.SUM,
+                                 axis=(inner_axis, outer_axis))
+    else:
+        def body(s):
+            s = C.pvary(C.pvary(s, inner_axis), outer_axis)
+            return C.hierarchical_allreduce_p(s, op=C.ReduceOp.SUM,
+                                              inner_axis=inner_axis,
+                                              outer_axis=outer_axis)
+
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                                 out_specs=P()))
+
+
+def _default_measure(kind: str, nbytes: int, inner_axis: str,
+                     outer_axis: str, reps: int) -> float:
+    """Median wall time of one eager dispatch of the flat or hierarchical
+    allreduce program at ``nbytes`` over the live mesh."""
+    nelem = max(nbytes // 4, 1)
+    x = jnp.ones((nelem,), jnp.float32)
+    fn = _variant_fn(kind, inner_axis, outer_axis)
+    jax.block_until_ready(fn(x))  # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def autotune_hierarchical(inner_axis: str, outer_axis: str,
+                          sizes: Tuple[int, ...] = (1 << 20, 16 << 20,
+                                                    128 << 20),
+                          reps: int = 5,
+                          measure: Optional[Callable] = None) -> dict:
+    """Calibrate flat vs hierarchical allreduce on the live mesh.
+
+    Runs both variants at each message size, records the faster one, and
+    returns ``{nbytes: ("flat"|"hierarchical", flat_s, hier_s)}``. Decisions
+    feed ``allreduce_gradients(..., hierarchical="auto")``.
+
+    ``measure(kind, nbytes, inner_axis, outer_axis, reps) -> seconds`` is
+    injectable for tests (bandwidth models) and for offline tables.
+    """
+    m = measure if measure is not None else _default_measure
+    results = {}
+    table: List[Tuple[int, str]] = []
+    for nbytes in sorted(sizes):
+        flat_s = m("flat", nbytes, inner_axis, outer_axis, reps)
+        hier_s = m("hierarchical", nbytes, inner_axis, outer_axis, reps)
+        choice = "hierarchical" if hier_s < flat_s else "flat"
+        results[nbytes] = (choice, flat_s, hier_s)
+        table.append((nbytes, choice))
+        log.info(f"autotune_hierarchical[{inner_axis},{outer_axis}] "
+                 f"{nbytes >> 20}MB: flat={flat_s * 1e3:.3f}ms "
+                 f"hier={hier_s * 1e3:.3f}ms -> {choice}")
+    with _lock:
+        key = _mesh_key(inner_axis, outer_axis)
+        _decisions[key] = table
+        _warned_uncalibrated.discard(key)
+    return results
+
+
+def clear_hierarchical_decisions() -> None:
+    with _lock:
+        _decisions.clear()
+        _warned_uncalibrated.clear()
+
+
+def choose_hierarchical(inner_axis: str, outer_axis: str,
+                        nbytes: int) -> bool:
+    """True if the calibrated table says hierarchical wins at ``nbytes``
+    (nearest measured size decides). Uncalibrated — including a mesh whose
+    SHAPE differs from the one the table was measured on — defaults to
+    flat, with a one-time warning: the reference's default of hierarchical
+    OFF until the parameter manager turns it on."""
+    key = _mesh_key(inner_axis, outer_axis)
+    with _lock:
+        table = _decisions.get(key)
+    if not table:
+        if key not in _warned_uncalibrated:
+            _warned_uncalibrated.add(key)
+            log.warning(
+                f"hierarchical='auto' over ({inner_axis},{outer_axis}) "
+                f"without calibration for mesh {key[2]} — defaulting to "
+                "flat; run hvd.autotune_hierarchical(inner, outer) once "
+                "after init")
+        return False
+    # Nearest measured size in LOG space (message sizes span decades; 32 MB
+    # is "closer" to 64 MB than to 64 KB even though the linear distances
+    # say otherwise).
+    ln = np.log(max(nbytes, 1))
+    i = int(np.argmin([abs(np.log(s) - ln) for s, _ in table]))
+    return table[i][1] == "hierarchical"

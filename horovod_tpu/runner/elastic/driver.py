@@ -53,6 +53,10 @@ class ElasticSettings:
     discovery_interval_s: float = 1.0
     elastic_timeout_s: float = 600.0
     reset_limit: Optional[int] = None
+    # Interpreter for the {python} placeholder on REMOTE hosts (matching the
+    # static launcher's --remote-python; local slots always use
+    # sys.executable).
+    remote_python: str = "python3"
 
 
 class ElasticDriver:
@@ -197,7 +201,8 @@ class ElasticDriver:
         if self._verbose:
             log.info("elastic: spawning %s", worker_id)
         local = safe_exec.is_local_host(hostname)
-        cmd = safe_exec.resolve_python(self._command, local)
+        cmd = safe_exec.resolve_python(self._command, local,
+                                       self._settings.remote_python)
         if local:
             command = cmd
             stdin_data = None

@@ -253,7 +253,8 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
         min_np=args.min_np or args.num_proc,
         max_np=args.max_np or args.num_proc,
         elastic_timeout_s=args.elastic_timeout,
-        reset_limit=args.reset_limit)
+        reset_limit=args.reset_limit,
+        remote_python=args.remote_python)
     discovery = HostDiscoveryScript(args.host_discovery_script,
                                     slots=args.slots)
     # Worker topology comes from the rendezvous KV store, not static env;

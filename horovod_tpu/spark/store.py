@@ -132,14 +132,22 @@ class HDFSStore(Store):
     ``pyarrow.hdfs`` path)."""
 
     def __init__(self, prefix_path: str, host: Optional[str] = None,
-                 port: Optional[int] = None, user: Optional[str] = None):
+                 port: Optional[int] = None, user: Optional[str] = None,
+                 filesystem=None):
         from urllib.parse import urlparse
 
-        import pyarrow.fs as pafs
         parsed = urlparse(prefix_path)
-        self._fs = pafs.HadoopFileSystem(
-            host=host or parsed.hostname or "default",
-            port=port or parsed.port or 0, user=user)
+        if filesystem is not None:
+            # Injected pyarrow FileSystem (same API as HadoopFileSystem) —
+            # lets tests exercise the full remote-store code path against
+            # LocalFileSystem without a libhdfs runtime, and lets users
+            # supply a pre-configured/kerberized fs.
+            self._fs = filesystem
+        else:
+            import pyarrow.fs as pafs
+            self._fs = pafs.HadoopFileSystem(
+                host=host or parsed.hostname or "default",
+                port=port or parsed.port or 0, user=user)
         self.prefix_path = parsed.path or "/"
 
     def _run_path(self, run_id: str, name: str) -> str:

@@ -27,6 +27,14 @@ def prepare_data(df, store: Store, run_id: str, validation=None,
     count matches the training world size (each rank gets whole fragments).
     Returns metadata: row counts + output paths.
     """
+    if isinstance(validation, float):
+        # Fraction split (reference: util.py validation ratio — there via a
+        # rand() < ratio column filter; randomSplit is the same contract).
+        if not 0.0 < validation < 1.0:
+            raise ValueError(
+                f"validation fraction must be in (0, 1), got {validation}")
+        df, validation = df.randomSplit([1.0 - validation, validation],
+                                        seed=42)
     train_path = store.get_train_data_path(run_id)
     train_df = df if partitions is None else df.repartition(partitions)
     train_df.write.mode("overwrite").parquet(train_path)

@@ -474,6 +474,51 @@ class TestEagerProgramCache:
         assert after.currsize == mid.currsize
         assert after.hits >= mid.hits + 2
 
+    def test_warm_call_compiles_nothing(self, spmd8):
+        """Round-3 verdict #3: the warm eager compressed_allreduce must be
+        pure execution — zero XLA compilations — so its dispatch cost stays
+        within a small constant of the dense path's (r02 measured ~4,000x
+        before the cached-program rewrite). Verified with jax's compile-event
+        monitoring: cold call emits compile events, warm calls emit none."""
+        from jax._src import monitoring
+
+        q = MaxMinQuantizer(bits=4, use_pallas=False)
+        x = jnp.ones((65536,), jnp.float32)
+        events = []
+        listener = lambda name, **kw: events.append(name)  # noqa: E731
+        monitoring.register_event_listener(listener)
+        try:
+            compressed_allreduce(x, q)  # cold: compiles the group program
+            cold = [e for e in events if "compile" in e.lower()]
+            assert cold, "cold call should have compiled something"
+            events.clear()
+            for _ in range(3):
+                out = compressed_allreduce(x, q)
+            jax.block_until_ready(out)
+            warm = [e for e in events if "compile" in e.lower()]
+            assert warm == [], f"warm calls recompiled: {warm}"
+        finally:
+            monitoring.unregister_event_listener(listener)
+
+    def test_warm_dispatch_time_bounded(self, spmd8):
+        """Wall-time canary for the same regression: the warm call at 64 KiB
+        (compute negligible) must cost milliseconds, not the r02 path's
+        hundreds of ms of per-call retracing."""
+        import time
+
+        q = MaxMinQuantizer(bits=4, use_pallas=False)
+        x = jnp.ones((16384,), jnp.float32)
+        jax.block_until_ready(compressed_allreduce(x, q))  # warm the cache
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = compressed_allreduce(x, q)
+        jax.block_until_ready(out)
+        per_call = (time.perf_counter() - t0) / reps
+        # Generous CI bound: a cached-program dispatch is ~1 ms on the CPU
+        # mesh; the broken path was ~500 ms. 100 ms still catches a relapse.
+        assert per_call < 0.1, f"warm dispatch {per_call * 1e3:.1f} ms"
+
     def test_equal_config_quantizers_share_programs(self, spmd8):
         from horovod_tpu.compression.reducers import _eager_compressed_fn
         x = jnp.ones((256,), jnp.float32)

@@ -44,6 +44,8 @@ def _run_example(name, args, timeout=420):
     ("compression_benchmark.py", ["--bits", "4", "--size", "65536"], None),
     ("torch_mnist.py", ["--epochs", "1", "--batch-size", "64"], None),
     ("estimator_parquet.py", ["--epochs", "2"], None),
+    ("hierarchical_cross_slice.py", ["--steps", "2"],
+     "hierarchical cross-slice training ok"),
     # Not smoked here: jax_synthetic_benchmark.py is hard-wired to 224x224
     # ResNet-50 (bench.py's CPU drive covers the path); elastic_train.py
     # needs the elastic driver (test_elastic.py covers it); ray_mnist.py

@@ -174,6 +174,58 @@ class TestRayExecutor:
         ex.shutdown()
         assert ex.workers == []
 
+    def test_placement_group_scheduling_strategy(self, monkeypatch):
+        """num_hosts/num_slots placement must use the modern
+        scheduling_strategy=PlacementGroupSchedulingStrategy API when
+        present (Ray 2.x rejects the raw placement_group options —
+        round-3 advisor, medium)."""
+        import sys
+        import types
+
+        class _FakePG:
+            def ready(self):
+                return _FakeRef(True)
+
+        created = {}
+
+        def fake_placement_group(bundles, strategy=None):
+            created["bundles"] = bundles
+            created["strategy"] = strategy
+            return _FakePG()
+
+        class _FakePGSS:
+            def __init__(self, placement_group=None,
+                         placement_group_bundle_index=None):
+                self.placement_group = placement_group
+                self.placement_group_bundle_index = \
+                    placement_group_bundle_index
+
+        pg_mod = types.ModuleType("ray.util.placement_group")
+        pg_mod.placement_group = fake_placement_group
+        pg_mod.remove_placement_group = lambda pg: None
+        ss_mod = types.ModuleType("ray.util.scheduling_strategies")
+        ss_mod.PlacementGroupSchedulingStrategy = _FakePGSS
+        monkeypatch.setitem(sys.modules, "ray.util.placement_group", pg_mod)
+        monkeypatch.setitem(sys.modules, "ray.util.scheduling_strategies",
+                            ss_mod)
+        fake, ex = self._executor(
+            monkeypatch, ["n0", "n0", "n1", "n1"], num_hosts=2, num_slots=2)
+        saved = dict(os.environ)
+        try:
+            ex.start()
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+        assert created["strategy"] == "STRICT_SPREAD"
+        assert len(created["bundles"]) == 2
+        strategies = [o["scheduling_strategy"] for o in fake.remote_opts]
+        assert all(isinstance(s, _FakePGSS) for s in strategies)
+        assert [s.placement_group_bundle_index for s in strategies] == \
+            [0, 0, 1, 1]
+        # The deprecated raw options must be absent.
+        assert all("placement_group" not in o for o in fake.remote_opts)
+        ex.shutdown()
+
     def test_num_hosts_num_slots_topology(self, monkeypatch):
         fake, ex = self._executor(
             monkeypatch, ["n0", "n0", "n1", "n1"], num_hosts=2, num_slots=2)

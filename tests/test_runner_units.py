@@ -99,6 +99,21 @@ class TestPythonPlaceholder:
         assert resolve_python(["python", "t.py"], local=False) == \
             ["python", "t.py"]
 
+    def test_elastic_settings_carry_remote_python(self):
+        """--remote-python must reach the elastic driver's spawn path too
+        (round-3 advisor, low: the elastic {python} placeholder always
+        resolved to the default python3 on remote hosts)."""
+        from horovod_tpu.runner.elastic import ElasticSettings
+        args = parse_args(["-np", "2", "--min-np", "1",
+                           "--host-discovery-script", "./d.sh",
+                           "--remote-python", "/opt/py/bin/python3",
+                           "python", "train.py"])
+        settings = ElasticSettings(
+            min_np=args.min_np or args.num_proc,
+            max_np=args.max_np or args.num_proc,
+            remote_python=args.remote_python)
+        assert settings.remote_python == "/opt/py/bin/python3"
+
 
 class TestDuplicateHosts:
     def test_repeated_hostname_merged(self):
