@@ -326,41 +326,22 @@ def hierarchical_compressed_allreduce_p(
         raise ValueError(
             f"hierarchical_compressed_allreduce_p supports Sum/Average "
             f"only, got {op!r}")
-    n_inner = lax.axis_size(inner_axis)
-    total = n_inner * lax.axis_size(outer_axis)
-    if C._dp_invariant(x, inner_axis) and C._dp_invariant(x, outer_axis):
-        # Already reduced over the mesh (autodiff-psummed gradients of
-        # replicated params): normalization-only, matching allreduce_p /
-        # hierarchical_allreduce_p's invariant semantics. There is nothing
-        # to compress (no bytes would move), so the residual is untouched.
-        y = (x.astype(jnp.float32) / total).astype(x.dtype) \
-            if op == C.ReduceOp.AVERAGE else x
-        return (y, residual) if residual is not None else y
-    orig_shape, orig_dtype = x.shape, x.dtype
-    flat = x.reshape(-1)
-    pad = (-flat.shape[0]) % n_inner
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    # reducescatter_p (not raw psum_scatter): handles an input already
-    # reduced over the inner axis with consistent semantics.
-    shard = C.reducescatter_p(flat, op=C.ReduceOp.SUM, axis=inner_axis)
-    if C._dp_invariant(shard, outer_axis):
-        # Input was already reduced over the outer axis: the compressed
-        # exchange would gather n_outer identical copies and re-sum them
-        # (n_outer-times-too-large). Nothing crosses the slow fabric;
-        # the residual is untouched.
-        out, new_res = shard, residual
-    else:
-        out, new_res = _REDUCERS[reduction](shard, compressor,
-                                            axis=outer_axis,
-                                            residual=residual, key=key)
-    full = C.allgather_p(out, axis=inner_axis)
-    if pad:
-        full = full[:-pad]
-    y = full.reshape(orig_shape)
+    def outer_hop(shard):
+        # The compressed exchange IS the slow-fabric hop; the shared frame
+        # (collectives._hierarchical_sum_frame) owns every flatten/pad/vma
+        # invariance rule, so dense and compressed cannot drift apart.
+        return _REDUCERS[reduction](shard, compressor, axis=outer_axis,
+                                    residual=residual, key=key)
+
+    y, new_res = C._hierarchical_sum_frame(x, inner_axis, outer_axis,
+                                           outer_hop)
+    if new_res is None:
+        # Hop skipped (input already reduced over the outer axis or both):
+        # no bytes moved, so the error-feedback residual is untouched.
+        new_res = residual
     if op == C.ReduceOp.AVERAGE:
-        y = (y.astype(jnp.float32) / total)
-    y = y.astype(orig_dtype)
+        total = lax.axis_size(inner_axis) * lax.axis_size(outer_axis)
+        y = (y.astype(jnp.float32) / total).astype(x.dtype)
     return (y, new_res) if residual is not None else y
 
 

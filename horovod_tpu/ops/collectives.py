@@ -312,6 +312,38 @@ def ppermute_p(x, perm: Sequence[tuple], axis: Optional[str] = None):
     return lax.ppermute(x, _resolve_axis(axis), perm=perm)
 
 
+def _hierarchical_sum_frame(x, inner_axis: str, outer_axis: str, outer_hop):
+    """Shared flatten/pad/vma frame for sum-based hierarchical reductions
+    (dense and compressed share every subtle invariance rule here, so a
+    semantics fix lands in both at once).
+
+    ``outer_hop(shard) -> (reduced_shard, aux)`` performs the slow-fabric
+    hop on the inner-reduce-scattered shard. Returns ``(global_sum, aux)``
+    with the sum shaped/dtyped like ``x``; ``aux`` is None whenever the hop
+    was SKIPPED — input already reduced over both axes (returned as-is) or
+    over the outer axis only (re-running the hop would re-sum it).
+    """
+    n_inner = lax.axis_size(inner_axis)
+    if _dp_invariant(x, inner_axis) and _dp_invariant(x, outer_axis):
+        return x, None  # already globally reduced: nothing to move
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n_inner
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # reducescatter_p (not raw psum_scatter): handles an input already
+    # reduced over the inner axis with consistent semantics.
+    shard = reducescatter_p(flat, op=ReduceOp.SUM, axis=inner_axis)
+    if _dp_invariant(shard, outer_axis):
+        aux = None  # outer hop would gather n_outer identical copies
+    else:
+        shard, aux = outer_hop(shard)
+    full = allgather_p(shard, axis=inner_axis)
+    if pad:
+        full = full[:-pad]
+    return full.reshape(orig_shape).astype(orig_dtype), aux
+
+
 def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
                              inner_axis: str = None, outer_axis: str = None,
                              prescale_factor: float = 1.0,
@@ -355,36 +387,15 @@ def hierarchical_allreduce_p(x, op: ReduceOp = ReduceOp.SUM,
                         op=op, axis=outer_axis)
         return _apply_scale(y, postscale_factor)
 
-    n_inner = lax.axis_size(inner_axis)
-    total = n_inner * lax.axis_size(outer_axis)
-    orig_shape, orig_dtype = x.shape, x.dtype
+    def outer_hop(shard):
+        if op == ReduceOp.ADASUM:
+            from ..parallel.adasum import adasum_p
+            return adasum_p(shard, axis=outer_axis), None
+        return allreduce_p(shard, op=ReduceOp.SUM, axis=outer_axis), None
 
-    # Flatten + pad so dim 0 splits evenly across the inner axis (reference:
-    # the NCCL path reduces the local_size-divisible chunk hierarchically and
-    # broadcasts the remainder; padding is the compiled-friendly equivalent).
-    flat = x.reshape(-1)
-    pad = (-flat.shape[0]) % n_inner
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-
-    # reducescatter_p / allreduce_p (not raw psum_scatter/psum): they
-    # handle per-axis invariance, so an input already reduced over ONE of
-    # the two axes still comes out with allreduce_p-consistent semantics.
-    shard = reducescatter_p(flat, op=ReduceOp.SUM, axis=inner_axis)
-    if op == ReduceOp.ADASUM:
-        from ..parallel.adasum import adasum_p
-        shard = adasum_p(shard, axis=outer_axis)
-    else:
-        shard = allreduce_p(shard, op=ReduceOp.SUM, axis=outer_axis)
-    # allgather_p lowers to a true all-gather with provably-replicated
-    # output (all_gather_invariant), so this leg costs gather-wire bytes,
-    # not the old masked-psum's 2x.
-    full = allgather_p(shard, axis=inner_axis)
-
-    if pad:
-        full = full[:-pad]
-    y = full.reshape(orig_shape).astype(orig_dtype)
+    y, _ = _hierarchical_sum_frame(x, inner_axis, outer_axis, outer_hop)
     if op == ReduceOp.AVERAGE:
+        total = lax.axis_size(inner_axis) * lax.axis_size(outer_axis)
         y = _apply_scale(y, 1.0 / total)
     return _apply_scale(y, postscale_factor)
 

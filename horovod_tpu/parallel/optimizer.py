@@ -67,33 +67,87 @@ def allreduce_gradients(grads, op: C.ReduceOp = C.ReduceOp.AVERAGE,
         if choose_hierarchical(inner, outer, nbytes):
             hierarchical = (inner, outer)
         else:
-            # Flat: ONE fused all-reduce over both axes — the same program
-            # the calibration's flat arm timed.
+            # Flat: the fused two-axis all-reduce — the same fused-buffer
+            # grouping as the hierarchical arm, so the runtime program
+            # matches what the calibration's single-buffer flat arm timed.
             if not C.in_named_trace(inner):
                 raise ValueError(
                     "hierarchical allreduce is in-step only: call inside "
                     "run_step/shard_map over a mesh with both axes")
-            return jax.tree.map(
-                lambda g: C.allreduce_p(
-                    g, op=op, axis=(inner, outer),
-                    prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor),
-                grads)
+            return _fused_two_axis_allreduce(grads, op, inner, outer,
+                                             prescale_factor,
+                                             postscale_factor, flat=True)
     if hierarchical is not None:
         if not C.in_named_trace(hierarchical[0]):
             raise ValueError(
                 "hierarchical allreduce is in-step only: call inside "
                 "run_step/shard_map over a mesh with both axes")
         inner, outer = hierarchical
-        return jax.tree.map(
-            lambda g: C.hierarchical_allreduce_p(
-                g, op=op, inner_axis=inner, outer_axis=outer,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor), grads)
+        return _fused_two_axis_allreduce(grads, op, inner, outer,
+                                         prescale_factor,
+                                         postscale_factor)
     return C.grouped_allreduce(grads, name="grads", op=op,
                                compression=compression,
                                prescale_factor=prescale_factor,
                                postscale_factor=postscale_factor, axis=axis)
+
+
+def _fused_two_axis_allreduce(grads, op, inner: str, outer: str,
+                              prescale: float, postscale: float,
+                              flat: bool = False):
+    """One two-axis reduction per (dtype, vma-signature) group instead of
+    one per leaf — for the hierarchical path and (``flat=True``) the
+    calibrated-flat path, so the auto choice always dispatches the same
+    fused-buffer program shape the calibration timed.
+
+    Reference: ``FuseResponses`` (``controller.cc:686``) fuses co-negotiated
+    same-dtype tensors into a single buffer so one collective moves them all
+    — here the flattened group buffer crosses the fabric in one volley per
+    group. Leaves are grouped by dtype (no silent upcasts) AND by per-axis
+    vma invariance: fusing an already-reduced (invariant) leaf with varying
+    ones would re-sum it. MIN/MAX/PRODUCT/ADASUM fall back to per-leaf
+    (no flattened fused form).
+    """
+    def reduce_buffer(buf, inv_inner, inv_outer):
+        if not flat:
+            return C.hierarchical_allreduce_p(
+                buf, op=op, inner_axis=inner, outer_axis=outer,
+                prescale_factor=prescale, postscale_factor=postscale)
+        if not inv_inner and not inv_outer:
+            # Fully varying: one fused all-reduce over both axes.
+            return C.allreduce_p(buf, op=op, axis=(inner, outer),
+                                 prescale_factor=prescale,
+                                 postscale_factor=postscale)
+        # Partially/fully invariant: sequential per-axis allreduce_p — each
+        # leg handles its own axis's invariance (a tuple-axis psum would
+        # re-sum the already-reduced direction).
+        return C.allreduce_p(
+            C.allreduce_p(buf, op=op, axis=inner,
+                          prescale_factor=prescale),
+            op=op, axis=outer, postscale_factor=postscale)
+
+    leaves, treedef = jax.tree.flatten(grads)
+    if op not in (C.ReduceOp.SUM, C.ReduceOp.AVERAGE) or len(leaves) <= 1:
+        outs = [reduce_buffer(g, C._dp_invariant(g, inner),
+                              C._dp_invariant(g, outer)) for g in leaves]
+        return jax.tree.unflatten(treedef, outs)
+
+    groups = {}
+    for i, leaf in enumerate(leaves):
+        key = (str(leaf.dtype), C._dp_invariant(leaf, inner),
+               C._dp_invariant(leaf, outer))
+        groups.setdefault(key, []).append(i)
+    outs = [None] * len(leaves)
+    for (_, inv_inner, inv_outer), idxs in groups.items():
+        buf = jnp.concatenate([leaves[i].reshape(-1) for i in idxs]) \
+            if len(idxs) > 1 else leaves[idxs[0]].reshape(-1)
+        red = reduce_buffer(buf, inv_inner, inv_outer)
+        off = 0
+        for i in idxs:
+            size = leaves[i].size
+            outs[i] = red[off:off + size].reshape(leaves[i].shape)
+            off += size
+    return jax.tree.unflatten(treedef, outs)
 
 
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
@@ -177,6 +231,14 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             if hierarchical is not None:
                 # World size spans BOTH mesh axes on the hierarchical path.
                 h_inner, h_outer = hierarchical[-2], hierarchical[-1]
+                if not C.in_named_trace(h_inner):
+                    # Same clear error the predivide==1.0 path gets from
+                    # allreduce_gradients, instead of an opaque unbound-
+                    # axis failure from size_in_step.
+                    raise ValueError(
+                        "hierarchical allreduce is in-step only: call "
+                        "inside run_step/shard_map over a mesh with both "
+                        "axes")
                 n = C.size_in_step(h_inner) * C.size_in_step(h_outer)
             else:
                 n = C.size_in_step(axis) if C.in_named_trace(axis) \
@@ -337,11 +399,13 @@ class DistributedGradientTape:
     function so returned gradients are allreduced."""
 
     def __init__(self, grad_fn, op: C.ReduceOp = C.ReduceOp.AVERAGE,
-                 compression=None, axis: Optional[str] = None):
+                 compression=None, axis: Optional[str] = None,
+                 hierarchical: Optional[Tuple] = None):
         self._grad_fn = grad_fn
         self._op = op
         self._compression = compression
         self._axis = axis
+        self._hierarchical = hierarchical
 
     def __call__(self, *args, **kwargs):
         out = self._grad_fn(*args, **kwargs)
@@ -350,7 +414,8 @@ class DistributedGradientTape:
             value, grads = out
             return value, allreduce_gradients(
                 grads, op=self._op, compression=self._compression,
-                axis=self._axis)
+                axis=self._axis, hierarchical=self._hierarchical)
         return allreduce_gradients(out, op=self._op,
                                    compression=self._compression,
-                                   axis=self._axis)
+                                   axis=self._axis,
+                                   hierarchical=self._hierarchical)

@@ -322,6 +322,60 @@ def test_optimizer_hierarchical_invariant_grads(mesh42):
                                  compression=MaxMinQuantizer(bits=4))
 
 
+def test_optimizer_hierarchical_predivide_eager_raises(mesh42):
+    """hierarchical + gradient_predivide_factor outside a trace must give
+    the clear in-step-only error, not an unbound-axis failure."""
+    import optax
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                   hierarchical=("ici", "dcn"),
+                                   gradient_predivide_factor=2.0)
+    state = opt.init({"w": jnp.ones(3)})
+    with pytest.raises(ValueError, match="in-step only"):
+        opt.update({"w": jnp.ones(3)}, state, {"w": jnp.ones(3)})
+
+
+def test_fused_hierarchical_group_reduction(mesh42, monkeypatch):
+    """allreduce_gradients(hierarchical=...) fuses same-dtype same-vma
+    leaves into ONE hierarchical reduction per group (reference:
+    FuseResponses, controller.cc:686) and stays numerically equal to the
+    per-leaf result."""
+    from horovod_tpu.ops import collectives as C
+
+    calls = []
+    real = C.hierarchical_allreduce_p
+
+    def counting(x, **kw):
+        calls.append(x.shape)
+        return real(x, **kw)
+
+    monkeypatch.setattr(C, "hierarchical_allreduce_p", counting)
+    vals = _per_rank_values((4,), seed=41)
+
+    def body(x):
+        grads = {"a": x, "b": 2.0 * x,            # f32 varying group
+                 "c": x.astype(jnp.bfloat16),     # bf16 varying group
+                 "s": x[0]}                       # f32 varying scalar
+        return hvd.allreduce_gradients(grads, op=hvd.Average,
+                                       hierarchical=("ici", "dcn"))
+
+    step = hvd.run_step(body, in_specs=P(("dcn", "ici")),
+                        out_specs=hvd.REPLICATED)
+    out = step(jnp.asarray(vals.reshape(-1)))
+    # Two groups -> two hierarchical reductions, not four.
+    assert len(calls) == 2, calls
+    expect = vals.mean(axis=0)
+    np.testing.assert_allclose(np.asarray(out["a"]), expect, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["b"]), 2 * expect, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["c"]),
+                               expect.astype(np.float32), rtol=2e-2,
+                               atol=2e-2)
+    np.testing.assert_allclose(np.asarray(out["s"]), expect[0], rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_hierarchical_allgather_via_public_api(mesh42):
     """hvd.allgather(hierarchical=...) routes in-step; eager raises."""
     vals = _per_rank_values((2, 4), seed=17)
