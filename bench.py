@@ -414,6 +414,44 @@ def _compression_ab(jax, jnp):
     }
 
 
+def _attention_kernel_bench(jax, jnp):
+    """Fused (flash) Pallas attention vs plain softmax attention, fwd+bwd at
+    a real long-context shape — the kernel's on-chip evidence (and its
+    Mosaic-lowering validation, which interpret-mode tests cannot give)."""
+    from horovod_tpu.models.transformer import default_attention
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    B = int(os.environ.get("HVDTPU_BENCH_ATTN_BATCH", 4))
+    S = int(os.environ.get("HVDTPU_BENCH_ATTN_SEQ", 2048))
+    H, D = 8, 64
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.bfloat16) * 0.5
+               for kk in ks)
+
+    out = []
+    for name, fn in (("attention_dense", default_attention),
+                     ("attention_flash", flash_attention)):
+        try:
+            step = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    fn(q, k, v, causal=True).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2)))  # all three grads: identical backward
+            # work for both paths (dense XLA would otherwise DCE dK/dV)
+            _fence(jax, step(q, k, v))  # compile + warm
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                g = step(q, k, v)
+            _fence(jax, g)
+            out.append({"op": name, "shape": f"B{B} S{S} H{H} D{D} bf16",
+                        "fwd_bwd_ms": round(
+                            (time.perf_counter() - t0) / reps * 1e3, 3)})
+        except Exception as exc:
+            out.append({"op": name,
+                        "error": f"{type(exc).__name__}: {str(exc)[:160]}"})
+    return out
+
+
 def _resnet101_bench(jax, jnp):
     """ResNet-101 bs=64 — the EXACT model/batch of the reference's absolute
     throughput row (tf_cnn_benchmarks resnet101 bs=64, ~1656.82 img/s on 16
@@ -499,10 +537,15 @@ def _gpt_bench(jax, jnp, long_context: bool = False):
 
     layers = int(os.environ.get("HVDTPU_BENCH_GPT_LAYERS", 6))
     embed = int(os.environ.get("HVDTPU_BENCH_GPT_EMBED", 512))
+    # "flash" switches to the fused Pallas attention kernel
+    # (ops/flash_attention.py); default stays dense until the kernel has
+    # Mosaic-lowered on a real chip (interpret-mode tests cannot prove
+    # that — the quantize kernels' round-2 lesson).
+    attn = os.environ.get("HVDTPU_BENCH_GPT_ATTN", "dense")
     cfg = gpt.GPTConfig(vocab_size=32000, num_layers=layers, num_heads=8,
                         head_dim=embed // 8, embed_dim=embed,
                         mlp_dim=4 * embed, dtype=jnp.bfloat16, tp_axis=None,
-                        sp_axis=None, attention="dense",
+                        sp_axis=None, attention=attn,
                         remat="full" if long_context else "none")
     B = int(os.environ.get("HVDTPU_BENCH_GPT_BATCH", 8))
     S = int(os.environ.get("HVDTPU_BENCH_GPT_SEQ", 1024))
@@ -559,7 +602,9 @@ def _gpt_bench(jax, jnp, long_context: bool = False):
     mfu = round(6.0 * n_params * tok_s / peak, 4) if peak else None
     entry = {"model": f"GPT {n_params / 1e6:.0f}M (L{cfg.num_layers} "
                       f"d{cfg.embed_dim} seq {S} bs {B}"
-                      + (" remat=full" if long_context else "") + ")",
+                      + (" remat=full" if long_context else "")
+                      + (f" attn={cfg.attention}"
+                         if cfg.attention != "dense" else "") + ")",
              "tokens_per_sec_per_chip": round(tok_s, 1), "mfu": mfu,
              "inner_steps_per_dispatch": INNER_STEPS}
     if mfu is not None and mfu > 1.0:
@@ -737,6 +782,7 @@ def _run():
                                       f"{str(exc)[:200]}"}
 
     guarded("compression_ab", lambda: _compression_ab(jax, jnp))
+    guarded("attention_kernels", lambda: _attention_kernel_bench(jax, jnp))
     guarded("gpt", lambda: _gpt_bench(jax, jnp))
     # ResNet-101: the reference's exact absolute-throughput model. Heavy
     # compile (~60-90 s on chip) — run only with watchdog headroom.

@@ -49,6 +49,8 @@ from .parallel.ring_attention import (ring_attention, ring_attention_p,
                                       make_ring_attention)
 from .parallel.ulysses import (ulysses_attention, ulysses_attention_p,
                                make_ulysses_attention)
+# Fused (flash) causal attention Pallas kernel (TPU-first extension).
+from .ops.flash_attention import flash_attention
 
 # Compression (reference: horovod/torch/compression.py + IST fork subsystem).
 from .compression import Compression

@@ -52,7 +52,7 @@ class GPTConfig:
     tp_axis: Optional[str] = "tp"
     sp_axis: Optional[str] = "sp"
     ep_axis: Optional[str] = None
-    attention: str = "ring"                  # "ring" | "ulysses" | "dense"
+    attention: str = "ring"         # "ring" | "ulysses" | "dense" | "flash"
     # MoE (active when moe_every > 0): every moe_every-th block is a switch
     # layer with num_experts experts.
     moe_every: int = 0
@@ -169,8 +169,19 @@ def _tp_psum(x, cfg: GPTConfig):
 
 def _attention(cfg: GPTConfig, q, k, v):
     """Dispatch to the configured context-parallel attention. Falls back to
-    dense attention when the sp axis is not bound (single-device parity)."""
+    dense attention when the sp axis is not bound (single-device parity).
+    ``attention="flash"`` uses the fused Pallas kernel
+    (:mod:`horovod_tpu.ops.flash_attention`) — no S x S logits tensor in
+    HBM; local (non-sp) attention only."""
     sp = cfg.sp_axis
+    if cfg.attention == "flash":
+        if _axis_bound(sp):
+            raise ValueError(
+                "attention='flash' is local attention; with a bound sp "
+                "axis use 'ring' or 'ulysses' (their per-device blocks "
+                "can adopt the flash kernel internally)")
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
     if not _axis_bound(sp) or cfg.attention == "dense":
         return default_attention(q, k, v, causal=True)
     if cfg.attention == "ring":

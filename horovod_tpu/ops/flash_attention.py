@@ -1,0 +1,307 @@
+"""Fused (flash) causal attention — Pallas TPU kernels.
+
+The reference is a collective-communication library and ships no attention
+kernels; this is a TPU-first extension for the GPT / long-context path
+(SURVEY.md §2.7: long-context is in scope for the rebuild). Plain attention
+(``models/transformer.py default_attention``) materializes the full
+``[B, H, S, S]`` fp32 logits tensor in HBM — at S=4096 that is ~2 GB per
+layer per pass, which is exactly the HBM-bandwidth wall flash attention
+exists to avoid. Algorithm: FlashAttention online-softmax tiling
+(arXiv:2205.14135), with the standard recompute-from-logsumexp backward.
+
+Design notes (TPU):
+
+* Layout ``[B*H, S, D]``. Each kernel walks a 3-D grid whose innermost
+  dimension streams the contraction blocks: the forward visits
+  ``(bh, q_block, k_block)`` so only ONE ``BLOCK x D`` slab of K and V is
+  DMA'd into VMEM per step, with the online-softmax state (running max,
+  denominator, output accumulator) carried across k-steps in VMEM scratch
+  and written on the final visit — VMEM use is O(BLOCK x D) regardless of
+  sequence length, not O(S x D).
+* All matmuls accumulate in fp32 (``preferred_element_type``) on the MXU.
+* Causal-only: off-diagonal upper blocks are predicated out (``pl.when``),
+  and tail-padding to the 128-row block is free (a real query row never
+  attends a key beyond itself), so any sequence length works.
+* Backward = two kernels, same streaming structure: dKdV walks
+  ``(bh, k_block, q_block)``, dQ walks ``(bh, q_block, k_block)``, each
+  recomputing the probability tile from q, k and the saved row logsumexp —
+  no S x S tensor is ever materialized in either direction.
+* Gate: compiled on TPU backends, ``interpret=True`` elsewhere — the same
+  policy as the quantize kernels (``compression/quantize.py``
+  ``_pallas_backend_enabled``). NOTE interpret mode does not validate
+  Mosaic lowering — keep ``attention="dense"`` in anything driver-critical
+  until the kernel has run on a real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where NaN-free
+
+
+def _use_interpret() -> bool:
+    # Same gate as the quantize kernels: compiled only on TPU backends;
+    # everything else (cpu tests, gpu) runs the interpreter.
+    from ..compression.quantize import _pallas_backend_enabled
+    return not _pallas_backend_enabled(None)
+
+
+def _causal_mask(s, q_block, k_block):
+    """Mask logits tile ``s`` [BLOCK_Q, BLOCK_K] for causality: query block
+    index ``q_block``, key block index ``k_block`` (global positions)."""
+    q_pos = q_block * BLOCK_Q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0)
+    k_pos = k_block * BLOCK_K + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, sm_scale: float, n_k_blocks: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kj <= qi)  # causal: upper-triangle blocks contribute nothing
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)                 # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _causal_mask(s, qi, kj)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_k_blocks - 1)
+    def _finish():
+        l = l_scr[:]
+        safe_l = jnp.where(l == 0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(safe_l))[:, 0]
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale: float,
+                 n_q_blocks: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= kj)  # causal: earlier query blocks never see these keys
+    def _step():
+        k = k_ref[0].astype(jnp.float32)                 # [BK, D]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [BQ, D]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _causal_mask(s, qi, kj)
+        p = jnp.exp(s - lse)                             # [BQ, BK]
+        # dv += p^T @ dO
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dk += ds^T @ q  (q already carries sm_scale)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, sm_scale: float, n_k_blocks: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(kj <= qi)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = _causal_mask(s, qi, kj)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _pad_seq(x, block):
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _fwd_call(q, k, v, sm_scale, interpret):
+    """q/k/v: [BH, S, D] (S already padded). Returns (o, lse)."""
+    bh, s, d = q.shape
+    n_q = s // BLOCK_Q
+    n_k = s // BLOCK_K
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               n_k_blocks=n_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),   # running max
+            pltpu.VMEM((BLOCK_Q, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((BLOCK_Q, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_bhsd(q, k, v, sm_scale):
+    o, _ = _fwd_call(q, k, v, sm_scale, _use_interpret())
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, sm_scale):
+    o, lse = _fwd_call(q, k, v, sm_scale, _use_interpret())
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bhsd_bwd(sm_scale, res, do):
+    q, k, v, o, lse = res
+    interpret = _use_interpret()
+    bh, s, d = q.shape
+    n_q = s // BLOCK_Q
+    n_k = s // BLOCK_K
+    # delta_i = rowsum(dO_i * O_i) — tiny elementwise pass, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+
+    dkdv = functools.partial(_dkdv_kernel, sm_scale=sm_scale,
+                             n_q_blocks=n_q)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, j, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),  # k
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),  # v
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, j, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, BLOCK_Q), lambda b, j, i: (b, i)),       # lse
+            pl.BlockSpec((1, BLOCK_Q), lambda b, j, i: (b, i)),       # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_K, d), jnp.float32),
+            pltpu.VMEM((BLOCK_K, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dqk = functools.partial(_dq_kernel, sm_scale=sm_scale, n_k_blocks=n_k)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),  # q
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),  # do
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),       # lse
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),       # delta
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((BLOCK_Q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    """Fused causal attention. q/k/v: ``[B, S, H, D]`` (the layout the GPT
+    blocks use); differentiable (custom VJP, flash backward). Only
+    ``causal=True`` is supported — the causal structure is also what makes
+    tail-padding to the 128-row block size free.
+    """
+    if not causal:
+        raise NotImplementedError(
+            "flash_attention is causal-only; use default_attention for "
+            "bidirectional attention")
+    b, s, h, d = q.shape
+    sm_scale = 1.0 / float(np.sqrt(d))
+
+    def to_bhsd(x):
+        return _pad_seq(x.transpose(0, 2, 1, 3).reshape(b * h, s, d),
+                        BLOCK_Q)
+
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale)
+    return o[:, :s, :].reshape(b, h, s, d).transpose(0, 2, 1, 3)
