@@ -17,6 +17,15 @@ where hierarchical wins — only 1/n_inner of the bytes cross the slow fabric
 The measurement hook is injectable so the decision logic is testable against
 a bandwidth model without real multi-fabric hardware (the same reason the
 reference unit-tests its parameter manager against synthetic scores).
+
+Why only allreduce (the reference also tunes ``hierarchical_allgather``):
+for allreduce both programs genuinely exist (one fused two-axis psum vs
+reduce-scatter/psum/allgather). For allgather the "flat" single collective
+over both axes has no VMA-provably-replicated lowering
+(``all_gather_invariant`` takes a single axis), so the two-stage ICI-then-
+DCN gather (:func:`~horovod_tpu.ops.collectives.hierarchical_allgather_p`)
+is the only compiled form — the categorical is structurally resolved, not
+tuned. ``docs/parity.md`` records the same rationale.
 """
 
 from __future__ import annotations
