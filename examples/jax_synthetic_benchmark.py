@@ -1,9 +1,11 @@
-"""Synthetic ResNet-50 throughput benchmark.
+"""Synthetic ResNet throughput benchmark.
 
 Reference: ``examples/tensorflow2_synthetic_benchmark.py`` /
 ``examples/pytorch_synthetic_benchmark.py`` — random data, fwd+bwd+step,
 images/sec, with the fp16-allreduce knob (here bf16 end-to-end is the
-TPU-native default; ``--fp32`` opts out).
+TPU-native default; ``--fp32`` opts out). ``--model resnet101`` matches the
+reference's published absolute-throughput row (tf_cnn_benchmarks resnet101
+bs=64); ``--image-size`` shrinks the input for CPU smokes.
 
     python examples/jax_synthetic_benchmark.py --batch-size 32 --num-iters 20
 """
@@ -17,12 +19,17 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
+from horovod_tpu.models import ResNet18, ResNet34, ResNet50, ResNet101
+
+MODELS = {"resnet18": ResNet18, "resnet34": ResNet34,
+          "resnet50": ResNet50, "resnet101": ResNet101}
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="resnet50", choices=["resnet50"])
+    parser.add_argument("--model", default="resnet50",
+                        choices=sorted(MODELS))
+    parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--batch-size", type=int, default=32,
                         help="per-chip batch size")
     parser.add_argument("--num-warmup-batches", type=int, default=5)
@@ -34,10 +41,11 @@ def main():
     hvd.init()
     dtype = jnp.float32 if args.fp32 else jnp.bfloat16
     n = hvd.size()
-    model = ResNet50(num_classes=1000, dtype=dtype)
+    model = MODELS[args.model](num_classes=1000, dtype=dtype)
     rng = jax.random.PRNGKey(0)
     batch = args.batch_size * n
-    images = jax.random.normal(rng, (batch, 224, 224, 3), dtype)
+    images = jax.random.normal(
+        rng, (batch, args.image_size, args.image_size, 3), dtype)
     labels = jax.random.randint(rng, (batch,), 0, 1000)
 
     variables = model.init(rng, images[:1], train=True)
@@ -86,7 +94,7 @@ def main():
 
     if hvd.rank() == 0:
         ips = batch * args.num_iters / dt
-        print(f"Total img/sec on {n} device(s): {ips:.1f} "
+        print(f"{args.model}: total img/sec on {n} device(s): {ips:.1f} "
               f"({ips / n:.1f} per device)")
     hvd.shutdown()
 

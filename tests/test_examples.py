@@ -46,10 +46,12 @@ def _run_example(name, args, timeout=420):
     ("estimator_parquet.py", ["--epochs", "2"], None),
     ("hierarchical_cross_slice.py", ["--steps", "2"],
      "hierarchical cross-slice training ok"),
-    # Not smoked here: jax_synthetic_benchmark.py is hard-wired to 224x224
-    # ResNet-50 (bench.py's CPU drive covers the path); elastic_train.py
-    # needs the elastic driver (test_elastic.py covers it); ray_mnist.py
-    # needs a ray install (gating covered in test_integrations.py).
+    ("jax_synthetic_benchmark.py",
+     ["--model", "resnet18", "--batch-size", "2", "--image-size", "32",
+      "--num-warmup-batches", "1", "--num-iters", "2"], "img/sec"),
+    # Not smoked here: elastic_train.py needs the elastic driver
+    # (test_elastic.py covers it); ray_mnist.py needs a ray install
+    # (gating covered in test_integrations.py).
 ])
 def test_example_smokes(name, args, expect):
     out = _run_example(name, args)
