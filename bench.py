@@ -807,11 +807,14 @@ def _run():
     # attention_kernels A/B proved the flash kernel COMPILED on this
     # backend — interpret-mode success (any non-TPU backend) proves
     # nothing about Mosaic lowering and would crawl at 4k tokens.
-    from horovod_tpu.ops.flash_attention import _use_interpret
-    ak = _partial.get("attention_kernels") or []
-    flash_ok = (not _use_interpret()) and any(
-        isinstance(e, dict) and e.get("op") == "attention_flash"
-        and "fwd_bwd_ms" in e for e in ak)
+    try:
+        from horovod_tpu.ops.flash_attention import _use_interpret
+        ak = _partial.get("attention_kernels") or []
+        flash_ok = (not _use_interpret()) and any(
+            isinstance(e, dict) and e.get("op") == "attention_flash"
+            and "fwd_bwd_ms" in e for e in ak)
+    except Exception:  # the gate must never cost the completed phases
+        flash_ok = False
     if not flash_ok:
         _partial["gpt_long_context_flash"] = {
             "skipped": "flash kernel not compiled-validated on this "

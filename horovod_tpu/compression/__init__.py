@@ -87,4 +87,6 @@ from .error_feedback import (init_error_feedback,  # noqa: E402
 from .reducers import (compressed_allreduce,  # noqa: E402
                        compressed_grouped_allreduce,
                        hierarchical_compressed_allreduce_p)
+from .powersgd import (PowerSGDState, powersgd_init,  # noqa: E402
+                       powersgd_allreduce_p)
 from .config import CompressionConfig, make_compressor, from_env  # noqa: E402

@@ -1,0 +1,134 @@
+"""PowerSGD: low-rank gradient compression (beyond-reference extension).
+
+The IST fork compresses gradients element-wise (quantization / top-k,
+SURVEY.md §2.3); PowerSGD (Vogels et al., arXiv:1905.13727) is the other
+major practical family — rank-r factorization ``M ~= P @ Q^T`` with error
+feedback and warm-started factors. It is a natural fit for TPU: the
+compress/decompress work is two tall-skinny matmuls per tensor (MXU), and
+the wire cost drops from ``n*m`` to ``r*(n+m)`` per matrix.
+
+Algorithm per 2-D (reshaped) gradient M, with persistent factor Q and
+error-feedback residual E (both functional state, like the quantizers'
+residuals):
+
+1. ``M += E``                          (apply error feedback)
+2. ``P = M @ Q``; **allreduce-mean P**; orthonormalize P (Gram-Schmidt)
+3. ``Q = M^T @ P``; **allreduce-mean Q**
+4. ``approx = P @ Q^T``; ``E = M - approx``  (new residual)
+
+The two allreduces move the factors, not the gradient — that is the whole
+point. The result ``approx`` is identical on every rank (both factors are
+reduced), so the optimizer sees a replicated update like a dense allreduce.
+Non-matrix leaves (ndim < 2) are reduced densely — their wire cost is
+negligible, matching the standard PowerSGD practice and the reference
+fork's per-layer "ignore" configs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import runtime
+from ..ops import collectives as C
+
+
+class PowerSGDState(NamedTuple):
+    """Functional per-leaf state: warm-start factors and EF residuals.
+
+    ``qs``/``errors`` are tuples aligned with the flattened gradient leaves;
+    dense-path leaves hold ``None`` factors and zero-size residuals.
+    """
+    qs: tuple
+    errors: tuple
+
+
+def _as_matrix(x):
+    """Collapse leading dims: [a, b, c, ...] -> [a, b*c*...] (the PowerSGD
+    reshape — first dim stays, the rest flatten)."""
+    return x.reshape(x.shape[0], -1)
+
+
+def _orthonormalize(p):
+    """Modified Gram-Schmidt over columns (the paper's choice — cheap at
+    rank r, numerically adequate because r is small)."""
+    cols = []
+    for i in range(p.shape[1]):
+        c = p[:, i]
+        for prev in cols:
+            c = c - jnp.dot(prev, c) * prev
+        c = c / jnp.maximum(jnp.linalg.norm(c), 1e-8)
+        cols.append(c)
+    return jnp.stack(cols, axis=1)
+
+
+def powersgd_init(grads, rank: int = 2, seed: int = 0) -> PowerSGDState:
+    """State for :func:`powersgd_allreduce_p`: random-normal warm-start Q
+    per matrix leaf (deterministic per leaf index so every rank starts with
+    the SAME factors — required for correctness), zero residuals."""
+    leaves = jax.tree.leaves(grads)
+    qs, errors = [], []
+    for i, leaf in enumerate(leaves):
+        if leaf.ndim >= 2:
+            m = _as_matrix(leaf)
+            r = min(rank, *m.shape)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+            qs.append(jax.random.normal(key, (m.shape[1], r), jnp.float32))
+            errors.append(jnp.zeros(m.shape, jnp.float32))
+        else:
+            qs.append(None)
+            errors.append(jnp.zeros((0,), jnp.float32))
+    return PowerSGDState(qs=tuple(qs), errors=tuple(errors))
+
+
+def powersgd_allreduce_p(grads, state: PowerSGDState,
+                         axis: Optional[str] = None,
+                         rank: int = 2):
+    """In-step PowerSGD-compressed gradient averaging over mesh axis
+    ``axis``. Returns ``(avg_tree, new_state)``; the average is replicated
+    across the axis (like a dense allreduce-mean), lossy at rank ``r`` with
+    the loss fed back through the residual.
+
+    ``rank`` must match the state built by :func:`powersgd_init`.
+    """
+    leaves, treedef = jax.tree.flatten(grads)
+    if len(leaves) != len(state.qs):
+        raise ValueError(
+            f"state built for {len(state.qs)} leaves, got {len(leaves)} — "
+            "rebuild with powersgd_init(grads, rank)")
+    for leaf, q in zip(leaves, state.qs):
+        if q is None:
+            continue
+        expect = min(rank, *_as_matrix(leaf).shape)
+        if q.shape[1] != expect:
+            raise ValueError(
+                f"rank={rank} does not match the state's factors "
+                f"(Q rank {q.shape[1]}) — pass the rank the state was "
+                "built with (powersgd_init)")
+    ax = axis if axis is not None else runtime.dp_axis()
+    n = lax.axis_size(ax)
+    outs, new_qs, new_errors = [], [], []
+    for leaf, q, err in zip(leaves, state.qs, state.errors):
+        if q is None:
+            # Dense path for vectors/scalars (negligible wire cost).
+            outs.append(C.allreduce_p(leaf, op=C.ReduceOp.AVERAGE, axis=ax))
+            new_qs.append(None)
+            new_errors.append(err)
+            continue
+        m = _as_matrix(leaf).astype(jnp.float32) + err
+        p = m @ q                                   # [a, r]
+        p = lax.psum(p, ax) / n                     # wire: a*r
+        p = _orthonormalize(p)
+        q_new = m.T @ p                             # [b, r]
+        q_new = lax.psum(q_new, ax) / n             # wire: b*r
+        approx = p @ q_new.T                        # replicated by construction
+        # approx is the rank-r approximation of mean(M); residual keeps
+        # THIS rank's lost component for the next step.
+        new_errors.append(m - approx)
+        new_qs.append(q_new)
+        outs.append(approx.reshape(leaf.shape).astype(leaf.dtype))
+    return (jax.tree.unflatten(treedef, outs),
+            PowerSGDState(qs=tuple(new_qs), errors=tuple(new_errors)))

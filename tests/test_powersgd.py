@@ -1,0 +1,143 @@
+"""PowerSGD low-rank compressed gradient averaging (compression/powersgd.py).
+
+Beyond-reference extension (arXiv:1905.13727): validated by its math —
+full-rank factorization reproduces the dense mean exactly, low rank + error
+feedback converges to it over steps, and non-matrix leaves ride the dense
+path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.compression.powersgd import (PowerSGDState,
+                                              powersgd_allreduce_p,
+                                              powersgd_init)
+
+
+@pytest.fixture
+def spmd8():
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def _per_rank_mats(a, b, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(8, a, b).astype(np.float32)
+
+
+def _stack_errors(state, n=8):
+    """Global view of the per-rank residuals: stacked on dim 0 (the sharded
+    in_specs dim)."""
+    return state._replace(errors=tuple(
+        jnp.zeros((n * e.shape[0],) + e.shape[1:], e.dtype) if e.size
+        else e for e in state.errors))
+
+
+def _run(vals, state, rank, steps=1):
+    """Drive `steps` iterations over an 8-way dp mesh; per-rank matrix
+    gradients come in sharded on dim 0, residual state round-trips sharded,
+    factors replicated."""
+    a, b = vals.shape[1:]
+    state = _stack_errors(state)
+    state_specs = PowerSGDState(
+        qs=tuple(P() for _ in state.qs),
+        errors=tuple(P("dp") if e.size else P() for e in state.errors))
+
+    def body(x, st):
+        grads = {"w": x}
+        out, st = powersgd_allreduce_p(grads, st, axis="dp", rank=rank)
+        return out["w"], st
+
+    step = hvd.run_step(body, in_specs=(P("dp"), state_specs),
+                        out_specs=(hvd.REPLICATED, state_specs))
+    outs = []
+    x = jnp.asarray(vals.reshape(-1, b))
+    for _ in range(steps):
+        out, state = step(x, state)
+        outs.append(np.asarray(out))
+    return outs, state
+
+
+def test_full_rank_is_exact(spmd8):
+    """rank >= min(a, b): P spans col(mean M), so P P^T mean(M) == mean(M)
+    — the compressed average equals the dense average."""
+    vals = _per_rank_mats(6, 4, seed=1)
+    state = powersgd_init({"w": jnp.zeros((6, 4))}, rank=4)
+    (out,), _ = _run(vals, state, rank=4)
+    np.testing.assert_allclose(out, vals.mean(axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_low_rank_error_feedback_converges(spmd8):
+    """rank-1 on constant per-rank gradients: sum_t approx_t telescopes to
+    k*mean - E_k with bounded E, so the running average approaches the
+    dense mean at a 1/k rate."""
+    vals = _per_rank_mats(5, 3, seed=2)
+    state = powersgd_init({"w": jnp.zeros((5, 3))}, rank=1)
+    outs, state = _run(vals, state, rank=1, steps=25)
+    mean = vals.mean(axis=0)
+    err_first = np.abs(outs[0] - mean).max()
+    running = np.mean(outs, axis=0)
+    err_running = np.abs(running - mean).max()
+    assert err_running < max(err_first / 3, 5e-3), \
+        (err_first, err_running)
+
+
+def test_factors_replicated_and_warm_started(spmd8):
+    """Q factors come back identical across ranks (they were psummed) and
+    change between steps (warm start actually updates)."""
+    vals = _per_rank_mats(4, 4, seed=3)
+    state0 = powersgd_init({"w": jnp.zeros((4, 4))}, rank=2)
+    _, state1 = _run(vals, state0, rank=2)
+    q0, q1 = np.asarray(state0.qs[0]), np.asarray(state1.qs[0])
+    assert q1.shape == q0.shape
+    assert not np.allclose(q0, q1)
+
+
+def test_vector_leaves_ride_dense_path(spmd8):
+    """1-D leaves are averaged exactly (no factorization), mixed with a
+    compressed matrix leaf in one pytree."""
+    rng = np.random.RandomState(4)
+    mats = rng.randn(8, 4, 4).astype(np.float32)
+    vecs = rng.randn(8, 6).astype(np.float32)
+    state = _stack_errors(powersgd_init(
+        {"b": jnp.zeros((6,)), "w": jnp.zeros((4, 4))}, rank=4))
+    state_specs = PowerSGDState(
+        qs=tuple(P() for _ in state.qs),
+        errors=tuple(P("dp") if e.size else P() for e in state.errors))
+
+    def body(xm, xv, st):
+        out, st = powersgd_allreduce_p({"b": xv, "w": xm}, st, axis="dp",
+                                       rank=4)
+        return out["b"], out["w"], st
+
+    step = hvd.run_step(body, in_specs=(P("dp"), P("dp"), state_specs),
+                        out_specs=(hvd.REPLICATED, hvd.REPLICATED,
+                                   state_specs))
+    out_b, out_w, _ = step(jnp.asarray(mats.reshape(-1, 4)),
+                           jnp.asarray(vecs.reshape(-1)), state)
+    # The vector comes back flattened per-shard semantics: [8,6] sharded on
+    # dim 0 means each rank held 6 elems of a 48-vector; its dense average
+    # over dp is element-wise across ranks' shards only if replicated.
+    # Here each rank's vector IS its shard, so the dense allreduce averages
+    # the 8 shards' values position-wise.
+    np.testing.assert_allclose(np.asarray(out_b), vecs.mean(axis=0),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_w), mats.mean(axis=0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_state_leaf_mismatch_raises(spmd8):
+    state = powersgd_init({"w": jnp.zeros((4, 4))}, rank=2)
+    x = jnp.ones((4, 4))
+    with pytest.raises(ValueError, match="rebuild"):
+        # The leaf-count check fires before any collective, so a direct
+        # call suffices.
+        powersgd_allreduce_p({"a": x, "b": x}, state, axis="dp")
+    with pytest.raises(ValueError, match="rank"):
+        powersgd_allreduce_p({"w": x}, state, axis="dp", rank=4)
