@@ -88,5 +88,6 @@ from .reducers import (compressed_allreduce,  # noqa: E402
                        compressed_grouped_allreduce,
                        hierarchical_compressed_allreduce_p)
 from .powersgd import (PowerSGDState, powersgd_init,  # noqa: E402
-                       powersgd_allreduce_p)
+                       powersgd_allreduce_p, powersgd_state_specs,
+                       PowerSGDOptimizer)
 from .config import CompressionConfig, make_compressor, from_env  # noqa: E402

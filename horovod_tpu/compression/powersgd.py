@@ -65,10 +65,17 @@ def _orthonormalize(p):
     return jnp.stack(cols, axis=1)
 
 
-def powersgd_init(grads, rank: int = 2, seed: int = 0) -> PowerSGDState:
+def powersgd_init(grads, rank: int = 2, seed: int = 0,
+                  world_size: int = 1) -> PowerSGDState:
     """State for :func:`powersgd_allreduce_p`: random-normal warm-start Q
     per matrix leaf (deterministic per leaf index so every rank starts with
-    the SAME factors — required for correctness), zero residuals."""
+    the SAME factors — required for correctness), zero residuals.
+
+    ``world_size``: the residuals are PER-RANK state. In the global view
+    (``run_step``'s in/out arrays) they stack over the mesh axis on dim 0,
+    so pass the axis size and shard the ``errors`` leaves with
+    :func:`powersgd_state_specs`; ``world_size=1`` gives local-shaped state
+    for hand-managed per-device setups."""
     leaves = jax.tree.leaves(grads)
     qs, errors = [], []
     for i, leaf in enumerate(leaves):
@@ -77,11 +84,21 @@ def powersgd_init(grads, rank: int = 2, seed: int = 0) -> PowerSGDState:
             r = min(rank, *m.shape)
             key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
             qs.append(jax.random.normal(key, (m.shape[1], r), jnp.float32))
-            errors.append(jnp.zeros(m.shape, jnp.float32))
+            errors.append(jnp.zeros((world_size * m.shape[0], m.shape[1]),
+                                    jnp.float32))
         else:
             qs.append(None)
             errors.append(jnp.zeros((0,), jnp.float32))
     return PowerSGDState(qs=tuple(qs), errors=tuple(errors))
+
+
+def powersgd_state_specs(state: PowerSGDState, axis: str) -> PowerSGDState:
+    """PartitionSpec tree matching ``state`` for run_step in/out specs:
+    factors replicated, residuals sharded over ``axis`` (dim 0)."""
+    from jax.sharding import PartitionSpec as P
+    return PowerSGDState(
+        qs=tuple(P() for _ in state.qs),
+        errors=tuple(P(axis) if e.size else P() for e in state.errors))
 
 
 def powersgd_allreduce_p(grads, state: PowerSGDState,
@@ -132,3 +149,39 @@ def powersgd_allreduce_p(grads, state: PowerSGDState,
         outs.append(approx.reshape(leaf.shape).astype(leaf.dtype))
     return (jax.tree.unflatten(treedef, outs),
             PowerSGDState(qs=tuple(new_qs), errors=tuple(new_errors)))
+
+
+def PowerSGDOptimizer(optimizer, rank: int = 2,
+                      axis: Optional[str] = None, seed: int = 0):
+    """Wrap an optax optimizer so updates use PowerSGD-averaged gradients.
+
+    The drop-in form of :func:`powersgd_allreduce_p` — factors and
+    residuals ride inside the optax state, so the training step signature
+    is unchanged (the PowerSGD analog of ``DistributedOptimizer``'s dense
+    reduction). In-step only (the reduction is a compiled collective).
+
+    ``init`` sizes the residuals for the GLOBAL view (stacked over the
+    axis, read from the live mesh), so inside ``run_step`` give the
+    optimizer state the spec ``(P(), powersgd_state_specs(psgd, axis))``
+    and it just works; see ``tests/test_powersgd.py``.
+    """
+    import optax
+
+    def init(params):
+        try:
+            ax = axis if axis is not None else runtime.dp_axis()
+            world = int(runtime.mesh().shape[ax])
+        except Exception:
+            world = 1  # no live mesh (hand-managed per-device state)
+        return (optimizer.init(params),
+                powersgd_init(params, rank=rank, seed=seed,
+                              world_size=world))
+
+    def update(grads, state, params=None):
+        inner_state, psgd_state = state
+        avg, psgd_state = powersgd_allreduce_p(grads, psgd_state,
+                                               axis=axis, rank=rank)
+        updates, inner_state = optimizer.update(avg, inner_state, params)
+        return updates, (inner_state, psgd_state)
+
+    return optax.GradientTransformation(init, update)

@@ -15,7 +15,8 @@ from jax.sharding import PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.compression.powersgd import (PowerSGDState,
                                               powersgd_allreduce_p,
-                                              powersgd_init)
+                                              powersgd_init,
+                                              powersgd_state_specs)
 
 
 @pytest.fixture
@@ -31,23 +32,12 @@ def _per_rank_mats(a, b, seed=0):
     return rng.randn(8, a, b).astype(np.float32)
 
 
-def _stack_errors(state, n=8):
-    """Global view of the per-rank residuals: stacked on dim 0 (the sharded
-    in_specs dim)."""
-    return state._replace(errors=tuple(
-        jnp.zeros((n * e.shape[0],) + e.shape[1:], e.dtype) if e.size
-        else e for e in state.errors))
-
-
 def _run(vals, state, rank, steps=1):
     """Drive `steps` iterations over an 8-way dp mesh; per-rank matrix
     gradients come in sharded on dim 0, residual state round-trips sharded,
     factors replicated."""
     a, b = vals.shape[1:]
-    state = _stack_errors(state)
-    state_specs = PowerSGDState(
-        qs=tuple(P() for _ in state.qs),
-        errors=tuple(P("dp") if e.size else P() for e in state.errors))
+    state_specs = powersgd_state_specs(state, "dp")
 
     def body(x, st):
         grads = {"w": x}
@@ -68,7 +58,7 @@ def test_full_rank_is_exact(spmd8):
     """rank >= min(a, b): P spans col(mean M), so P P^T mean(M) == mean(M)
     — the compressed average equals the dense average."""
     vals = _per_rank_mats(6, 4, seed=1)
-    state = powersgd_init({"w": jnp.zeros((6, 4))}, rank=4)
+    state = powersgd_init({"w": jnp.zeros((6, 4))}, rank=4, world_size=8)
     (out,), _ = _run(vals, state, rank=4)
     np.testing.assert_allclose(out, vals.mean(axis=0), rtol=1e-4, atol=1e-5)
 
@@ -78,7 +68,7 @@ def test_low_rank_error_feedback_converges(spmd8):
     k*mean - E_k with bounded E, so the running average approaches the
     dense mean at a 1/k rate."""
     vals = _per_rank_mats(5, 3, seed=2)
-    state = powersgd_init({"w": jnp.zeros((5, 3))}, rank=1)
+    state = powersgd_init({"w": jnp.zeros((5, 3))}, rank=1, world_size=8)
     outs, state = _run(vals, state, rank=1, steps=25)
     mean = vals.mean(axis=0)
     err_first = np.abs(outs[0] - mean).max()
@@ -92,7 +82,7 @@ def test_factors_replicated_and_warm_started(spmd8):
     """Q factors come back identical across ranks (they were psummed) and
     change between steps (warm start actually updates)."""
     vals = _per_rank_mats(4, 4, seed=3)
-    state0 = powersgd_init({"w": jnp.zeros((4, 4))}, rank=2)
+    state0 = powersgd_init({"w": jnp.zeros((4, 4))}, rank=2, world_size=8)
     _, state1 = _run(vals, state0, rank=2)
     q0, q1 = np.asarray(state0.qs[0]), np.asarray(state1.qs[0])
     assert q1.shape == q0.shape
@@ -105,11 +95,9 @@ def test_vector_leaves_ride_dense_path(spmd8):
     rng = np.random.RandomState(4)
     mats = rng.randn(8, 4, 4).astype(np.float32)
     vecs = rng.randn(8, 6).astype(np.float32)
-    state = _stack_errors(powersgd_init(
-        {"b": jnp.zeros((6,)), "w": jnp.zeros((4, 4))}, rank=4))
-    state_specs = PowerSGDState(
-        qs=tuple(P() for _ in state.qs),
-        errors=tuple(P("dp") if e.size else P() for e in state.errors))
+    state = powersgd_init({"b": jnp.zeros((6,)), "w": jnp.zeros((4, 4))},
+                          rank=4, world_size=8)
+    state_specs = powersgd_state_specs(state, "dp")
 
     def body(xm, xv, st):
         out, st = powersgd_allreduce_p({"b": xv, "w": xm}, st, axis="dp",
@@ -130,6 +118,40 @@ def test_vector_leaves_ride_dense_path(spmd8):
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(out_w), mats.mean(axis=0),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_powersgd_optimizer_trains(spmd8):
+    """PowerSGDOptimizer: drop-in optax wrapper; a linear model trains to a
+    fraction of its starting loss with rank-2 compressed averaging."""
+    import optax
+
+    from horovod_tpu.compression import PowerSGDOptimizer
+
+    rng = np.random.RandomState(7)
+    W_true = rng.randn(6, 4).astype(np.float32)
+    X = rng.randn(64, 6).astype(np.float32)
+    Y = X @ W_true
+
+    opt = PowerSGDOptimizer(optax.sgd(0.05), rank=2, axis="dp")
+    params = {"w": jnp.zeros((6, 4))}
+    inner, psgd = opt.init(params)  # residuals already global-stacked
+    sspec = (P(), powersgd_state_specs(psgd, "dp"))
+
+    def body(p, st, xb, yb):
+        loss, g = jax.value_and_grad(
+            lambda q: ((xb @ q["w"] - yb) ** 2).mean())(hvd.pvary(p))
+        updates, st = opt.update(g, st, p)
+        return optax.apply_updates(p, updates), st, hvd.allreduce(loss)
+
+    step = hvd.run_step(body, in_specs=(P(), sspec, P("dp"), P("dp")),
+                        out_specs=(P(), sspec, P()))
+    state = (inner, psgd)
+    losses = []
+    for _ in range(40):
+        params, state, loss = step(params, state, jnp.asarray(X),
+                                   jnp.asarray(Y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
 
 
 def test_state_leaf_mismatch_raises(spmd8):
