@@ -168,11 +168,17 @@ def PowerSGDOptimizer(optimizer, rank: int = 2,
     import optax
 
     def init(params):
+        from ..exceptions import NotInitializedError
         try:
             ax = axis if axis is not None else runtime.dp_axis()
             world = int(runtime.mesh().shape[ax])
-        except Exception:
+        except NotInitializedError:
             world = 1  # no live mesh (hand-managed per-device state)
+        except KeyError:
+            raise ValueError(
+                f"axis {axis!r} is not a mesh axis "
+                f"({tuple(runtime.mesh().shape)}) — pass the axis the mesh "
+                "was initialized with")
         return (optimizer.init(params),
                 powersgd_init(params, rank=rank, seed=seed,
                               world_size=world))

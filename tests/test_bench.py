@@ -1,0 +1,83 @@
+"""bench.py driver contract: ONE parseable JSON line with the agreed keys.
+
+The driver records bench.py's last stdout line as BENCH_r{N}.json — a
+schema drift or a crash in any phase breaks the round's perf evidence, so
+the contract gets its own test: run the CLI end-to-end on the CPU platform
+with tiny knobs and assert the schema.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import REPO_ROOT, subprocess_env
+
+_KNOBS = {
+    "HVDTPU_BENCH_PLATFORM": "cpu",
+    "HVDTPU_BENCH_BATCH": "2", "HVDTPU_BENCH_IMAGE": "32",
+    "HVDTPU_BENCH_WARMUP": "1", "HVDTPU_BENCH_ITERS": "2",
+    "HVDTPU_BENCH_INNER_STEPS": "2",
+    "HVDTPU_BENCH_RN101_BATCH": "2", "HVDTPU_BENCH_RN101_IMAGE": "32",
+    "HVDTPU_BENCH_RN101_ITERS": "1",
+    "HVDTPU_BENCH_ATTN_BATCH": "1", "HVDTPU_BENCH_ATTN_SEQ": "128",
+    "HVDTPU_BENCH_GPT_LAYERS": "1", "HVDTPU_BENCH_GPT_EMBED": "64",
+    "HVDTPU_BENCH_GPT_BATCH": "1", "HVDTPU_BENCH_GPT_SEQ": "64",
+    "HVDTPU_BENCH_DEADLINE": "800",
+}
+
+
+def test_bench_cli_contract():
+    env = subprocess_env()
+    env.update(_KNOBS)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=780, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    result = json.loads(lines[-1])  # the driver reads the LAST line
+
+    # Driver contract (task brief): metric/value/unit/vs_baseline.
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in result, key
+    assert result["value"] > 0
+    assert "error" not in result
+    assert result["unit"] == "images/sec/chip"
+    assert result["flops_source"] in ("analytic", "cost_analysis")
+
+    # Phase keys: every phase reports something (measurement, error note,
+    # or an explicit skip) — silent phase loss is the r03 failure mode.
+    micro = result["microbench"]
+    assert any(e.get("op") == "compressed_allreduce"
+               for e in micro["ops"] if isinstance(e, dict))
+    assert "crossover_gbps" in result["compression_ab"]
+    assert any(e.get("op") == "attention_flash"
+               for e in result["attention_kernels"] if isinstance(e, dict))
+    assert result["gpt"]["tokens_per_sec_per_chip"] > 0
+    assert "images_per_sec_per_chip" in result["resnet101"] or \
+        "skipped" in result["resnet101"]
+    assert "tokens_per_sec_per_chip" in result["gpt_long_context"] or \
+        "skipped" in result["gpt_long_context"]
+    # CPU backend: the flash long-context phase must be SKIPPED (interpret
+    # mode proves nothing and would crawl), with the reason recorded.
+    assert "skipped" in result["gpt_long_context_flash"]
+
+
+def test_bench_probe_bails_on_deterministic_failure():
+    """A broken platform knob must produce a fast, precisely-diagnosed
+    error — not 900 s of retries blamed on the tunnel (r03 postmortem)."""
+    env = subprocess_env()
+    env.update(_KNOBS)
+    env["HVDTPU_BENCH_PLATFORM"] = "bogus"
+    env["HVDTPU_BENCH_PROBE_BUDGET"] = "120"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=110, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "deterministically" in result["error"]
+    assert result["value"] == 0.0
