@@ -57,6 +57,14 @@ def main():
     # square-ish matrix, per-rank data sharded over the mesh, factors on
     # the wire. rel_err is the single-shot rank-r error (training quality
     # comes from the error feedback shrinking it across steps).
+    if hvd.mode() == "process":
+        # The section below is SPMD-global-view (run_step over the mesh);
+        # under the process-mode launcher each rank has a 1-device mesh and
+        # the stacked-input layout would be wrong.
+        if hvd.rank() == 0:
+            print("  powersgd        (skipped: SPMD mode only)")
+        hvd.shutdown()
+        return
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
