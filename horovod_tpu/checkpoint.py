@@ -1,0 +1,111 @@
+"""Sharded checkpoint save/restore (orbax-backed).
+
+The reference has no native checkpoint format — checkpointing is the
+user framework's job (SURVEY.md §5: "resume-from-checkpoint is the user's
+framework's job"), with only state broadcast + the Spark estimators' Store
+blobs as mechanisms. On TPU the capability users actually need at scale is
+**sharded** checkpointing: params/optimizer state laid out over a mesh must
+save from and restore to device shards WITHOUT gathering the whole model
+through one host. Orbax (the JAX-ecosystem checkpointer) provides exactly
+that; this module is the thin ``hvd.save_checkpoint`` / ``restore_checkpoint``
+surface over it, sharding-aware on both sides.
+
+* ``save_checkpoint(path, tree, step=)``: writes the pytree (jax arrays of
+  any sharding, numpy, scalars) atomically under ``path/step``.
+* ``restore_checkpoint(path, template, step=None)``: restores the latest
+  (or given) step. With a ``template`` of jax arrays, each leaf restores
+  WITH the template's sharding (device-direct, no host round-trip);
+  otherwise arrays come back as numpy.
+* ``latest_checkpoint_step(path)``: highest saved step, or None.
+
+Pairs with the elastic ``State`` (in-memory commit/restore across failures)
+— this is the durable cross-restart layer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _is_remote(path: str) -> bool:
+    return "://" in path  # gs://, s3://, hdfs://... — orbax/epath territory
+
+
+def _resolve(path: str) -> str:
+    # abspath would mangle remote URIs into local paths; only localize
+    # scheme-less paths.
+    return path if _is_remote(path) else os.path.abspath(path)
+
+
+def _manager(path: str):
+    import orbax.checkpoint as ocp
+    return ocp.CheckpointManager(_resolve(path))
+
+
+def save_checkpoint(path: str, tree: Any, step: int = 0,
+                    force: bool = True) -> None:
+    """Atomically save ``tree`` under ``path/<step>`` (orbax layout).
+
+    Sharded ``jax.Array`` leaves are written per-shard by the hosts that
+    own them — a tp/dp-sharded model never materializes on one host.
+    Rank discipline: under multi-host SPMD (``jax.distributed``) call on
+    every process (orbax coordinates the single-controller world). In
+    PROCESS mode each rank is an independent JAX world, so only rank 0
+    writes — this function enforces that (other ranks no-op) to prevent N
+    uncoordinated writers racing on the same destination.
+    """
+    import orbax.checkpoint as ocp
+
+    from . import runtime
+    if runtime.is_initialized() and runtime.mode() == "process" and \
+            runtime.rank() != 0:
+        return
+    with _manager(path) as mgr:
+        mgr.save(step, args=ocp.args.StandardSave(tree), force=force)
+        # close() (context exit) waits for the async save to finish.
+
+
+def latest_checkpoint_step(path: str) -> Optional[int]:
+    if not _is_remote(path) and not os.path.isdir(path):
+        return None  # avoid the manager mkdir-ing an empty layout
+    with _manager(path) as mgr:
+        return mgr.latest_step()
+
+
+def restore_checkpoint(path: str, template: Any = None,
+                       step: Optional[int] = None) -> Any:
+    """Restore a checkpoint saved by :func:`save_checkpoint`.
+
+    ``template``: a pytree of arrays (or ShapeDtypeStruct-likes) giving the
+    target structure; jax-array leaves restore directly onto their
+    shardings. ``step=None`` restores the latest.
+    """
+    import orbax.checkpoint as ocp
+    if not _is_remote(path) and not os.path.isdir(path):
+        # Probe-friendly: a fresh-start check must not mkdir an empty
+        # orbax layout as a side effect.
+        raise FileNotFoundError(f"no checkpoint directory at {path!r}")
+    with _manager(path) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint steps under {path!r}")
+        if template is None:
+            return mgr.restore(step)
+
+        def to_restore_arg(leaf):
+            if isinstance(leaf, jax.Array):
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=leaf.sharding)
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return leaf
+            arr = np.asarray(leaf)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+        target = jax.tree.map(to_restore_arg, template)
+        return mgr.restore(step, args=ocp.args.StandardRestore(target))
