@@ -41,13 +41,25 @@ def _resolve(path: str) -> str:
     return path if _is_remote(path) else os.path.abspath(path)
 
 
-def _manager(path: str):
+def _exists(path: str) -> bool:
+    if not _is_remote(path):
+        return os.path.isdir(path)
+    try:  # epath ships with orbax and understands gs:// etc.
+        from etils import epath
+        return epath.Path(path).exists()
+    except Exception:
+        return True  # can't probe: let orbax decide (may create layout)
+
+
+def _manager(path: str, keep: Optional[int] = None):
     import orbax.checkpoint as ocp
-    return ocp.CheckpointManager(_resolve(path))
+    options = ocp.CheckpointManagerOptions(max_to_keep=keep) \
+        if keep is not None else None
+    return ocp.CheckpointManager(_resolve(path), options=options)
 
 
 def save_checkpoint(path: str, tree: Any, step: int = 0,
-                    force: bool = True) -> None:
+                    force: bool = True, keep: Optional[int] = None) -> None:
     """Atomically save ``tree`` under ``path/<step>`` (orbax layout).
 
     Sharded ``jax.Array`` leaves are written per-shard by the hosts that
@@ -57,6 +69,10 @@ def save_checkpoint(path: str, tree: Any, step: int = 0,
     PROCESS mode each rank is an independent JAX world, so only rank 0
     writes — this function enforces that (other ranks no-op) to prevent N
     uncoordinated writers racing on the same destination.
+
+    ``keep``: retain only the newest N steps (orbax ``max_to_keep``) —
+    unbounded by default, but long-running jobs committing every step
+    should cap it.
     """
     import orbax.checkpoint as ocp
 
@@ -64,13 +80,13 @@ def save_checkpoint(path: str, tree: Any, step: int = 0,
     if runtime.is_initialized() and runtime.mode() == "process" and \
             runtime.rank() != 0:
         return
-    with _manager(path) as mgr:
+    with _manager(path, keep=keep) as mgr:
         mgr.save(step, args=ocp.args.StandardSave(tree), force=force)
         # close() (context exit) waits for the async save to finish.
 
 
 def latest_checkpoint_step(path: str) -> Optional[int]:
-    if not _is_remote(path) and not os.path.isdir(path):
+    if not _exists(path):
         return None  # avoid the manager mkdir-ing an empty layout
     with _manager(path) as mgr:
         return mgr.latest_step()
@@ -85,7 +101,7 @@ def restore_checkpoint(path: str, template: Any = None,
     shardings. ``step=None`` restores the latest.
     """
     import orbax.checkpoint as ocp
-    if not _is_remote(path) and not os.path.isdir(path):
+    if not _exists(path):
         # Probe-friendly: a fresh-start check must not mkdir an empty
         # orbax layout as a side effect.
         raise FileNotFoundError(f"no checkpoint directory at {path!r}")

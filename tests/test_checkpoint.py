@@ -14,14 +14,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import horovod_tpu as hvd
 
 
-@pytest.fixture
-def spmd8():
-    hvd.shutdown()
-    hvd.init()
-    yield hvd
-    hvd.shutdown()
-
-
 def _sharded_tree(mesh):
     sharded = jax.device_put(
         jnp.arange(32.0, dtype=jnp.float32).reshape(8, 4),
@@ -81,6 +73,35 @@ def test_restore_missing_raises(spmd8, tmp_path):
     assert not os.path.exists(tmp_path / "empty")
     assert hvd.latest_checkpoint_step(str(tmp_path / "nothing")) is None
     assert not os.path.exists(tmp_path / "nothing")
+
+
+def test_elastic_state_durable_commits(spmd8, tmp_path):
+    """TpuState(checkpoint_dir=...): commits write durable snapshots, and a
+    FRESH state (new job) resumes params/attrs from the latest one."""
+    from horovod_tpu.elastic.state import TpuState
+
+    path = str(tmp_path / "elastic")
+    st = TpuState(params={"w": jnp.ones((4,)) * 2.0}, opt_state=None,
+                  checkpoint_dir=path, checkpoint_every=2, epoch=0)
+    st.commit()                       # count 1: no durable write (every=2)
+    assert hvd.latest_checkpoint_step(path) is None
+    st.epoch = 5
+    st.params = {"w": jnp.ones((4,)) * 7.0}
+    st.commit()                       # count 2: durable
+    assert hvd.latest_checkpoint_step(path) == 2
+
+    fresh = TpuState(params=None, opt_state=None, checkpoint_dir=path,
+                     epoch=0)
+    assert fresh.load_from_checkpoint() is True
+    np.testing.assert_array_equal(np.asarray(fresh.params["w"]),
+                                  np.full((4,), 7.0, np.float32))
+    assert fresh.epoch == 5
+    # Step numbering continues monotonically after resume.
+    fresh.commit()
+    assert hvd.latest_checkpoint_step(path) == 3
+
+    none = TpuState(params=None, checkpoint_dir=str(tmp_path / "nothing"))
+    assert none.load_from_checkpoint() is False
 
 
 def test_resume_training_mid_run(spmd8, tmp_path):
