@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import copy
 import queue
-from typing import Any, Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 import jax
 import numpy as np
@@ -152,11 +152,21 @@ class TpuState(ObjectState):
         self._ckpt_every = max(int(checkpoint_every), 1)
         self._ckpt_keep = checkpoint_keep
         self._commit_count = 0
+        self._latest_durable = 0
         if checkpoint_dir is not None:
             from ..checkpoint import latest_checkpoint_step
             # Continue orbax's monotone step numbering across restarts.
-            self._commit_count = latest_checkpoint_step(checkpoint_dir) or 0
+            self._latest_durable = latest_checkpoint_step(checkpoint_dir) or 0
+            self._commit_count = self._latest_durable
         super().__init__(**kwargs)
+
+    def _durable_manager(self):
+        # Persistent manager: per-commit construction would re-list the
+        # (possibly remote) step directory every save.
+        if getattr(self, "_ckpt_mgr", None) is None:
+            from ..checkpoint import _manager
+            self._ckpt_mgr = _manager(self._ckpt_dir, keep=self._ckpt_keep)
+        return self._ckpt_mgr
 
     def save(self) -> None:
         self._tree_snapshot = jax.device_get((self.params, self.opt_state))
@@ -164,27 +174,43 @@ class TpuState(ObjectState):
         self._commit_count += 1
         if self._ckpt_dir is not None and \
                 self._commit_count % self._ckpt_every == 0:
-            from ..checkpoint import save_checkpoint
+            import orbax.checkpoint as ocp
+
             from ..functions import _serialize
+            if runtime.is_initialized() and \
+                    runtime.mode() == "process" and runtime.rank() != 0:
+                return  # one writer per destination (see save_checkpoint)
             # The LIVE device tree, not the host snapshot: sharded arrays
             # write per-shard (the whole point of the orbax layer); the
-            # host snapshot above remains the in-memory rollback.
+            # host snapshot above remains the in-memory rollback. The wait
+            # keeps commit() a completed rollback point (commits block in
+            # the reference too — deepcopy semantics).
+            mgr = self._durable_manager()
             blob = {"tree": (self.params, self.opt_state),
                     # Arbitrary picklable attrs ride as a byte array.
                     "attrs": _serialize(self._saved_state)}
-            save_checkpoint(self._ckpt_dir, blob, step=self._commit_count,
-                            keep=self._ckpt_keep)
+            mgr.save(self._commit_count,
+                     args=ocp.args.StandardSave(blob), force=True)
+            mgr.wait_until_finished()
 
     def load_from_checkpoint(self) -> bool:
         """Populate params/opt_state/attrs from the latest durable commit;
         False when none exists (fresh start). Call before training begins
-        — the in-memory restore() covers failures within the job."""
+        — the in-memory restore() covers failures within the job.
+
+        The restore goes through host numpy, matching TpuState's
+        host-snapshot design (save()/restore() already round-trip through
+        ``jax.device_get``). For models too large to materialize per host,
+        restore the durable blob directly with
+        :func:`horovod_tpu.restore_checkpoint` and a sharded template.
+        """
         if self._ckpt_dir is None:
             return False
-        from ..checkpoint import (latest_checkpoint_step,
-                                  restore_checkpoint)
+        from ..checkpoint import restore_checkpoint
         from ..functions import _deserialize
-        step = latest_checkpoint_step(self._ckpt_dir)
+        # __init__ already probed the latest durable step — no second
+        # directory listing (durable steps start at 1, so 0 means none).
+        step = self._latest_durable or None
         if step is None:
             return False
         blob = restore_checkpoint(self._ckpt_dir, step=step)
@@ -196,6 +222,7 @@ class TpuState(ObjectState):
         for k, v in attrs.items():
             setattr(self, k, v)
         self._commit_count = step
+        self._latest_durable = step
         return True
 
     def restore(self) -> None:

@@ -11,7 +11,6 @@ import os
 import subprocess
 import sys
 
-import pytest
 
 from conftest import REPO_ROOT, subprocess_env
 
