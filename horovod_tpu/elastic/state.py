@@ -125,7 +125,63 @@ class ObjectState(State):
                 setattr(self, k, v)
 
 
-class TpuState(ObjectState):
+class DurableStateMixin:
+    """Shared durable-commit plumbing for elastic states (TpuState and the
+    torch TorchState): step numbering continued across restarts, cadence,
+    retention, one-writer guard under process mode, and a persistent orbax
+    manager. Subclasses call :meth:`_init_durable` in ``__init__`` and
+    :meth:`_maybe_durable_save` after each in-memory save with a zero-arg
+    blob builder."""
+
+    def _init_durable(self, checkpoint_dir: Optional[str],
+                      checkpoint_every: int,
+                      checkpoint_keep: Optional[int]) -> None:
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_every = max(int(checkpoint_every), 1)
+        self._ckpt_keep = checkpoint_keep
+        self._ckpt_mgr = None
+        self._ckpt_armed = True
+        self._commit_count = 0
+        self._latest_durable = 0
+        if checkpoint_dir is not None:
+            from ..checkpoint import latest_checkpoint_step
+            # Continue orbax's monotone step numbering across restarts.
+            self._latest_durable = latest_checkpoint_step(checkpoint_dir) or 0
+            self._commit_count = self._latest_durable
+
+    def _durable_manager(self):
+        # Persistent manager: per-commit construction would re-list the
+        # (possibly remote) step directory every save.
+        if self._ckpt_mgr is None:
+            from ..checkpoint import _manager
+            self._ckpt_mgr = _manager(self._ckpt_dir, keep=self._ckpt_keep)
+        return self._ckpt_mgr
+
+    def _maybe_durable_save(self, build_blob: Callable[[], dict]) -> None:
+        """Count the commit; write durably at the configured cadence. The
+        ``_ckpt_armed`` gate lets construction/sync snapshots stay
+        in-memory-only (a durable write there would record untrained or
+        pre-rollback state as the newest step)."""
+        if not self._ckpt_armed:
+            return
+        self._commit_count += 1
+        if self._ckpt_dir is None or \
+                self._commit_count % self._ckpt_every != 0:
+            return
+        if runtime.is_initialized() and runtime.mode() == "process" and \
+                runtime.rank() != 0:
+            return  # one writer per destination (see save_checkpoint)
+        import orbax.checkpoint as ocp
+        mgr = self._durable_manager()
+        mgr.save(self._commit_count,
+                 args=ocp.args.StandardSave(build_blob()), force=True)
+        # The wait keeps commit() a completed rollback point (commits
+        # block in the reference too — deepcopy semantics).
+        mgr.wait_until_finished()
+        self._latest_durable = self._commit_count
+
+
+class TpuState(DurableStateMixin, ObjectState):
     """Elastic state holding JAX pytrees (params / optimizer state) plus
     arbitrary picklable attrs — the TPU analog of ``TorchState``
     (reference ``horovod/torch/elastic.py:51``).
@@ -148,50 +204,24 @@ class TpuState(ObjectState):
         self.params = params
         self.opt_state = opt_state
         self._tree_snapshot = None
-        self._ckpt_dir = checkpoint_dir
-        self._ckpt_every = max(int(checkpoint_every), 1)
-        self._ckpt_keep = checkpoint_keep
-        self._commit_count = 0
-        self._latest_durable = 0
-        if checkpoint_dir is not None:
-            from ..checkpoint import latest_checkpoint_step
-            # Continue orbax's monotone step numbering across restarts.
-            self._latest_durable = latest_checkpoint_step(checkpoint_dir) or 0
-            self._commit_count = self._latest_durable
+        self._init_durable(checkpoint_dir, checkpoint_every,
+                           checkpoint_keep)
         super().__init__(**kwargs)
-
-    def _durable_manager(self):
-        # Persistent manager: per-commit construction would re-list the
-        # (possibly remote) step directory every save.
-        if getattr(self, "_ckpt_mgr", None) is None:
-            from ..checkpoint import _manager
-            self._ckpt_mgr = _manager(self._ckpt_dir, keep=self._ckpt_keep)
-        return self._ckpt_mgr
 
     def save(self) -> None:
         self._tree_snapshot = jax.device_get((self.params, self.opt_state))
         super().save()
-        self._commit_count += 1
-        if self._ckpt_dir is not None and \
-                self._commit_count % self._ckpt_every == 0:
-            import orbax.checkpoint as ocp
 
+        def build_blob():
             from ..functions import _serialize
-            if runtime.is_initialized() and \
-                    runtime.mode() == "process" and runtime.rank() != 0:
-                return  # one writer per destination (see save_checkpoint)
             # The LIVE device tree, not the host snapshot: sharded arrays
             # write per-shard (the whole point of the orbax layer); the
-            # host snapshot above remains the in-memory rollback. The wait
-            # keeps commit() a completed rollback point (commits block in
-            # the reference too — deepcopy semantics).
-            mgr = self._durable_manager()
-            blob = {"tree": (self.params, self.opt_state),
+            # host snapshot above remains the in-memory rollback.
+            return {"tree": (self.params, self.opt_state),
                     # Arbitrary picklable attrs ride as a byte array.
                     "attrs": _serialize(self._saved_state)}
-            mgr.save(self._commit_count,
-                     args=ocp.args.StandardSave(blob), force=True)
-            mgr.wait_until_finished()
+
+        self._maybe_durable_save(build_blob)
 
     def load_from_checkpoint(self) -> bool:
         """Populate params/opt_state/attrs from the latest durable commit;
@@ -222,7 +252,6 @@ class TpuState(ObjectState):
         for k, v in attrs.items():
             setattr(self, k, v)
         self._commit_count = step
-        self._latest_durable = step
         return True
 
     def restore(self) -> None:

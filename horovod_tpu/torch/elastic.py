@@ -13,10 +13,11 @@ from typing import Any
 
 import torch
 
-from ..elastic.state import ObjectState, run, run_fn  # noqa: F401
+from ..elastic.state import (DurableStateMixin, ObjectState,  # noqa: F401
+                             run, run_fn)
 
 
-class TorchState(ObjectState):
+class TorchState(DurableStateMixin, ObjectState):
     """Elastic state that snapshots torch modules and optimizers by value.
 
     Usage (reference parity)::
@@ -30,12 +31,22 @@ class TorchState(ObjectState):
     """
 
     def __init__(self, model: torch.nn.Module = None,
-                 optimizer: torch.optim.Optimizer = None, **kwargs):
+                 optimizer: torch.optim.Optimizer = None,
+                 checkpoint_dir: str = None, checkpoint_every: int = 1,
+                 checkpoint_keep: int = 5, **kwargs):
         self._saved = {}
         self.model = model
         self.optimizer = optimizer
+        self._init_durable(checkpoint_dir, checkpoint_every,
+                           checkpoint_keep)
         super().__init__(**kwargs)
+        # The construction-time save only seeds the in-memory snapshot —
+        # a durable write here would record UNTRAINED params as the newest
+        # step, and a crash before the first real commit would then resume
+        # from them.
+        self._ckpt_armed = False
         self.save()
+        self._ckpt_armed = True
 
     # -- State hooks -------------------------------------------------------
 
@@ -46,6 +57,34 @@ class TorchState(ObjectState):
             self._saved["optimizer"] = copy.deepcopy(
                 self.optimizer.state_dict())
         super().save()
+
+        def build_blob():
+            # torch state_dicts + attrs ride as one pickled byte array —
+            # torch-CPU interop has no sharded-array layout to preserve,
+            # so the blob form is the right one here.
+            from ..functions import _serialize
+            return {"state": _serialize(
+                {"saved": self._saved, "attrs": self._saved_state})}
+
+        self._maybe_durable_save(build_blob)
+
+    def load_from_checkpoint(self) -> bool:
+        """Resume a NEW job from the latest durable commit; False on a
+        fresh start. Loads state_dicts into the live model/optimizer."""
+        if self._ckpt_dir is None or not self._latest_durable:
+            return False
+        import numpy as np
+
+        from ..checkpoint import restore_checkpoint
+        from ..functions import _deserialize
+        blob = restore_checkpoint(self._ckpt_dir,
+                                  step=self._latest_durable)
+        data = _deserialize(np.asarray(blob["state"]))
+        self._saved = data["saved"]
+        self._saved_state.update(data["attrs"])
+        self.restore()  # ObjectState.restore setattrs every saved attr
+        self._commit_count = self._latest_durable
+        return True
 
     def restore(self) -> None:
         if self.model is not None and "model" in self._saved:
@@ -68,4 +107,12 @@ class TorchState(ObjectState):
             if rank() != 0:
                 self.optimizer.load_state_dict(state)
         super().sync()
-        self.save()
+        # In-memory snapshot only: the first sync() inside hvd.elastic.run
+        # happens BEFORE any training — a durable write here would record
+        # untrained params as the newest step (and every rejoin would skew
+        # the checkpoint_every cadence).
+        self._ckpt_armed = False
+        try:
+            self.save()
+        finally:
+            self._ckpt_armed = True

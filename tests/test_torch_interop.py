@@ -73,6 +73,53 @@ class TestSingleProcess:
             assert torch.equal(v, before[k]), k
         assert state.batch == 7
 
+    def test_torch_state_durable_resume(self, spmd8, tmp_path):
+        """TorchState(checkpoint_dir=...): durable commits survive a
+        simulated full-job restart (parity with TpuState's durable layer)."""
+        import torch
+        import horovod_tpu.torch as hvd
+        path = str(tmp_path / "tstate")
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = hvd.elastic.TorchState(model=model, optimizer=opt,
+                                       checkpoint_dir=path, epoch=0)
+        with torch.no_grad():
+            for p in model.parameters():
+                p.fill_(3.0)
+        state.epoch = 4
+        state.commit()
+        expect = {k: v.clone() for k, v in model.state_dict().items()}
+
+        fresh_model = torch.nn.Linear(4, 2)
+        fresh_opt = torch.optim.SGD(fresh_model.parameters(), lr=0.1)
+        fresh = hvd.elastic.TorchState(model=fresh_model,
+                                       optimizer=fresh_opt,
+                                       checkpoint_dir=path, epoch=0)
+        # Construction must NOT write a durable step (untrained params
+        # would shadow the real latest commit for the next restart).
+        from horovod_tpu import latest_checkpoint_step
+        assert latest_checkpoint_step(path) == 1
+        assert fresh.load_from_checkpoint() is True
+        assert fresh.epoch == 4
+        for k, v in fresh_model.state_dict().items():
+            assert torch.equal(v, expect[k]), k
+
+        nothing = hvd.elastic.TorchState(
+            model=torch.nn.Linear(2, 2),
+            checkpoint_dir=str(tmp_path / "none"))
+        assert nothing.load_from_checkpoint() is False
+
+        # sync() (run by hvd.elastic.run BEFORE training) must stay
+        # in-memory: a durable write there would record untrained params
+        # as the newest step (round-4 review finding).
+        synced = hvd.elastic.TorchState(
+            model=torch.nn.Linear(2, 2),
+            checkpoint_dir=str(tmp_path / "sync"), epoch=0)
+        synced.sync()
+        assert latest_checkpoint_step(str(tmp_path / "sync")) is None
+        synced.commit()
+        assert latest_checkpoint_step(str(tmp_path / "sync")) == 1
+
     def test_named_parameters_validation(self, spmd8):
         """Reference: optimizer.py:44-63 — non-tuple sequences, duplicate
         names, and partially-named models are user errors."""
