@@ -9,7 +9,7 @@ attributes, with commit/restore/sync semantics driven by
 from __future__ import annotations
 
 import copy
-from typing import Any
+from typing import Any, Optional
 
 import torch
 
@@ -32,8 +32,9 @@ class TorchState(DurableStateMixin, ObjectState):
 
     def __init__(self, model: torch.nn.Module = None,
                  optimizer: torch.optim.Optimizer = None,
-                 checkpoint_dir: str = None, checkpoint_every: int = 1,
-                 checkpoint_keep: int = 5, **kwargs):
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1,
+                 checkpoint_keep: Optional[int] = 5, **kwargs):
         self._saved = {}
         self.model = model
         self.optimizer = optimizer

@@ -89,6 +89,33 @@ class TestInStep:
         np.testing.assert_allclose(np.asarray(out),
                                    data.mean(axis=0, keepdims=True), rtol=1e-5)
 
+    def test_allreduce_tuple_axis(self, make_runtime):
+        """allreduce_p over a TUPLE of mesh axes: varying input reduces
+        over both; an already-reduced (invariant) input only normalizes.
+        Regression for the round-4 _dp_invariant fix — `tuple not in vma`
+        was always True, silently skipping the psum for tuple axes."""
+        make_runtime(mesh_shape={"a": 2, "b": 4})
+        vals = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+
+        def body(x):
+            varying = hvd.allreduce_p(x, op=hvd.Average, axis=("a", "b"))
+            # Invariant path: psum first (invariant result), then the
+            # tuple-axis AVERAGE must only divide by the combined size.
+            summed = hvd.allreduce_p(x, op=hvd.Sum, axis=("a", "b"))
+            renorm = hvd.allreduce_p(summed, op=hvd.Average,
+                                     axis=("a", "b"))
+            return varying, renorm
+
+        step = hvd.run_step(body, in_specs=P(("a", "b")),
+                            out_specs=(P(), P()))
+        varying, renorm = step(jnp.asarray(vals))
+        np.testing.assert_allclose(np.asarray(varying),
+                                   vals.mean(axis=0, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(renorm),
+                                   vals.sum(axis=0, keepdims=True) / 8.0,
+                                   rtol=1e-5, atol=1e-5)
+
     def test_rank_and_size_in_step(self, spmd8):
         @hvd.run_step(in_specs=P("dp"), out_specs=P("dp"))
         def step(x):
