@@ -55,6 +55,19 @@ def _use_interpret() -> bool:
     return not _pallas_backend_enabled(None)
 
 
+def repeat_kv_heads(k, n_q_heads: int):
+    """Grouped-query attention: tile K/V heads up to the query head count
+    (the compact heads are what cross the wire; the repeat is local).
+    Shared by flash, ring and Ulysses attention."""
+    n_kv = k.shape[2]
+    if n_kv == n_q_heads:
+        return k
+    if n_q_heads % n_kv:
+        raise ValueError(
+            f"query heads ({n_q_heads}) not a multiple of kv heads ({n_kv})")
+    return jnp.repeat(k, n_q_heads // n_kv, axis=2)
+
+
 def _causal_mask(s, q_block, k_block):
     """Mask logits tile ``s`` [BLOCK_Q, BLOCK_K] for causality: query block
     index ``q_block``, key block index ``k_block`` (global positions)."""
@@ -287,15 +300,21 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True):
-    """Fused causal attention. q/k/v: ``[B, S, H, D]`` (the layout the GPT
-    blocks use); differentiable (custom VJP, flash backward). Only
-    ``causal=True`` is supported — the causal structure is also what makes
-    tail-padding to the 128-row block size free.
+    """Fused causal attention. q: ``[B, S, H, D]`` (the layout the GPT
+    blocks use); k/v: ``[B, S, Hkv, D]`` where ``Hkv`` may divide ``H``
+    (grouped-query attention — kv heads tile up locally, mirroring ring
+    attention's contract). Differentiable (custom VJP, flash backward).
+    Only ``causal=True`` is supported — the causal structure is also what
+    makes tail-padding to the 128-row block size free.
     """
     if not causal:
         raise NotImplementedError(
             "flash_attention is causal-only; use default_attention for "
             "bidirectional attention")
+    if k.shape[2] != q.shape[2]:
+        # GQA: repeat before the kernel (same policy as ring attention).
+        k = repeat_kv_heads(k, q.shape[2])
+        v = repeat_kv_heads(v, q.shape[2])
     b, s, h, d = q.shape
     sm_scale = 1.0 / float(np.sqrt(d))
 

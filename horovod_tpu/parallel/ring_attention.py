@@ -59,15 +59,9 @@ def _require_axis(axis: Optional[str], who: str) -> str:
     return ax
 
 
-def _repeat_kv_heads(k, n_q_heads: int):
-    """Grouped-query attention: tile K/V heads up to the query head count."""
-    n_kv = k.shape[2]
-    if n_kv == n_q_heads:
-        return k
-    if n_q_heads % n_kv:
-        raise ValueError(
-            f"query heads ({n_q_heads}) not a multiple of kv heads ({n_kv})")
-    return jnp.repeat(k, n_q_heads // n_kv, axis=2)
+# Shared with flash attention; ops is the lower layer, so parallel imports
+# from it (keeps the module graph one-directional).
+from ..ops.flash_attention import repeat_kv_heads as _repeat_kv_heads  # noqa: E402,E501
 
 
 def ring_attention_p(q, k, v, causal: bool = True,

@@ -30,8 +30,8 @@ class TorchState(DurableStateMixin, ObjectState):
             state.commit()
     """
 
-    def __init__(self, model: torch.nn.Module = None,
-                 optimizer: torch.optim.Optimizer = None,
+    def __init__(self, model: Optional[torch.nn.Module] = None,
+                 optimizer: Optional[torch.optim.Optimizer] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1,
                  checkpoint_keep: Optional[int] = 5, **kwargs):

@@ -84,6 +84,21 @@ def test_bf16_runs():
                                rtol=5e-2, atol=5e-2)
 
 
+def test_grouped_query_attention():
+    """Hkv < H (GQA): K/V heads tile up to the query head count, matching
+    dense attention on the explicitly repeated heads."""
+    q, _, _ = _qkv(1, 128, 4, 64, seed=17)
+    kk = jax.random.split(jax.random.PRNGKey(19), 2)
+    k = jax.random.normal(kk[0], (1, 128, 2, 64)) * 0.5
+    v = jax.random.normal(kk[1], (1, 128, 2, 64)) * 0.5
+    out = flash_attention(q, k, v, causal=True)
+    kr = jnp.repeat(k, 2, axis=2)
+    vr = jnp.repeat(v, 2, axis=2)
+    ref = default_attention(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_non_causal_rejected():
     q, k, v = _qkv(1, 128, 1, 64)
     with pytest.raises(NotImplementedError, match="causal-only"):
