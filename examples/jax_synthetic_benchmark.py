@@ -19,10 +19,12 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet18, ResNet34, ResNet50, ResNet101
+from horovod_tpu.models import (ResNet18, ResNet34, ResNet50, ResNet101,
+                                VGG16, VGG19)
 
 MODELS = {"resnet18": ResNet18, "resnet34": ResNet34,
-          "resnet50": ResNet50, "resnet101": ResNet101}
+          "resnet50": ResNet50, "resnet101": ResNet101,
+          "vgg16": VGG16, "vgg19": VGG19}
 
 
 def main():
@@ -49,7 +51,9 @@ def main():
     labels = jax.random.randint(rng, (batch,), 0, 1000)
 
     variables = model.init(rng, images[:1], train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    # VGG has no batch norm; ResNets carry BN statistics.
+    batch_stats = variables.get("batch_stats", {})
     opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
     opt_state = opt.init(params)
 
@@ -62,7 +66,7 @@ def main():
                 mutable=["batch_stats"])
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), lbls).mean()
-            return loss, updates["batch_stats"]
+            return loss, updates.get("batch_stats", {})
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(p)

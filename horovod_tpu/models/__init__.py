@@ -5,3 +5,4 @@ from .mlp import MLP  # noqa: F401
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,  # noqa: F401
                      ResNet152)
 from .transformer import Transformer, default_attention  # noqa: F401
+from .vgg import VGG, VGG16, VGG19  # noqa: F401

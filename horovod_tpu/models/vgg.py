@@ -1,0 +1,55 @@
+"""VGG (Simonyan & Zisserman, arXiv:1409.1556) — the third model of the
+reference's published scaling table (``docs/benchmarks.rst:12-13``: VGG-16
+reaches only 68% scaling efficiency at 512 GPUs, vs 90% for ResNet-101 /
+Inception V3 — its huge dense gradients stress the allreduce).
+
+TPU notes: convs/FCs in bf16 on the MXU with fp32 params (same policy as
+``resnet.py``); no batch norm in classic VGG, so there is no cross-replica
+stats question. The 100M+ fully-connected parameters that made VGG the
+reference's worst-scaling benchmark are exactly what the compression
+subsystem and the hierarchical/PowerSGD reducers exist for.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Conv widths per stage; "M" = 2x2 max-pool (the classic configs).
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    """VGG backbone + 4096-4096-classes head. Input: NHWC images."""
+
+    cfg: Sequence = _VGG16_CFG
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                                 dtype=self.dtype, param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        for item in self.cfg:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(conv(features=item)(x))
+        x = x.reshape((x.shape[0], -1))
+        dense = functools.partial(nn.Dense, dtype=self.dtype,
+                                  param_dtype=jnp.float32)
+        x = nn.relu(dense(4096)(x))
+        x = nn.relu(dense(4096)(x))
+        x = dense(self.num_classes)(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = functools.partial(VGG, cfg=_VGG16_CFG)
+VGG19 = functools.partial(VGG, cfg=_VGG19_CFG)

@@ -311,10 +311,10 @@ def flash_attention(q, k, v, causal: bool = True):
         raise NotImplementedError(
             "flash_attention is causal-only; use default_attention for "
             "bidirectional attention")
-    if k.shape[2] != q.shape[2]:
-        # GQA: repeat before the kernel (same policy as ring attention).
-        k = repeat_kv_heads(k, q.shape[2])
-        v = repeat_kv_heads(v, q.shape[2])
+    # GQA: repeat before the kernel (no-op when heads match; also
+    # validates BOTH k and v against the query head count).
+    k = repeat_kv_heads(k, q.shape[2])
+    v = repeat_kv_heads(v, q.shape[2])
     b, s, h, d = q.shape
     sm_scale = 1.0 / float(np.sqrt(d))
 

@@ -27,6 +27,9 @@ from jax import lax
 
 from .. import runtime
 from ..ops import collectives as C
+# Shared with flash attention; ops is the lower layer, so parallel imports
+# from it.
+from ..ops.flash_attention import repeat_kv_heads as _repeat_kv_heads
 
 SP_AXIS = "sp"
 
@@ -59,9 +62,7 @@ def _require_axis(axis: Optional[str], who: str) -> str:
     return ax
 
 
-# Shared with flash attention; ops is the lower layer, so parallel imports
-# from it (keeps the module graph one-directional).
-from ..ops.flash_attention import repeat_kv_heads as _repeat_kv_heads  # noqa: E402,E501
+
 
 
 def ring_attention_p(q, k, v, causal: bool = True,

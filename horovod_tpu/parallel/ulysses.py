@@ -20,7 +20,8 @@ from jax import lax
 
 from .. import runtime  # noqa: F401  (re-exported context for callers)
 from ..ops import collectives as C
-from .ring_attention import _default_axis, _repeat_kv_heads, _require_axis
+from .ring_attention import _default_axis, _require_axis
+from ..ops.flash_attention import repeat_kv_heads as _repeat_kv_heads
 
 
 def _heads_first(x, ax: str):
