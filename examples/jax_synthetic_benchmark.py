@@ -19,12 +19,13 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import (ResNet18, ResNet34, ResNet50, ResNet101,
-                                VGG16, VGG19)
+from horovod_tpu.models import (InceptionV3, ResNet18, ResNet34, ResNet50,
+                                ResNet101, ResNet152, VGG16, VGG19)
 
 MODELS = {"resnet18": ResNet18, "resnet34": ResNet34,
           "resnet50": ResNet50, "resnet101": ResNet101,
-          "vgg16": VGG16, "vgg19": VGG19}
+          "resnet152": ResNet152, "vgg16": VGG16, "vgg19": VGG19,
+          "inception3": InceptionV3}
 
 
 def main():

@@ -6,3 +6,4 @@ from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,  # noqa: F
                      ResNet152)
 from .transformer import Transformer, default_attention  # noqa: F401
 from .vgg import VGG, VGG16, VGG19  # noqa: F401
+from .inception import InceptionV3  # noqa: F401

@@ -1,0 +1,172 @@
+"""Inception V3 (Szegedy et al., arXiv:1512.00567) — the headline model of
+the reference's published scaling table (``docs/benchmarks.rst:8-13``: 90%
+scaling efficiency at 512 GPUs; also ``README.rst`` "Why Horovod?"). With
+ResNet-101 and VGG-16 this completes the zoo's coverage of that table.
+
+TPU notes: convs in bf16 on the MXU with fp32 params and fp32 batch-norm
+statistics (same policy as ``resnet.py``); the auxiliary classifier head is
+omitted — it exists as a training-regularization aid and contributes
+nothing to the throughput benchmark the table measures.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """Conv + BN + ReLU — the basic Inception unit."""
+
+    features: int
+    kernel: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32, axis_name=None)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(64, (1, 1))(x, train)
+        b5 = c(48, (1, 1))(x, train)
+        b5 = c(64, (5, 5))(b5, train)
+        b3 = c(64, (1, 1))(x, train)
+        b3 = c(96, (3, 3))(b3, train)
+        b3 = c(96, (3, 3))(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = c(self.pool_features, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid-size reduction 35x35 -> 17x17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = c(384, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        bd = c(64, (1, 1))(x, train)
+        bd = c(96, (3, 3))(bd, train)
+        bd = c(96, (3, 3), strides=(2, 2), padding="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches at 17x17."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = c(192, (1, 1))(x, train)
+        b7 = c(c7, (1, 1))(x, train)
+        b7 = c(c7, (1, 7))(b7, train)
+        b7 = c(192, (7, 1))(b7, train)
+        bd = c(c7, (1, 1))(x, train)
+        bd = c(c7, (7, 1))(bd, train)
+        bd = c(c7, (1, 7))(bd, train)
+        bd = c(c7, (7, 1))(bd, train)
+        bd = c(192, (1, 7))(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = c(192, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid-size reduction 17x17 -> 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = c(192, (1, 1))(x, train)
+        b3 = c(320, (3, 3), strides=(2, 2), padding="VALID")(b3, train)
+        b7 = c(192, (1, 1))(x, train)
+        b7 = c(192, (1, 7))(b7, train)
+        b7 = c(192, (7, 1))(b7, train)
+        b7 = c(192, (3, 3), strides=(2, 2), padding="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank blocks at 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = c(320, (1, 1))(x, train)
+        b3 = c(384, (1, 1))(x, train)
+        b3 = jnp.concatenate([c(384, (1, 3))(b3, train),
+                              c(384, (3, 1))(b3, train)], axis=-1)
+        bd = c(448, (1, 1))(x, train)
+        bd = c(384, (3, 3))(bd, train)
+        bd = jnp.concatenate([c(384, (1, 3))(bd, train),
+                              c(384, (3, 1))(bd, train)], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = c(192, (1, 1))(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 classifier (299x299 canonical input; any size >= 75
+    works — the head global-pools)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299 -> 35.
+        x = c(32, (3, 3), strides=(2, 2), padding="VALID")(x, train)
+        x = c(32, (3, 3), padding="VALID")(x, train)
+        x = c(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = c(80, (1, 1), padding="VALID")(x, train)
+        x = c(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 3x InceptionA, reduction, 4x InceptionC, reduction, 2x InceptionE.
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        x = InceptionC(128, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(192, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -62,9 +62,6 @@ def _require_axis(axis: Optional[str], who: str) -> str:
     return ax
 
 
-
-
-
 def ring_attention_p(q, k, v, causal: bool = True,
                      axis: Optional[str] = None,
                      q_positions=None, kv_positions=None):

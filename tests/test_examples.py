@@ -52,6 +52,9 @@ def _run_example(name, args, timeout=420):
     ("jax_synthetic_benchmark.py",
      ["--model", "vgg16", "--batch-size", "2", "--image-size", "32",
       "--num-warmup-batches", "1", "--num-iters", "2"], "vgg16"),
+    ("jax_synthetic_benchmark.py",
+     ["--model", "inception3", "--batch-size", "1", "--image-size", "96",
+      "--num-warmup-batches", "1", "--num-iters", "1"], "inception3"),
     # Not smoked here: elastic_train.py needs the elastic driver
     # (test_elastic.py covers it); ray_mnist.py needs a ray install
     # (gating covered in test_integrations.py).
