@@ -42,7 +42,9 @@ from .parallel.sharded_optimizer import ShardedDistributedOptimizer
 # Flat-vs-hierarchical calibration (reference: the parameter manager's
 # categorical hierarchical_allreduce switch, parameter_manager.h:186).
 from .parallel.strategy import (autotune_hierarchical, choose_hierarchical,
-                                clear_hierarchical_decisions)
+                                clear_hierarchical_decisions,
+                                load_hierarchical_decisions,
+                                save_hierarchical_decisions)
 
 # Sequence/context parallelism (TPU-first; no reference analog — SURVEY.md §2.7).
 from .parallel.ring_attention import (ring_attention, ring_attention_p,

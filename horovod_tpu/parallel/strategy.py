@@ -30,6 +30,8 @@ tuned. ``docs/parity.md`` records the same rationale.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -51,10 +53,79 @@ _decisions: Dict[Tuple, List[Tuple[int, str]]] = {}
 _lock = threading.Lock()
 _warned_uncalibrated = set()
 
+# Env-pointed persistence (reference: HOROVOD_AUTOTUNE_LOG,
+# ``parameter_manager.cc`` — tuned params survive the run and re-broadcast
+# on restart). ``autotune_hierarchical`` writes the file after calibrating;
+# ``choose_hierarchical`` loads it on the first uncalibrated query, so a
+# restarted training job keeps its decisions without re-measuring.
+_AUTOTUNE_LOG_ENV = "HVDTPU_AUTOTUNE_LOG"
+_env_loaded = False
+
 
 def _mesh_key(inner_axis: str, outer_axis: str) -> Tuple:
     shape = tuple(sorted(runtime.mesh().shape.items()))
     return (inner_axis, outer_axis, shape)
+
+
+def _key_to_str(key: Tuple) -> str:
+    return json.dumps([key[0], key[1], [list(p) for p in key[2]]])
+
+
+def _str_to_key(s: str) -> Tuple:
+    inner, outer, shape = json.loads(s)
+    return (inner, outer, tuple((a, int(n)) for a, n in shape))
+
+
+def save_hierarchical_decisions(path: Optional[str] = None) -> Optional[str]:
+    """Write the calibration table to ``path`` (default:
+    ``$HVDTPU_AUTOTUNE_LOG``) as JSON keyed on the (inner, outer,
+    mesh-shape) signature; returns the path written, or None when no path
+    is configured. Atomic (tmp + rename) so a crash mid-write never leaves
+    a truncated table for the next start to load."""
+    path = path or os.environ.get(_AUTOTUNE_LOG_ENV)
+    if not path:
+        return None
+    with _lock:
+        tables = {_key_to_str(k): [[int(s), c] for s, c in v]
+                  for k, v in _decisions.items()}
+    # MERGE with what's already on disk: one log file serves several
+    # topologies, so a job that only calibrated mesh B must not destroy
+    # mesh A's persisted table (this process may never have loaded it —
+    # the env auto-load only fires on an uncalibrated query). In-memory
+    # (fresher) entries win on key collision.
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                on_disk = json.load(f).get("tables", {})
+            tables = {**on_disk, **tables}
+        except Exception as exc:
+            log.warning(f"save_hierarchical_decisions: existing {path!r} "
+                        f"unreadable ({exc}); overwriting")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "tables": tables}, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_hierarchical_decisions(path: Optional[str] = None) -> int:
+    """Merge tables from ``path`` (default: ``$HVDTPU_AUTOTUNE_LOG``) into
+    the in-process decision table; returns how many mesh signatures were
+    loaded. Entries for OTHER mesh shapes load fine and simply never match
+    ``_mesh_key`` — one log file can serve several topologies."""
+    path = path or os.environ.get(_AUTOTUNE_LOG_ENV)
+    if not path or not os.path.exists(path):
+        return 0
+    with open(path) as f:
+        payload = json.load(f)
+    n = 0
+    with _lock:
+        for ks, table in payload.get("tables", {}).items():
+            key = _str_to_key(ks)
+            _decisions[key] = [(int(s), str(c)) for s, c in table]
+            _warned_uncalibrated.discard(key)
+            n += 1
+    return n
 
 
 def _variant_fn(kind: str, inner_axis: str, outer_axis: str):
@@ -115,13 +186,29 @@ def autotune_hierarchical(inner_axis: str, outer_axis: str,
 
     ``measure(kind, nbytes, inner_axis, outer_axis, reps) -> seconds`` is
     injectable for tests (bandwidth models) and for offline tables.
+
+    Multi-host: the coordinator's (process 0's) measurements are broadcast
+    to every process BEFORE choices are recorded — per-host wall clocks are
+    not bit-identical, so a near-tie could otherwise bake ``flat`` into one
+    host's traced step and ``hierarchical`` into another's, deadlocking the
+    mesh (reference: ``Controller::SynchronizeParameters``,
+    ``controller.cc:34`` — tuned params always ship from the coordinator).
+    With ``$HVDTPU_AUTOTUNE_LOG`` set, process 0 also persists the table
+    for the next start (reference: ``HOROVOD_AUTOTUNE_LOG``).
     """
     m = measure if measure is not None else _default_measure
+    sizes_sorted = sorted(sizes)
+    times = np.array(
+        [[m("flat", nb, inner_axis, outer_axis, reps),
+          m("hierarchical", nb, inner_axis, outer_axis, reps)]
+         for nb in sizes_sorted], np.float64)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        times = np.asarray(multihost_utils.broadcast_one_to_all(times))
     results = {}
     table: List[Tuple[int, str]] = []
-    for nbytes in sorted(sizes):
-        flat_s = m("flat", nbytes, inner_axis, outer_axis, reps)
-        hier_s = m("hierarchical", nbytes, inner_axis, outer_axis, reps)
+    for (flat_s, hier_s), nbytes in zip(times, sizes_sorted):
+        flat_s, hier_s = float(flat_s), float(hier_s)
         choice = "hierarchical" if hier_s < flat_s else "flat"
         results[nbytes] = (choice, flat_s, hier_s)
         table.append((nbytes, choice))
@@ -132,13 +219,23 @@ def autotune_hierarchical(inner_axis: str, outer_axis: str,
         key = _mesh_key(inner_axis, outer_axis)
         _decisions[key] = table
         _warned_uncalibrated.discard(key)
+    if jax.process_index() == 0:
+        try:
+            save_hierarchical_decisions()
+        except OSError as exc:
+            log.warning(f"autotune_hierarchical: could not persist table "
+                        f"to ${_AUTOTUNE_LOG_ENV}: {exc}")
     return results
 
 
 def clear_hierarchical_decisions() -> None:
+    global _env_loaded
     with _lock:
         _decisions.clear()
         _warned_uncalibrated.clear()
+        # A later uncalibrated query may re-load from $HVDTPU_AUTOTUNE_LOG
+        # (fresh-start semantics, same as a new process).
+        _env_loaded = False
 
 
 def choose_hierarchical(inner_axis: str, outer_axis: str,
@@ -148,9 +245,26 @@ def choose_hierarchical(inner_axis: str, outer_axis: str,
     SHAPE differs from the one the table was measured on — defaults to
     flat, with a one-time warning: the reference's default of hierarchical
     OFF until the parameter manager turns it on."""
+    global _env_loaded
     key = _mesh_key(inner_axis, outer_axis)
     with _lock:
         table = _decisions.get(key)
+    if not table and not _env_loaded \
+            and os.environ.get(_AUTOTUNE_LOG_ENV):
+        # First uncalibrated query of a fresh process: a prior run's
+        # persisted table (same mesh signature) beats re-measuring.
+        _env_loaded = True
+        try:
+            load_hierarchical_decisions()
+        except Exception as exc:
+            # ANY malformed log (bad JSON, wrong structure, unreadable
+            # file) takes the warn-and-default-flat path — a corrupt
+            # cache must never crash the training job's first step.
+            log.warning(f"choose_hierarchical: could not load "
+                        f"${_AUTOTUNE_LOG_ENV}: "
+                        f"{type(exc).__name__}: {exc}")
+        with _lock:
+            table = _decisions.get(key)
     if not table:
         if key not in _warned_uncalibrated:
             _warned_uncalibrated.add(key)
