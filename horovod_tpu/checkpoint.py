@@ -47,8 +47,17 @@ def _exists(path: str) -> bool:
     try:  # epath ships with orbax and understands gs:// etc.
         from etils import epath
         return epath.Path(path).exists()
-    except Exception:
-        return True  # can't probe: let orbax decide (may create layout)
+    except Exception as exc:
+        # An unreachable or unprovisioned remote path must fail HERE with a
+        # clear message: returning True would let the caller's manager
+        # mkdir an empty orbax layout (or die in an opaque orbax-internal
+        # error), breaking the probe-friendly contract documented for the
+        # local case (round-4 advisor finding).
+        raise RuntimeError(
+            f"cannot probe remote checkpoint path {path!r} "
+            f"({type(exc).__name__}: {exc}); refusing to construct a "
+            "checkpoint manager that could create an empty layout there"
+        ) from exc
 
 
 def _manager(path: str, keep: Optional[int] = None):

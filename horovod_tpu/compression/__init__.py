@@ -86,7 +86,8 @@ from .error_feedback import (init_error_feedback,  # noqa: E402
                              compress_with_feedback)
 from .reducers import (compressed_allreduce,  # noqa: E402
                        compressed_grouped_allreduce,
-                       hierarchical_compressed_allreduce_p)
+                       hierarchical_compressed_allreduce_p,
+                       hierarchical_compressed_residual_zeros)
 from .powersgd import (PowerSGDState, powersgd_init,  # noqa: E402
                        powersgd_allreduce_p, powersgd_state_specs,
                        PowerSGDOptimizer)

@@ -294,6 +294,22 @@ _REDUCERS = {
 }
 
 
+def hierarchical_compressed_residual_zeros(x, inner_axis: str):
+    """Shard-shaped zeros that BOOTSTRAP error feedback for
+    :func:`hierarchical_compressed_allreduce_p`.
+
+    The residual lives on the inner-reduce-scattered shard, whose layout —
+    flatten, pad to a multiple of ``n_inner``, scatter — is internal to
+    ``collectives._hierarchical_sum_frame``; this helper owns that shape so
+    callers never have to reverse-engineer it (round-4 advisor finding: the
+    docstring demanded 'zeros of the returned residual's shape', a shape
+    only discoverable from a call that already passed a residual). In-step
+    only (reads the axis size from the trace)."""
+    n_inner = lax.axis_size(inner_axis)
+    size = -(-int(np.prod(x.shape)) // n_inner)
+    return jnp.zeros((int(size),), x.dtype)
+
+
 def hierarchical_compressed_allreduce_p(
         x, compressor, inner_axis: str = None, outer_axis: str = None,
         reduction: str = "scatter_allgather",
@@ -310,12 +326,17 @@ def hierarchical_compressed_allreduce_p(
     by n_inner.
 
     ``residual`` (error feedback) is SHARD-shaped — state for the
-    compressed hop only; pass the previous call's returned residual, or
-    zeros of the returned residual's shape to start.
+    compressed hop only. To start, pass ``residual="init"`` (or ``True``),
+    which bootstraps zeros of the right internal shape (equivalently:
+    :func:`hierarchical_compressed_residual_zeros`); thereafter pass the
+    previous call's returned residual.
     """
     if inner_axis is None or outer_axis is None:
         raise ValueError("hierarchical_compressed_allreduce_p needs explicit "
                          "inner_axis (ICI) and outer_axis (DCN)")
+    if residual is True or (isinstance(residual, str) and
+                            residual == "init"):
+        residual = hierarchical_compressed_residual_zeros(x, inner_axis)
     if reduction not in _REDUCERS:
         raise ValueError(f"unknown reduction {reduction!r}; "
                          f"choose from {sorted(_REDUCERS)}")

@@ -109,7 +109,13 @@ def _fused_two_axis_allreduce(grads, op, inner: str, outer: str,
     (no flattened fused form).
     """
     def reduce_buffer(buf, inv_inner, inv_outer):
-        if not flat:
+        if not flat or op == C.ReduceOp.ADASUM:
+            # ADASUM ignores the calibrated-flat choice: adasum_p is a
+            # single-axis algorithm (no tuple-axis form), and VHDD is
+            # *defined* as sum within the fast axis + Adasum across the
+            # slow one — the hierarchical program IS Adasum's shape
+            # (round-4 advisor finding: the flat arm forwarded ADASUM
+            # into a tuple-axis allreduce_p).
             return C.hierarchical_allreduce_p(
                 buf, op=op, inner_axis=inner, outer_axis=outer,
                 prescale_factor=prescale, postscale_factor=postscale)

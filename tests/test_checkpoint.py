@@ -143,3 +143,19 @@ def test_resume_training_mid_run(spmd8, tmp_path):
                                rtol=1e-6)
     np.testing.assert_allclose(np.asarray(p2["w"]),
                                np.asarray(params["w"]), rtol=1e-6)
+
+
+def test_unreachable_remote_probe_raises_clearly(monkeypatch):
+    """A remote path whose existence probe fails must raise a clear error
+    instead of letting the manager mkdir an empty orbax layout or die in
+    an opaque orbax-internal error (round-4 advisor finding)."""
+    import etils.epath
+
+    def boom(path):
+        raise OSError("no credentials / unreachable endpoint")
+
+    monkeypatch.setattr(etils.epath, "Path", boom)
+    with pytest.raises(RuntimeError, match="cannot probe remote"):
+        hvd.latest_checkpoint_step("gs://some-bucket/ckpt")
+    with pytest.raises(RuntimeError, match="refusing to construct"):
+        hvd.restore_checkpoint("gs://some-bucket/ckpt")

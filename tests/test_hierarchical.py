@@ -390,3 +390,34 @@ def test_hierarchical_allgather_via_public_api(mesh42):
                                rtol=1e-6)
     with pytest.raises(ValueError, match="in-step only"):
         hvd.allgather(jnp.ones((2, 2)), hierarchical=("ici", "dcn"))
+
+def test_hierarchical_compressed_residual_bootstrap(mesh42):
+    """residual="init" bootstraps error feedback without the caller knowing
+    the internal shard layout (round-4 advisor finding: the documented
+    'zeros of the returned residual's shape' was undiscoverable). The
+    returned residual feeds the next call unchanged."""
+    from horovod_tpu.compression import (MaxMinQuantizer,
+                                         hierarchical_compressed_allreduce_p)
+    comp = MaxMinQuantizer(bits=4, use_pallas=False)
+    vals = _per_rank_values((48,), seed=31)
+
+    def body(x):
+        y1, res1 = hierarchical_compressed_allreduce_p(
+            x, comp, inner_axis="ici", outer_axis="dcn", op=hvd.Average,
+            residual="init")
+        y2, res2 = hierarchical_compressed_allreduce_p(
+            x, comp, inner_axis="ici", outer_axis="dcn", op=hvd.Average,
+            residual=res1)
+        return y1, y2, res1, res2
+
+    step = hvd.run_step(body, in_specs=P(("dcn", "ici")),
+                        out_specs=(hvd.REPLICATED, hvd.REPLICATED,
+                                   P(("dcn", "ici")), P(("dcn", "ici"))))
+    y1, y2, res1, res2 = step(jnp.asarray(vals.reshape(-1)))
+    expect = vals.mean(axis=0)
+    scale = np.abs(vals.sum(axis=0)).max() / 15.0 / 8.0 * 2
+    np.testing.assert_allclose(np.asarray(y1), expect, atol=max(scale, 1e-4))
+    # Error feedback: the second call's result (fed the first residual)
+    # must not be wildly off either, and residual shapes must agree.
+    assert res1.shape == res2.shape
+    np.testing.assert_allclose(np.asarray(y2), expect, atol=max(scale, 1e-4))
