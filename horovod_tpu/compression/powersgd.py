@@ -66,7 +66,8 @@ def _orthonormalize(p):
 
 
 def powersgd_init(grads, rank: int = 2, seed: int = 0,
-                  world_size: int = 1) -> PowerSGDState:
+                  world_size: int = 1,
+                  max_residual_bytes: Optional[int] = None) -> PowerSGDState:
     """State for :func:`powersgd_allreduce_p`: random-normal warm-start Q
     per matrix leaf (deterministic per leaf index so every rank starts with
     the SAME factors — required for correctness), zero residuals.
@@ -75,8 +76,44 @@ def powersgd_init(grads, rank: int = 2, seed: int = 0,
     (``run_step``'s in/out arrays) they stack over the mesh axis on dim 0,
     so pass the axis size and shard the ``errors`` leaves with
     :func:`powersgd_state_specs`; ``world_size=1`` gives local-shaped state
-    for hand-managed per-device setups."""
+    for hand-managed per-device setups.
+
+    **Memory**: the global residual tree is fp32 of ``world_size × rows ×
+    cols`` PER matrix leaf — ``world_size`` times the (fp32) gradient
+    memory. Sharded with :func:`powersgd_state_specs` the per-device cost
+    is one gradient copy, which is fine; but REPLICATING these leaves
+    (``P()`` specs, or forgetting the specs) multiplies HBM use by the
+    world size. ``max_residual_bytes`` (or ``$HVDTPU_POWERSGD_RESIDUAL_CAP``)
+    raises above a hard cap; without a cap, a global residual tree over
+    ``$HVDTPU_POWERSGD_RESIDUAL_WARN`` bytes (default 1 GiB) logs a
+    warning pointing at the sharding specs."""
+    import os
+
+    from ..utils import logging as log
+
     leaves = jax.tree.leaves(grads)
+    residual_bytes = sum(
+        4 * world_size * _as_matrix(leaf).shape[0] * _as_matrix(leaf).shape[1]
+        for leaf in leaves if leaf.ndim >= 2)
+    cap = max_residual_bytes
+    if cap is None and os.environ.get("HVDTPU_POWERSGD_RESIDUAL_CAP"):
+        cap = int(os.environ["HVDTPU_POWERSGD_RESIDUAL_CAP"])
+    if cap is not None and residual_bytes > cap:
+        raise ValueError(
+            f"PowerSGD residual state would take {residual_bytes:,} bytes "
+            f"globally (world_size={world_size} x fp32 gradient size), over "
+            f"the {cap:,}-byte cap — shard it with powersgd_state_specs "
+            "(per-device cost is then one gradient copy), lower world_size, "
+            "or raise the cap")
+    warn_at = int(os.environ.get("HVDTPU_POWERSGD_RESIDUAL_WARN",
+                                 1 << 30))
+    if cap is None and residual_bytes > warn_at:
+        log.warning(
+            f"PowerSGD residual state is {residual_bytes / (1 << 30):.1f} "
+            f"GiB globally (world_size={world_size} x fp32 gradients) — "
+            "make sure the errors leaves are SHARDED via "
+            "powersgd_state_specs; replicated, they cost this much on "
+            "EVERY device")
     qs, errors = [], []
     for i, leaf in enumerate(leaves):
         if leaf.ndim >= 2:

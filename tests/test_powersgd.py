@@ -163,3 +163,33 @@ def test_state_leaf_mismatch_raises(spmd8):
         powersgd_allreduce_p({"a": x, "b": x}, state, axis="dp")
     with pytest.raises(ValueError, match="rank"):
         powersgd_allreduce_p({"w": x}, state, axis="dp", rank=4)
+
+
+def test_residual_bytes_cap_raises():
+    """The global residual tree is world_size x the fp32 gradient memory;
+    a configurable cap must refuse a blowup instead of silently eating
+    HBM (round-4 verdict #9)."""
+    import pytest
+
+    from horovod_tpu.compression import powersgd_init
+    grads = {"w": jnp.zeros((64, 64), jnp.float32)}
+    # 8 * 64*64*4 = 131072 bytes > 1000-byte cap.
+    with pytest.raises(ValueError, match="powersgd_state_specs"):
+        powersgd_init(grads, rank=2, world_size=8, max_residual_bytes=1000)
+    # Under the cap: fine.
+    st = powersgd_init(grads, rank=2, world_size=8,
+                       max_residual_bytes=1 << 20)
+    assert st.errors[0].shape == (8 * 64, 64)
+
+
+def test_residual_warn_threshold(monkeypatch):
+    """No cap + a large residual tree logs a warning pointing at the
+    sharding specs."""
+    from horovod_tpu.compression import powersgd_init
+    from horovod_tpu.utils import logging as hlog
+    msgs = []
+    monkeypatch.setattr(hlog, "warning", msgs.append)
+    monkeypatch.setenv("HVDTPU_POWERSGD_RESIDUAL_WARN", "1000")
+    grads = {"w": jnp.zeros((64, 64), jnp.float32)}
+    powersgd_init(grads, rank=2, world_size=8)
+    assert any("SHARDED" in m for m in msgs)
