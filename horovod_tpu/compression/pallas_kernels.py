@@ -20,6 +20,9 @@ from jax.experimental import pallas as pl
 BUCKET_BLOCK = 256  # buckets per grid step (BUCKET_BLOCK x bucket_size fp32)
 
 
+from ..ops.pallas_util import out_vma as _out_vma  # noqa: E402
+
+
 def _quantize_kernel(levels: int, x_ref, q_ref, mn_ref, unit_ref):
     x = x_ref[:]
     mn = jnp.min(x, axis=1, keepdims=True)
@@ -99,8 +102,10 @@ def norm_quantize_pallas(flat: jnp.ndarray, levels: jnp.ndarray,
             pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8),
-            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
+            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8,
+                                 vma=_out_vma(x)),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32,
+                                 vma=_out_vma(x)),
         ],
         interpret=interpret,
     )(x, levels.astype(jnp.float32))
@@ -148,7 +153,8 @@ def norm_dequantize_pallas(q: jnp.ndarray, levels: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((BUCKET_BLOCK, bucket), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded_buckets, bucket),
-                                       jnp.float32),
+                                       jnp.float32,
+                                       vma=_out_vma(qp, np_)),
         interpret=interpret,
     )(qp, levels.astype(jnp.float32), np_)
     return out[:n_buckets]
@@ -182,9 +188,12 @@ def maxmin_quantize_pallas(flat: jnp.ndarray, bits: int, bucket_size: int,
             pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8),
-            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
-            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
+            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8,
+                                 vma=_out_vma(x)),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32,
+                                 vma=_out_vma(x)),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32,
+                                 vma=_out_vma(x)),
         ],
         interpret=interpret,
     )(x)
@@ -251,9 +260,12 @@ def maxmin_quantize_stochastic_pallas(flat: jnp.ndarray, bits: int,
             pl.BlockSpec((BUCKET_BLOCK, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8),
-            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
-            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32),
+            jax.ShapeDtypeStruct((padded_buckets, bucket_size), jnp.uint8,
+                                 vma=_out_vma(x, seed)),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32,
+                                 vma=_out_vma(x, seed)),
+            jax.ShapeDtypeStruct((padded_buckets, 1), jnp.float32,
+                                 vma=_out_vma(x, seed)),
         ],
     )(x, seed.reshape(1).astype(jnp.int32))
     return (q[:n_buckets], mn[:n_buckets, 0], unit[:n_buckets, 0])
@@ -295,7 +307,8 @@ def maxmin_dequantize_sum_pallas(q: jnp.ndarray, mn: jnp.ndarray,
             pl.BlockSpec((n_ranks, BUCKET_BLOCK, 1), lambda i: (0, i, 0)),
         ],
         out_specs=pl.BlockSpec((BUCKET_BLOCK, bucket), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((padded_buckets, bucket), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((padded_buckets, bucket), jnp.float32,
+                                       vma=_out_vma(qp, mnp, up)),
         interpret=interpret,
     )(qp, mnp, up)
     return out[:n_buckets]
@@ -323,7 +336,8 @@ def maxmin_dequantize_pallas(q: jnp.ndarray, mn: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((BUCKET_BLOCK, bucket_size), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded_buckets, bucket_size),
-                                       jnp.float32),
+                                       jnp.float32,
+                                       vma=_out_vma(qp, mnp, up)),
         interpret=interpret,
     )(qp, mnp, up)
     return out[:n_buckets]

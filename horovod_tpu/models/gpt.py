@@ -52,7 +52,10 @@ class GPTConfig:
     tp_axis: Optional[str] = "tp"
     sp_axis: Optional[str] = "sp"
     ep_axis: Optional[str] = None
-    attention: str = "ring"         # "ring" | "ulysses" | "dense" | "flash"
+    # "ring" | "ulysses" | "dense" | "flash" | "ulysses_flash"
+    # (ulysses_flash = Ulysses head/sequence exchange with the fused Pallas
+    # flash kernel as the per-device full-sequence attention)
+    attention: str = "ring"
     # MoE (active when moe_every > 0): every moe_every-th block is a switch
     # layer with num_experts experts.
     moe_every: int = 0
@@ -178,10 +181,17 @@ def _attention(cfg: GPTConfig, q, k, v):
         if _axis_bound(sp):
             raise ValueError(
                 "attention='flash' is local attention; with a bound sp "
-                "axis use 'ring' or 'ulysses' (their per-device blocks "
-                "can adopt the flash kernel internally)")
+                "axis use 'ring', 'ulysses', or 'ulysses_flash' (the "
+                "flash kernel as Ulysses' per-device attention)")
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True)
+    if cfg.attention == "ulysses_flash":
+        from ..ops.flash_attention import flash_attention
+        if not _axis_bound(sp):
+            return flash_attention(q, k, v, causal=True)
+        from ..parallel.ulysses import ulysses_attention_p
+        return ulysses_attention_p(q, k, v, causal=True, axis=sp,
+                                   attn_fn=flash_attention)
     if not _axis_bound(sp) or cfg.attention == "dense":
         return default_attention(q, k, v, causal=True)
     if cfg.attention == "ring":

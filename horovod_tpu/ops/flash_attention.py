@@ -55,6 +55,9 @@ def _use_interpret() -> bool:
     return not _pallas_backend_enabled(None)
 
 
+from .pallas_util import out_vma as _out_vma  # noqa: E402
+
+
 def repeat_kv_heads(k, n_q_heads: int):
     """Grouped-query attention: tile K/V heads up to the query head count
     (the compact heads are what cross the wire; the repeat is local).
@@ -215,8 +218,10 @@ def _fwd_call(q, k, v, sm_scale, interpret):
             pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype,
+                                 vma=_out_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32,
+                                 vma=_out_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((BLOCK_Q, 1), jnp.float32),   # running max
@@ -266,8 +271,10 @@ def _flash_bhsd_bwd(sm_scale, res, do):
             pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype,
+                                 vma=_out_vma(q, k, v, do)),
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype,
+                                 vma=_out_vma(q, k, v, do)),
         ],
         scratch_shapes=[
             pltpu.VMEM((BLOCK_K, d), jnp.float32),
@@ -289,7 +296,8 @@ def _flash_bhsd_bwd(sm_scale, res, do):
             pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),       # delta
         ],
         out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype,
+                                       vma=_out_vma(q, k, v, do)),
         scratch_shapes=[pltpu.VMEM((BLOCK_Q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)

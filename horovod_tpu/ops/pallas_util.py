@@ -1,0 +1,18 @@
+"""Shared Pallas helpers (no deps — importable from any kernel module)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def out_vma(*args) -> frozenset:
+    """Union of the inputs' varying-mesh-axes sets, for ``pallas_call``
+    out-shape annotation. A pallas_call inside a ``check_vma=True``
+    shard_map (the compressed reducers' collective programs; the flash
+    kernel as Ulysses' inner attention) must declare how its outputs vary
+    across mesh axes — and a per-shard kernel's outputs vary exactly as
+    its inputs do. Empty (a no-op) outside shard_map."""
+    vma = frozenset()
+    for a in args:
+        vma |= getattr(jax.typeof(a), "vma", frozenset())
+    return vma

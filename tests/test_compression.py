@@ -683,3 +683,31 @@ class TestReviewRegressions:
         out = np.asarray(step(x))
         # average of identical shards == shard; sgd(1.0) negates.
         np.testing.assert_allclose(out, -4.0, atol=0.05)
+
+
+def test_pallas_kernels_run_inside_mesh_program(spmd8):
+    """Quantize kernels round-trip per-shard inside a shard_map program.
+
+    The out-shape VMA annotations (``pallas_kernels._out_vma``) make the
+    COMPILED kernels traceable inside ``check_vma=True`` shard_map on TPU
+    (the compressed reducers' collective programs); the flash kernel
+    proves that path under checked vma in
+    ``test_ulysses_with_flash_inner_matches_reference``. Interpret-mode
+    discharge of these kernels under checked vma trips an upstream JAX
+    limitation (kernel-internal consts get empty vma; JAX's error says to
+    file an issue and pass check_vma=False), so this CPU test runs the
+    mesh program unchecked."""
+    from horovod_tpu.compression import pallas_kernels as pk
+
+    n = 64
+    vals = np.arange(8 * n, dtype=np.float32) / (8 * n)
+
+    def body(x):
+        q, mn, unit = pk.maxmin_quantize_pallas(x, 8, 32, True)
+        out = pk.maxmin_dequantize_pallas(q, mn, unit, 32, True)
+        return out.reshape(-1)[:x.shape[0]]
+
+    got = jax.shard_map(body, mesh=hvd.mesh(), in_specs=P("dp"),
+                        out_specs=P("dp"), check_vma=False)(
+                            jnp.asarray(vals))
+    np.testing.assert_allclose(np.asarray(got), vals, atol=1.5 / 255)
