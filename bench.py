@@ -545,6 +545,8 @@ def _compression_ab(jax, jnp):
     import numpy as np
 
     from horovod_tpu.compression import MaxMinQuantizer
+    from horovod_tpu.compression.ab import (crossover_gbps,
+                                            projected_step_seconds)
     from horovod_tpu.compression.reducers import _dequant_sum_stacked
 
     nbytes = 16 << 20
@@ -570,19 +572,18 @@ def _compression_ab(jax, jnp):
     comp_bytes = sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
                      for leaf in jax.tree.leaves(payload))
     compute_ms = q_ms + dq_ms
-    saved_bytes = 2 * (nbytes - comp_bytes)  # both ring directions
-    # Crossover: dense_wire - compressed_wire == compression compute.
-    crossover_gbps = saved_bytes * 8 / (compute_ms / 1e3) / 1e9 \
-        if compute_ms > 0 else None
+    # Shared wire model (horovod_tpu.compression.ab — crossover pinned by
+    # tests/test_compression_ab.py): dense_wire - compressed_wire ==
+    # compression compute at exactly the crossover link speed.
+    xover = crossover_gbps(nbytes, comp_bytes, compute_ms / 1e3)
     table = []
     for gbps in (3.0, 10.0, 25.0, 100.0, 400.0):
-        bw = gbps * 1e9 / 8
-        dense_ms = 2 * nbytes / bw * 1e3
-        compressed_ms = 2 * comp_bytes / bw * 1e3 + compute_ms
-        table.append({"gbps": gbps, "dense_ms": round(dense_ms, 3),
-                      "compressed_ms": round(compressed_ms, 3),
+        dense_s, compressed_s = projected_step_seconds(
+            nbytes, comp_bytes, compute_ms / 1e3, gbps)
+        table.append({"gbps": gbps, "dense_ms": round(dense_s * 1e3, 3),
+                      "compressed_ms": round(compressed_s * 1e3, 3),
                       "winner": "compressed"
-                      if compressed_ms < dense_ms else "dense"})
+                      if compressed_s < dense_s else "dense"})
     return {
         "model": ("ring allreduce across 2 slices; wire = 2*bytes/bw; "
                   "quantize/dequant measured on-chip (warm, fenced)"),
@@ -590,8 +591,12 @@ def _compression_ab(jax, jnp):
         "compressed_wire_bytes": int(comp_bytes),
         "compression_ratio": round(nbytes / comp_bytes, 2),
         "quantize_ms": round(q_ms, 3), "dequant_sum_ms": round(dq_ms, 3),
-        "crossover_gbps": round(crossover_gbps, 2)
-        if crossover_gbps else None,
+        # inf (free-compute always-wins sentinel) is not valid JSON; it
+        # cannot arise from a measured compute_ms but the output contract
+        # must hold regardless.
+        "crossover_gbps": (None if xover is None else
+                           round(xover, 2) if np.isfinite(xover)
+                           else "always"),
         "note": ("compressed wins below crossover_gbps link speed — DCN "
                  "regime; ICI (~100+ GB/s) correctly favors dense"),
         "table": table,

@@ -92,3 +92,5 @@ from .powersgd import (PowerSGDState, powersgd_init,  # noqa: E402
                        powersgd_allreduce_p, powersgd_state_specs,
                        PowerSGDOptimizer)
 from .config import CompressionConfig, make_compressor, from_env  # noqa: E402
+from .ab import (autotune_compressed, crossover_gbps,  # noqa: E402
+                 payload_nbytes, projected_step_seconds)
