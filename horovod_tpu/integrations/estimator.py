@@ -139,15 +139,27 @@ class Estimator:
             raise ValueError(
                 "num_proc requires a Spark DataFrame or a parquet directory "
                 "path; in-memory (x, y) data trains on the local mesh only")
+        if num_proc and spark_df is not None:
+            # Fail BEFORE materializing the dataset: num_proc fans out via
+            # horovod_tpu.spark.run, which needs a live SparkSession — a
+            # pandas-backed frame can never provide one, and the eventual
+            # ImportError would point at pyspark instead of num_proc.
+            from ..spark.pandas_df import PandasDataFrame
+            if isinstance(spark_df, PandasDataFrame):
+                raise ValueError(
+                    "num_proc fan-out needs a real Spark DataFrame (live "
+                    "SparkSession); a pandas-backed frame trains on the "
+                    "local mesh — drop num_proc")
         # The validation form must match the data form — a mismatch would
         # otherwise die deep inside pyarrow/Spark with an opaque error.
         if validation is not None:
-            if spark_df is not None and \
-                    self._as_spark_df(validation) is None and \
-                    not isinstance(validation, float):
-                raise ValueError(
-                    "validation must be a Spark DataFrame or a float "
-                    "fraction when fitting a Spark DataFrame")
+            if spark_df is not None and not isinstance(validation, float):
+                val_df = self._as_spark_df(validation)
+                if val_df is None:
+                    raise ValueError(
+                        "validation must be a Spark DataFrame or a float "
+                        "fraction when fitting a Spark DataFrame")
+                validation = val_df  # keep any auto-wrap (raw pandas)
             if spark_df is None and isinstance(data, str) and \
                     not isinstance(validation, str):
                 raise ValueError(
@@ -213,11 +225,26 @@ class Estimator:
 
     # ------------------------------------------------------------------
     def _as_spark_df(self, data):
-        try:
-            from pyspark.sql import DataFrame as SparkDataFrame
-        except ImportError:
+        """``data`` as a DataFrame, else None. Duck-typed on the exact API
+        slice ``prepare_data`` consumes (count/repartition/randomSplit/
+        write) rather than isinstance-gated on pyspark, so
+        :class:`~horovod_tpu.spark.PandasDataFrame` — and e.g. Spark
+        Connect frames — take the same DataFrame→parquet→train path a
+        classic ``pyspark.sql.DataFrame`` does. A RAW ``pandas.DataFrame``
+        is auto-wrapped (it has ``count`` but not the rest — falling
+        through to the (x, y) tuple-unpack path would die with an opaque
+        error far from the cause). (x, y) tuples, arrays, and path strings
+        don't expose the slice and fall through."""
+        from ..spark.pandas_df import PandasDataFrame, is_dataframe_like
+        if isinstance(data, (str, bytes, tuple, list)):
             return None
-        return data if isinstance(data, SparkDataFrame) else None
+        try:
+            import pandas as pd
+            if isinstance(data, pd.DataFrame):
+                return PandasDataFrame(data)
+        except ImportError:
+            pass
+        return data if is_dataframe_like(data) else None
 
     def _fit_arrays(self, x, y, validation=None) -> EstimatorModel:
         import numpy as np

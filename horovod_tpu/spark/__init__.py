@@ -20,10 +20,11 @@ import time
 from typing import Any, Callable, Optional
 
 __all__ = ["run", "run_elastic", "Store", "LocalStore", "FilesystemStore",
-           "HDFSStore", "DBFSLocalStore"]
+           "HDFSStore", "DBFSLocalStore", "PandasDataFrame"]
 
 from .store import (Store, LocalStore, FilesystemStore,  # noqa: E402,F401
                     HDFSStore, DBFSLocalStore)
+from .pandas_df import PandasDataFrame  # noqa: E402,F401
 
 _POLL_S = 0.25
 
