@@ -1,0 +1,36 @@
+"""Worker: drives the exact Ray actor task body as a real process — the
+``_Worker`` actor class, ``_Coordinator`` topology-env stamping, and
+``RayExecutor._under_runtime``'s init/run/shutdown wrapper — with no ray
+installed (no-install blocker, docs/parity.md): only ray's actor TRANSPORT
+remains stand-in-tested. Args: <rank> <num_proc> <controller_port>."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np  # noqa: E402
+
+rank, num_proc, port = (int(a) for a in sys.argv[1:4])
+
+from horovod_tpu.integrations.ray import (  # noqa: E402
+    RayExecutor, _Coordinator, _make_worker_cls)
+
+worker = _make_worker_cls(None)()
+coord = _Coordinator(["localhost"] * num_proc, "127.0.0.1", port)
+worker.set_env(coord.env_for(rank))
+
+
+def train(offset):
+    import horovod_tpu as hvd
+    assert hvd.size() == num_proc
+    assert hvd.local_size() == num_proc and hvd.cross_size() == 1
+    out = hvd.allreduce(
+        np.full((4,), float(hvd.rank() + offset), np.float32),
+        name="ray.t", op=hvd.Sum)
+    expect = float(sum(range(num_proc)) + num_proc * offset)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), expect))
+    return ("rank", hvd.rank())
+
+
+result = worker.execute_args(RayExecutor._under_runtime(train), (1,), {})
+assert result == ("rank", rank), result
+print("ALL OK")

@@ -242,6 +242,30 @@ class TestRayExecutor:
         with pytest.raises(ValueError, match="num_hosts"):
             self._executor(monkeypatch, ["n0"], num_slots=4)
 
+    def test_actor_task_body_real_processes(self):
+        """The exact code a Ray actor runs — _Worker + _Coordinator env
+        stamping + _under_runtime init/collective/shutdown — as REAL
+        processes doing a REAL rendezvous + allreduce (ray itself cannot be
+        installed here; only its actor transport remains stand-in-tested —
+        docs/parity.md). Reference: test/test_ray.py's local-cluster
+        executor smoke."""
+        import subprocess
+        import sys
+        from conftest import free_port, subprocess_env
+
+        worker = os.path.join(os.path.dirname(__file__), "data",
+                              "ray_task_worker.py")
+        port = free_port()
+        n = 2
+        procs = [subprocess.Popen(
+            [sys.executable, worker, str(r), str(n), str(port)],
+            env=subprocess_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True) for r in range(n)]
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"rank {r}:\n{err}\n{out}"
+            assert "ALL OK" in out
+
     def test_create_settings(self, monkeypatch):
         import sys
         monkeypatch.setitem(sys.modules, "ray", _FakeRay(["h"]))
