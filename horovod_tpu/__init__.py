@@ -63,7 +63,7 @@ from .functions import broadcast_object, allgather_object
 # Sharded checkpointing (orbax-backed; TPU-first — the reference leaves
 # checkpoint format to the user framework, SURVEY.md §5).
 from .checkpoint import (save_checkpoint, restore_checkpoint,
-                         latest_checkpoint_step)
+                         latest_checkpoint_step, checkpoint_metadata)
 
 # Compiled-step helpers (TPU-native).
 from .step import (run_step, data_parallel_step, shard_batch, replicate,

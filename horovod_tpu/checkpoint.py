@@ -17,6 +17,8 @@ surface over it, sharding-aware on both sides.
   WITH the template's sharding (device-direct, no host round-trip);
   otherwise arrays come back as numpy.
 * ``latest_checkpoint_step(path)``: highest saved step, or None.
+* ``checkpoint_metadata(path, step=None)``: the saved tree's shapes/dtypes
+  as ``ShapeDtypeStruct``s, read without touching array data.
 
 Pairs with the elastic ``State`` (in-memory commit/restore across failures)
 — this is the durable cross-restart layer.
@@ -64,7 +66,10 @@ def _manager(path: str, keep: Optional[int] = None):
     import orbax.checkpoint as ocp
     options = ocp.CheckpointManagerOptions(max_to_keep=keep) \
         if keep is not None else None
-    return ocp.CheckpointManager(_resolve(path), options=options)
+    # The explicit handler (vs. letting the manager infer one) is what
+    # makes item_metadata() work — checkpoint_metadata() depends on it.
+    return ocp.CheckpointManager(_resolve(path), options=options,
+                                 item_handlers=ocp.StandardCheckpointHandler())
 
 
 def save_checkpoint(path: str, tree: Any, step: int = 0,
@@ -101,6 +106,34 @@ def latest_checkpoint_step(path: str) -> Optional[int]:
         return mgr.latest_step()
 
 
+def _metadata_from(mgr, step: int) -> Any:
+    """Saved-tree ShapeDtypeStructs via an EXISTING manager (elastic states
+    hold a persistent one — reconstructing would re-list the possibly
+    remote step directory)."""
+    md = mgr.item_metadata(step)
+    tree = getattr(md, "tree", md)
+    return jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(tuple(leaf.shape),
+                                          np.dtype(leaf.dtype)),
+        tree, is_leaf=lambda leaf: hasattr(leaf, "shape"))
+
+
+def checkpoint_metadata(path: str, step: Optional[int] = None) -> Any:
+    """Shape/dtype metadata of a saved checkpoint as a pytree of
+    ``jax.ShapeDtypeStruct`` — read from orbax's metadata files WITHOUT
+    touching the array data. Lets a restore build its template (or size a
+    buffer of unknown length) for the cost of one small-file read instead
+    of a full untemplated restore."""
+    if not _exists(path):
+        raise FileNotFoundError(f"no checkpoint directory at {path!r}")
+    with _manager(path) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint steps under {path!r}")
+        return _metadata_from(mgr, step)
+
+
 def restore_checkpoint(path: str, template: Any = None,
                        step: Optional[int] = None) -> Any:
     """Restore a checkpoint saved by :func:`save_checkpoint`.
@@ -109,7 +142,6 @@ def restore_checkpoint(path: str, template: Any = None,
     target structure; jax-array leaves restore directly onto their
     shardings. ``step=None`` restores the latest.
     """
-    import orbax.checkpoint as ocp
     if not _exists(path):
         # Probe-friendly: a fresh-start check must not mkdir an empty
         # orbax layout as a side effect.
@@ -120,17 +152,23 @@ def restore_checkpoint(path: str, template: Any = None,
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoint steps under {path!r}")
-        if template is None:
-            return mgr.restore(step)
+        return _restore_from(mgr, step, template)
 
-        def to_restore_arg(leaf):
-            if isinstance(leaf, jax.Array):
-                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
-                                            sharding=leaf.sharding)
-            if isinstance(leaf, jax.ShapeDtypeStruct):
-                return leaf
-            arr = np.asarray(leaf)
-            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
-        target = jax.tree.map(to_restore_arg, template)
-        return mgr.restore(step, args=ocp.args.StandardRestore(target))
+def _restore_from(mgr, step: int, template: Any = None) -> Any:
+    """Restore via an EXISTING manager (see :func:`_metadata_from`)."""
+    import orbax.checkpoint as ocp
+    if template is None:
+        return mgr.restore(step)
+
+    def to_restore_arg(leaf):
+        if isinstance(leaf, jax.Array):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                        sharding=leaf.sharding)
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            return leaf
+        arr = np.asarray(leaf)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    target = jax.tree.map(to_restore_arg, template)
+    return mgr.restore(step, args=ocp.args.StandardRestore(target))

@@ -230,20 +230,50 @@ class TpuState(DurableStateMixin, ObjectState):
 
         The restore goes through host numpy, matching TpuState's
         host-snapshot design (save()/restore() already round-trip through
-        ``jax.device_get``). For models too large to materialize per host,
-        restore the durable blob directly with
+        ``jax.device_get``). The live ``(params, opt_state)`` — when
+        present — doubles as the structure template, so optax namedtuple
+        states come back as namedtuples, not dicts; a ``params=None``
+        bootstrap restores plain containers. For models too large to
+        materialize per host, restore the durable blob directly with
         :func:`horovod_tpu.restore_checkpoint` and a sharded template.
         """
         if self._ckpt_dir is None:
             return False
-        from ..checkpoint import restore_checkpoint
+        from ..checkpoint import _metadata_from, _restore_from
         from ..functions import _deserialize
         # __init__ already probed the latest durable step — no second
         # directory listing (durable steps start at 1, so 0 means none).
         step = self._latest_durable or None
         if step is None:
             return False
-        blob = restore_checkpoint(self._ckpt_dir, step=step)
+        # Templated restore when the state holds a live (params, opt_state):
+        # an untemplated orbax restore degrades pytree CONTAINERS to plain
+        # dicts (optax's namedtuple states would come back as
+        # {'count','mu','nu'} dicts and break opt.update — caught by the
+        # elastic example's cold-restart test). The attrs buffer's length is
+        # unknowable up front, so its template leaf comes from the
+        # checkpoint's metadata (shape-only read, no array data). A live
+        # tree whose STRUCTURE mismatches the saved one (e.g. an
+        # opt_state=None bootstrap against an adam checkpoint) falls back
+        # to the untemplated restore with a warning rather than crashing.
+        # One persistent manager serves metadata + restore (per-call
+        # construction would re-list the possibly-remote step directory).
+        mgr = self._durable_manager()
+        blob = None
+        live_tree = (self.params, self.opt_state)
+        if jax.tree.leaves(live_tree):
+            try:
+                attrs_md = _metadata_from(mgr, step)["attrs"]
+                blob = _restore_from(
+                    mgr, step, {"tree": live_tree, "attrs": attrs_md})
+            except Exception as exc:
+                log.warning(
+                    "durable resume: templated restore failed "
+                    f"({type(exc).__name__}: {exc}); falling back to an "
+                    "untemplated restore — container types (e.g. optax "
+                    "namedtuple states) may degrade to dicts")
+        if blob is None:
+            blob = _restore_from(mgr, step)
         self.params, self.opt_state = jax.tree.map(
             np.asarray, blob["tree"])
         self._tree_snapshot = (self.params, self.opt_state)

@@ -104,6 +104,73 @@ def test_elastic_state_durable_commits(spmd8, tmp_path):
     assert none.load_from_checkpoint() is False
 
 
+def test_durable_resume_preserves_opt_state_structure(spmd8, tmp_path):
+    """load_from_checkpoint with a LIVE (params, opt_state) must restore
+    optax's namedtuple states as namedtuples — an untemplated orbax
+    restore degrades them to dicts and the next opt.update crashes with
+    \"'dict' object has no attribute 'mu'\" (found by the elastic
+    example's cold-restart flow)."""
+    import optax
+
+    from horovod_tpu.elastic.state import TpuState
+
+    path = str(tmp_path / "resume")
+    params = {"w": jnp.ones((4,))}
+    opt = optax.adam(1e-2)
+    st = TpuState(params=params, opt_state=opt.init(params),
+                  checkpoint_dir=path, epoch=0)
+    st.commit()
+
+    params2 = {"w": jnp.zeros((4,))}
+    fresh = TpuState(params=params2, opt_state=opt.init(params2),
+                     checkpoint_dir=path, epoch=0)
+    assert fresh.load_from_checkpoint() is True
+    np.testing.assert_array_equal(np.asarray(fresh.params["w"]),
+                                  np.ones((4,), np.float32))
+    # The restored opt_state must drive an update — structure intact.
+    grads = {"w": jnp.full((4,), 0.5)}
+    updates, _ = opt.update(grads, fresh.opt_state, fresh.params)
+    assert set(updates) == {"w"}
+
+
+def test_durable_resume_mixed_bootstrap_falls_back(spmd8, tmp_path):
+    """A live tree whose STRUCTURE mismatches the saved one (params live,
+    opt_state=None, against a checkpoint saved with an adam state) must
+    fall back to the untemplated restore instead of crashing on the orbax
+    structure check (review finding)."""
+    import optax
+
+    from horovod_tpu.elastic.state import TpuState
+
+    path = str(tmp_path / "mixed")
+    params = {"w": jnp.full((4,), 3.0)}
+    opt = optax.adam(1e-2)
+    st = TpuState(params=params, opt_state=opt.init(params),
+                  checkpoint_dir=path, epoch=9)
+    st.commit()
+
+    partial = TpuState(params={"w": jnp.zeros((4,))}, opt_state=None,
+                       checkpoint_dir=path, epoch=0)
+    assert partial.load_from_checkpoint() is True
+    np.testing.assert_array_equal(np.asarray(partial.params["w"]),
+                                  np.full((4,), 3.0, np.float32))
+    assert partial.epoch == 9
+
+
+def test_checkpoint_metadata_reads_shapes_without_data(spmd8, tmp_path):
+    """checkpoint_metadata returns the saved tree's ShapeDtypeStructs (the
+    template-building primitive the durable resume uses to avoid a second
+    full data read)."""
+    path = str(tmp_path / "md")
+    tree = {"a": jnp.ones((8, 2), jnp.bfloat16), "b": np.arange(3)}
+    hvd.save_checkpoint(path, tree, step=1)
+    md = hvd.checkpoint_metadata(path)
+    assert md["a"].shape == (8, 2) and md["a"].dtype == jnp.bfloat16
+    assert md["b"].shape == (3,)
+    with pytest.raises(FileNotFoundError):
+        hvd.checkpoint_metadata(str(tmp_path / "nope"))
+
+
 def test_resume_training_mid_run(spmd8, tmp_path):
     """The actual workflow: checkpoint at step k, 'crash', restore, and the
     resumed trajectory matches the uninterrupted one."""

@@ -63,3 +63,37 @@ def test_example_smokes(name, args, expect):
     out = _run_example(name, args)
     if expect:
         assert expect in out.lower(), out[-500:]
+
+
+def test_elastic_example_kill_restart(tmp_path):
+    """elastic_train.py under the REAL launcher (hvdrun -np 2), end to end
+    through the durable-checkpoint flow (reference: docs/elastic.rst):
+    run 1 durable-commits then dies on an injected rank-0 crash; run 2 —
+    the same command — resumes from the latest durable commit instead of
+    step 0 and completes."""
+    env = subprocess_env()
+    env["HVDTPU_STALL_CHECK_DISABLE"] = "1"
+    ckpt = tmp_path / "ckpt"
+    marker = tmp_path / "crashed.marker"
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+           sys.executable, os.path.join(EXAMPLES, "elastic_train.py"),
+           "--epochs", "3", "--checkpoint-dir", str(ckpt),
+           "--crash-at-epoch", "2", "--crash-marker", str(marker)]
+
+    first = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=240, env=env)
+    assert first.returncode != 0, \
+        f"injected crash did not fail the job:\n{first.stdout[-1000:]}"
+    assert marker.exists()
+    assert "fresh start" in first.stdout, first.stdout[-1000:]
+    assert ckpt.exists() and os.listdir(ckpt), \
+        "no durable commit written before the crash"
+
+    second = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=240, env=env)
+    assert second.returncode == 0, \
+        f"restart failed:\n{second.stdout[-1500:]}\n{second.stderr[-1500:]}"
+    assert "resumed from durable commit: epoch 2" in second.stdout, \
+        second.stdout[-1000:]
+    assert "elastic training done: epochs=3" in second.stdout, \
+        second.stdout[-1000:]
