@@ -45,6 +45,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
+_LANES = 128  # TPU lane width: softmax stats ride lane-replicated [*, 128]
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/where NaN-free
 
 
@@ -114,7 +115,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:]
         safe_l = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(safe_l))[:, 0]
+        # Lane-replicated [BQ, 128]: Mosaic requires output block shapes
+        # whose last two dims are (8, 128)-tileable — a [BQ]-vector block
+        # is rejected on a real chip (interpret mode hid this). Same
+        # layout as jax's bundled TPU flash kernel's l/m stats
+        # (pallas/ops/tpu/flash_attention.py, MIN_BLOCK_SIZE lanes).
+        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(safe_l),
+                                      (m_scr.shape[0], _LANES))
 
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -134,8 +141,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         q = q_ref[0].astype(jnp.float32) * sm_scale      # [BQ, D]
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]    # lane-replicated stats: any lane works
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = _causal_mask(s, qi, kj)
@@ -171,8 +178,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -215,12 +222,13 @@ def _fwd_call(q, k, v, sm_scale, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),
+            # lse rides lane-replicated [bh, s, 128] (see _fwd_kernel).
+            pl.BlockSpec((1, BLOCK_Q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype,
                                  vma=_out_vma(q, k, v)),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32,
+            jax.ShapeDtypeStruct((bh, s, _LANES), jnp.float32,
                                  vma=_out_vma(q, k, v)),
         ],
         scratch_shapes=[
@@ -240,7 +248,10 @@ def _flash_bhsd(q, k, v, sm_scale):
 
 def _flash_bhsd_fwd(q, k, v, sm_scale):
     o, lse = _fwd_call(q, k, v, sm_scale, _use_interpret())
-    return o, (q, k, v, o, lse)
+    # Residual carries ONE lane of the lane-replicated stats: holding the
+    # [bh, s, 128] form across the whole fwd->bwd interval would cost 128x
+    # the logical bytes per layer; the backward re-broadcasts transiently.
+    return o, (q, k, v, o, lse[..., :1])
 
 
 def _flash_bhsd_bwd(sm_scale, res, do):
@@ -250,8 +261,13 @@ def _flash_bhsd_bwd(sm_scale, res, do):
     n_q = s // BLOCK_Q
     n_k = s // BLOCK_K
     # delta_i = rowsum(dO_i * O_i) — tiny elementwise pass, XLA fuses it.
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)
+    # Both stats enter the kernels lane-replicated [bh, s, 128] (Mosaic
+    # rejects vector blocks whose sublane dim is 1 — see _fwd_kernel) but
+    # only transiently for the backward: the residual holds one lane.
+    lse = jnp.broadcast_to(lse, (bh, s, _LANES))
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), (bh, s, _LANES))
 
     dkdv = functools.partial(_dkdv_kernel, sm_scale=sm_scale,
                              n_q_blocks=n_q)
@@ -263,8 +279,10 @@ def _flash_bhsd_bwd(sm_scale, res, do):
             pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),  # k
             pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),  # v
             pl.BlockSpec((1, BLOCK_Q, d), lambda b, j, i: (b, i, 0)),  # do
-            pl.BlockSpec((1, BLOCK_Q), lambda b, j, i: (b, i)),       # lse
-            pl.BlockSpec((1, BLOCK_Q), lambda b, j, i: (b, i)),       # delta
+            pl.BlockSpec((1, BLOCK_Q, _LANES),
+                         lambda b, j, i: (b, i, 0)),                   # lse
+            pl.BlockSpec((1, BLOCK_Q, _LANES),
+                         lambda b, j, i: (b, i, 0)),                   # delta
         ],
         out_specs=[
             pl.BlockSpec((1, BLOCK_K, d), lambda b, j, i: (b, j, 0)),
@@ -292,8 +310,10 @@ def _flash_bhsd_bwd(sm_scale, res, do):
             pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),  # k
             pl.BlockSpec((1, BLOCK_K, d), lambda b, i, j: (b, j, 0)),  # v
             pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),  # do
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),       # lse
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i, j: (b, i)),       # delta
+            pl.BlockSpec((1, BLOCK_Q, _LANES),
+                         lambda b, i, j: (b, i, 0)),                   # lse
+            pl.BlockSpec((1, BLOCK_Q, _LANES),
+                         lambda b, i, j: (b, i, 0)),                   # delta
         ],
         out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype,
