@@ -134,38 +134,11 @@ class Estimator:
         array data, a Spark DataFrame with a DataFrame input, a parquet
         directory path with a path input.
         """
-        spark_df = self._as_spark_df(data)
-        if spark_df is None and not isinstance(data, str) and num_proc:
-            raise ValueError(
-                "num_proc requires a Spark DataFrame or a parquet directory "
-                "path; in-memory (x, y) data trains on the local mesh only")
-        if num_proc and spark_df is not None:
-            # Fail BEFORE materializing the dataset: num_proc fans out via
-            # horovod_tpu.spark.run, which needs a live SparkSession — a
-            # pandas-backed frame can never provide one, and the eventual
-            # ImportError would point at pyspark instead of num_proc.
-            from ..spark.pandas_df import PandasDataFrame
-            if isinstance(spark_df, PandasDataFrame):
-                raise ValueError(
-                    "num_proc fan-out needs a real Spark DataFrame (live "
-                    "SparkSession); a pandas-backed frame trains on the "
-                    "local mesh — drop num_proc")
-        # The validation form must match the data form — a mismatch would
-        # otherwise die deep inside pyarrow/Spark with an opaque error.
-        if validation is not None:
-            if spark_df is not None and not isinstance(validation, float):
-                val_df = self._as_spark_df(validation)
-                if val_df is None:
-                    raise ValueError(
-                        "validation must be a Spark DataFrame or a float "
-                        "fraction when fitting a Spark DataFrame")
-                validation = val_df  # keep any auto-wrap (raw pandas)
-            if spark_df is None and isinstance(data, str) and \
-                    not isinstance(validation, str):
-                raise ValueError(
-                    "validation must be a parquet directory path when "
-                    "fitting a parquet directory")
-        if spark_df is not None:
+        from ..spark.fit_dispatch import resolve_fit_data
+        kind, payload, validation = resolve_fit_data(data, validation,
+                                                     num_proc)
+        if kind == "df":
+            spark_df = payload
             from ..spark.util import prepare_data
             if not self.feature_cols or not self.label_col:
                 raise ValueError(
@@ -225,26 +198,11 @@ class Estimator:
 
     # ------------------------------------------------------------------
     def _as_spark_df(self, data):
-        """``data`` as a DataFrame, else None. Duck-typed on the exact API
-        slice ``prepare_data`` consumes (count/repartition/randomSplit/
-        write) rather than isinstance-gated on pyspark, so
-        :class:`~horovod_tpu.spark.PandasDataFrame` — and e.g. Spark
-        Connect frames — take the same DataFrame→parquet→train path a
-        classic ``pyspark.sql.DataFrame`` does. A RAW ``pandas.DataFrame``
-        is auto-wrapped (it has ``count`` but not the rest — falling
-        through to the (x, y) tuple-unpack path would die with an opaque
-        error far from the cause). (x, y) tuples, arrays, and path strings
-        don't expose the slice and fall through."""
-        from ..spark.pandas_df import PandasDataFrame, is_dataframe_like
-        if isinstance(data, (str, bytes, tuple, list)):
-            return None
-        try:
-            import pandas as pd
-            if isinstance(data, pd.DataFrame):
-                return PandasDataFrame(data)
-        except ImportError:
-            pass
-        return data if is_dataframe_like(data) else None
+        """``data`` as a DataFrame, else None — see
+        :func:`horovod_tpu.spark.fit_dispatch.as_dataframe` (shared with
+        the torch estimator)."""
+        from ..spark.fit_dispatch import as_dataframe
+        return as_dataframe(data)
 
     def _fit_arrays(self, x, y, validation=None) -> EstimatorModel:
         import numpy as np
@@ -428,7 +386,14 @@ class Estimator:
             for xb, yb in it:
                 params, opt_state, l = run_batch(params, opt_state, xb, yb)
                 epoch_losses.append(l)
-            epoch_loss = float(np.mean(epoch_losses)) if epoch_losses else 0.0
+            if not epoch_losses:
+                # A silent loss=0.0 epoch would win best-epoch selection
+                # and checkpoint the untrained params.
+                raise ValueError(
+                    "training produced zero full batches (dataset smaller "
+                    "than batch_size); use more data or a smaller "
+                    "batch_size")
+            epoch_loss = float(np.mean(epoch_losses))
             history.append(epoch_loss)
             # Best-epoch selection on validation loss when given, training
             # loss otherwise (reference: estimators checkpoint on the

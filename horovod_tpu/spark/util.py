@@ -55,18 +55,24 @@ def _column_to_array(col) -> np.ndarray:
     return np.asarray(vals)
 
 
-def table_to_xy(table, feature_cols: List[str],
-                label_col: str) -> Tuple[np.ndarray, np.ndarray]:
-    """A pyarrow table → (x, y) numpy pair. Scalar feature columns stack into
-    a trailing feature axis; a single list-typed column is used as-is."""
+def table_to_x(table, feature_cols: List[str]) -> np.ndarray:
+    """Feature columns of a pyarrow table → one numpy array. Scalar columns
+    stack into a trailing feature axis; a single list-typed column is used
+    as-is."""
     cols = [_column_to_array(table.column(c)) for c in feature_cols]
     if len(cols) == 1:
         x = cols[0]
     else:
         cols = [c[..., None] if c.ndim == 1 else c for c in cols]
         x = np.concatenate(cols, axis=-1)
+    return np.ascontiguousarray(x)
+
+
+def table_to_xy(table, feature_cols: List[str],
+                label_col: str) -> Tuple[np.ndarray, np.ndarray]:
+    """A pyarrow table → (x, y) numpy pair."""
     y = _column_to_array(table.column(label_col))
-    return np.ascontiguousarray(x), np.ascontiguousarray(y)
+    return table_to_x(table, feature_cols), np.ascontiguousarray(y)
 
 
 class ParquetShardReader:
@@ -80,16 +86,25 @@ class ParquetShardReader:
     exactly one rank either way.
     """
 
-    def __init__(self, path: str, feature_cols: List[str], label_col: str,
+    def __init__(self, path: str, feature_cols: List[str], label_col,
                  batch_size: int = 32, rank: int = 0, size: int = 1,
-                 filesystem=None):
+                 filesystem=None, weight_col: Optional[str] = None):
         import pyarrow.dataset as pads
         self._ds = pads.dataset(path, format="parquet",
                                 filesystem=filesystem)
         self._fragments = sorted(self._ds.get_fragments(),
                                  key=lambda f: f.path)
         self.feature_cols = list(feature_cols)
-        self.label_col = label_col
+        # One label column → y is an array; a LIST of label columns → y is
+        # a list of arrays, one per head (reference: multi-label estimators,
+        # ``label_cols`` + per-label ``loss_constructors``).
+        self.label_cols = list(label_col) if isinstance(
+            label_col, (list, tuple)) else [label_col]
+        self.label_col = self.label_cols[0]
+        self._multi_label = isinstance(label_col, (list, tuple)) \
+            and len(self.label_cols) > 1
+        # Optional per-row weight column (reference: ``sample_weight_col``).
+        self.weight_col = weight_col
         self.batch_size = batch_size
         self.rank = rank
         self.size = size
@@ -105,7 +120,9 @@ class ParquetShardReader:
 
     def _shard_tables(self):
         import pyarrow as pa
-        columns = self.feature_cols + [self.label_col]
+        columns = self.feature_cols + self.label_cols
+        if self.weight_col:
+            columns = columns + [self.weight_col]
         if self._fragment_sharded:
             for frag in self._fragments[self.rank::self.size]:
                 yield frag.to_table(columns=columns)
@@ -115,20 +132,38 @@ class ParquetShardReader:
             yield table.take(list(range(self.rank, table.num_rows,
                                         self.size)))
 
-    def batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yield (x, y) numpy batches of ``batch_size`` rows; a trailing
-        partial batch is dropped (uniform shapes keep the step compiled
-        once — the reference's Petastorm loader cycles for the same
-        reason)."""
+    def batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Yield numpy batches of ``batch_size`` rows; a trailing partial
+        batch is dropped (uniform shapes keep the step compiled once — the
+        reference's Petastorm loader cycles for the same reason).
+
+        Batch shape: ``(x, y)``, plus a trailing weights array when
+        ``weight_col`` is set. ``y`` is a list of arrays when constructed
+        with a list of label columns (multi-head)."""
         leftover = None
         for table in self._shard_tables():
-            x, y = table_to_xy(table, self.feature_cols, self.label_col)
+            x = table_to_x(table, self.feature_cols)
+            ys = [_column_to_array(table.column(c)) for c in self.label_cols]
+            arrays = [x] + ys
+            if self.weight_col:
+                arrays.append(_column_to_array(table.column(self.weight_col)))
             if leftover is not None:
-                x = np.concatenate([leftover[0], x])
-                y = np.concatenate([leftover[1], y])
-            n_full = x.shape[0] // self.batch_size
+                arrays = [np.concatenate([lo, a])
+                          for lo, a in zip(leftover, arrays)]
+            n = arrays[0].shape[0]
+            n_full = n // self.batch_size
             for i in range(n_full):
                 sl = slice(i * self.batch_size, (i + 1) * self.batch_size)
-                yield x[sl], y[sl]
-            rem = x.shape[0] - n_full * self.batch_size
-            leftover = (x[-rem:], y[-rem:]) if rem else None
+                cut = [a[sl] for a in arrays]
+                yield self._pack(cut)
+            rem = n - n_full * self.batch_size
+            leftover = [a[-rem:] for a in arrays] if rem else None
+
+    def _pack(self, arrays):
+        x = arrays[0]
+        n_labels = len(self.label_cols)
+        ys = arrays[1:1 + n_labels]
+        y = ys if self._multi_label else ys[0]
+        if self.weight_col:
+            return x, y, arrays[-1]
+        return x, y

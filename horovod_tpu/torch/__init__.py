@@ -38,6 +38,7 @@ from .. import (mpi_threads_supported, mpi_enabled, mpi_built,  # noqa: F401
 from .optimizer import DistributedOptimizer
 from .compression import Compression
 from .sync_batch_norm import SyncBatchNorm
+from .estimator import TorchEstimator, TorchModel, EarlyStopping
 from . import elastic
 
 __all__ = [
@@ -51,7 +52,7 @@ __all__ = [
     "join", "poll", "synchronize",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer", "Compression",
-    "SyncBatchNorm",
+    "SyncBatchNorm", "TorchEstimator", "TorchModel", "EarlyStopping",
     "mpi_threads_supported", "mpi_enabled", "mpi_built", "gloo_enabled",
     "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built",

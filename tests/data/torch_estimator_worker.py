@@ -1,0 +1,38 @@
+"""Worker: the torch estimator's distributed training body
+(_remote_fit_torch) in process mode — what each Spark task executes on its
+parquet shard (reference: spark/torch/remote.py RemoteTrainer)."""
+import faulthandler
+import os
+import sys
+
+faulthandler.dump_traceback_later(120, exit=True, file=sys.stderr)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+from horovod_tpu.spark import LocalStore  # noqa: E402
+from horovod_tpu.torch.estimator import (TorchEstimator,  # noqa: E402
+                                         _remote_fit_torch)
+
+data_dir = os.environ["EST_DATA_DIR"]
+store_dir = os.environ["EST_STORE_DIR"]
+
+model = torch.nn.Linear(2, 1)
+est = TorchEstimator(
+    model=model,
+    optimizer=lambda params: torch.optim.Adam(params, lr=5e-2),
+    loss=lambda out, lab: torch.nn.functional.mse_loss(out[:, 0], lab),
+    store=LocalStore(store_dir), epochs=8, batch_size=32,
+    metrics={"mae": lambda out, lab: (out[:, 0] - lab).abs().mean()},
+    feature_cols=["f0", "f1"], label_cols=["label"], run_id="tproc1")
+hvd.init()
+history = _remote_fit_torch(est, data_dir)
+assert history[-1]["loss"] < history[0]["loss"] * 0.8, history
+assert "mae" in history[-1], history[-1]
+if hvd.rank() == 0:
+    assert os.path.exists(
+        est.store.get_checkpoint_path("tproc1")), "rank 0 must checkpoint"
+hvd.shutdown()
+print("ALL OK")
