@@ -1,0 +1,151 @@
+"""Tunnel watcher: opportunistically land on-chip evidence.
+
+The axon tunnel to the single real TPU chip is intermittent (rounds 1-4
+each lost their bench window to it).  This watcher loops forever:
+
+1. run staged payloads, cheapest first, each in its own subprocess with
+   its own hard timeout (a hung payload can never wedge the watcher):
+     stage A (~4 min): Pallas kernel compile check + first-number MLP
+     stage B (~30 min): the full ``bench.py`` run
+   There is NO separate probe: observed 2026-07-31, the tunnel served
+   the FIRST connection of the session instantly and hung every later
+   one — a throwaway probe would spend the only good connection. The
+   payload's own backend init IS the probe; a hang times out and retries.
+2. append every outcome as a JSON line to ``_live/onchip.jsonl`` so a
+   mid-run tunnel death still leaves partial evidence (stage A streams
+   incremental lines; a timeout keeps whatever was printed).
+
+Run as ``nohup python scripts/onchip_watch.py &``.  Stages that have
+already succeeded are skipped on later passes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIVE = os.path.join(REPO, "_live")
+LOG = os.path.join(LIVE, "onchip.jsonl")
+
+STAGE_A_TIMEOUT_S = 420
+STAGE_B_TIMEOUT_S = 3600
+SLEEP_BETWEEN_PROBES_S = 120
+
+STAGE_A = r"""
+import json, sys, time
+import jax, jax.numpy as jnp
+sys.path.insert(0, %(repo)r)
+import bench
+print("MARK devices " + str(jax.devices()), flush=True)
+t0 = time.time()
+kc = bench._kernel_compile_check(jax, jnp)
+print("MARK kernel_compile_check %%.1fs " %% (time.time() - t0)
+      + json.dumps(kc), flush=True)
+t0 = time.time()
+fn = bench._first_number(jax, jnp)
+print("MARK first_number %%.1fs " %% (time.time() - t0)
+      + json.dumps(fn), flush=True)
+out = {"devices": str(jax.devices()), "kernel_compile_check": kc,
+       "first_number": fn}
+print("STAGE_A_RESULT " + json.dumps(out), flush=True)
+""" % {"repo": REPO}
+
+
+def log(entry: dict) -> None:
+    entry["ts"] = time.time()
+    entry["iso"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def run_sub(args, timeout_s, tag):
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            args, cwd=REPO, capture_output=True, text=True, timeout=timeout_s)
+        return {
+            "tag": tag, "rc": proc.returncode,
+            "elapsed_s": round(time.time() - t0, 1),
+            "stdout_tail": proc.stdout[-8000:],
+            "stderr_tail": proc.stderr[-3000:],
+        }
+    except subprocess.TimeoutExpired as exc:
+        def _txt(b):
+            if b is None:
+                return ""
+            return b.decode("utf-8", "replace") if isinstance(b, bytes) else b
+        return {"tag": tag, "rc": None, "timeout": True,
+                "elapsed_s": round(time.time() - t0, 1),
+                "stdout_tail": _txt(exc.stdout)[-8000:],
+                "stderr_tail": _txt(exc.stderr)[-3000:]}
+
+
+def main() -> None:
+    os.makedirs(LIVE, exist_ok=True)
+    done = set()
+    # Re-scan prior log so a watcher restart does not redo finished stages.
+    if os.path.exists(LOG):
+        for line in open(LOG):
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if e.get("stage_done"):
+                done.add(e["stage_done"])
+    attempt = 0
+    while len(done) < 2:
+        attempt += 1
+        if "A" not in done:
+            res = run_sub([sys.executable, "-u", "-c", STAGE_A],
+                          STAGE_A_TIMEOUT_S, "stage_a")
+            payload = None
+            marks = []
+            for ln in (res.get("stdout_tail") or "").splitlines():
+                if ln.startswith("STAGE_A_RESULT "):
+                    payload = json.loads(ln[len("STAGE_A_RESULT "):])
+                elif ln.startswith("MARK "):
+                    marks.append(ln[:2000])
+            ok = res["rc"] == 0 and payload is not None
+            log({"event": "stage_a", "attempt": attempt, "ok": ok,
+                 "result": payload, "marks": marks,
+                 "rc": res["rc"], "elapsed_s": res["elapsed_s"],
+                 "timeout": res.get("timeout", False),
+                 "stderr": (res.get("stderr_tail") or "")[-1500:],
+                 **({"stage_done": "A"} if ok else {})})
+            if ok:
+                done.add("A")
+            else:
+                time.sleep(SLEEP_BETWEEN_PROBES_S)
+                continue
+        if "B" not in done:
+            res = run_sub([sys.executable, "bench.py"],
+                          STAGE_B_TIMEOUT_S, "stage_b")
+            line = (res.get("stdout_tail") or "").strip().splitlines()
+            bench_json = None
+            for ln in reversed(line):
+                try:
+                    bench_json = json.loads(ln)
+                    break
+                except ValueError:
+                    continue
+            ok = res["rc"] == 0 and bench_json and bench_json.get("value")
+            log({"event": "stage_b", "ok": bool(ok), "result": bench_json,
+                 "rc": res["rc"], "elapsed_s": res["elapsed_s"],
+                 "timeout": res.get("timeout", False),
+                 "stderr": (res.get("stderr_tail") or "")[-1500:],
+                 **({"stage_done": "B"} if ok else {})})
+            if ok:
+                done.add("B")
+                with open(os.path.join(LIVE, "bench_full.json"), "w") as f:
+                    json.dump(bench_json, f, indent=1)
+            else:
+                time.sleep(SLEEP_BETWEEN_PROBES_S)
+    log({"event": "all_stages_done"})
+
+
+if __name__ == "__main__":
+    main()
