@@ -393,6 +393,9 @@ def _kernel_compile_check(jax, jnp):
         lambda a, b, c: fa.flash_attention(a, b, c)
         .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
         .lower(q, q, q).compile())
+    check("flash_noncausal_compiles", lambda: jax.jit(
+        lambda a, b, c: fa.flash_attention(a, b, c, causal=False))
+        .lower(q, q, q).compile())
     x = jnp.zeros((8192,), jnp.float32)
     seed = jnp.zeros((), jnp.int32)
     check("quantize_compiles", lambda: jax.jit(

@@ -19,9 +19,11 @@ Design notes (TPU):
   and written on the final visit — VMEM use is O(BLOCK x D) regardless of
   sequence length, not O(S x D).
 * All matmuls accumulate in fp32 (``preferred_element_type``) on the MXU.
-* Causal-only: off-diagonal upper blocks are predicated out (``pl.when``),
-  and tail-padding to the 128-row block is free (a real query row never
-  attends a key beyond itself), so any sequence length works.
+* Causal mode skips the upper-triangle blocks entirely (``pl.when`` — no
+  DMA, no FLOPs) and gets tail-padding to the 128-row block for free (a
+  real query row never attends a key beyond itself). Bidirectional mode
+  (``causal=False``, encoder models) computes every block and masks the
+  padded key columns instead. Any sequence length works in both.
 * Backward = two kernels, same streaming structure: dKdV walks
   ``(bh, k_block, q_block)``, dQ walks ``(bh, q_block, k_block)``, each
   recomputing the probability tile from q, k and the saved row logsumexp —
@@ -72,18 +74,25 @@ def repeat_kv_heads(k, n_q_heads: int):
     return jnp.repeat(k, n_q_heads // n_kv, axis=2)
 
 
-def _causal_mask(s, q_block, k_block):
-    """Mask logits tile ``s`` [BLOCK_Q, BLOCK_K] for causality: query block
-    index ``q_block``, key block index ``k_block`` (global positions)."""
-    q_pos = q_block * BLOCK_Q + jax.lax.broadcasted_iota(
-        jnp.int32, s.shape, 0)
+def _mask_tile(s, q_block, k_block, causal: bool, kv_len: int):
+    """Mask logits tile ``s`` [BLOCK_Q, BLOCK_K] (global positions from the
+    block indices). Causal mode masks the upper triangle — which also
+    covers the tail padding for free (a real query row never attends a key
+    at or beyond its own position's pad). Non-causal mode must mask the
+    padded key columns explicitly (``k_pos >= kv_len``), or every query
+    would attend the zero-filled tail."""
     k_pos = k_block * BLOCK_K + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    if causal:
+        q_pos = q_block * BLOCK_Q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return jnp.where(k_pos < kv_len, s, _NEG_INF)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale: float, n_k_blocks: int):
+                *, sm_scale: float, n_k_blocks: int, causal: bool,
+                kv_len: int):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -93,14 +102,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(kj <= qi)  # causal: upper-triangle blocks contribute nothing
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale      # [BQ, D]
         k = k_ref[0].astype(jnp.float32)                 # [BK, D]
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = _causal_mask(s, qi, kj)
+        s = _mask_tile(s, qi, kj, causal, kv_len)
         m_prev, l_prev = m_scr[:], l_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -109,6 +117,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
             p, v, preferred_element_type=jnp.float32)
+
+    if causal:
+        # Upper-triangle blocks contribute nothing — skip their DMA+FLOPs.
+        pl.when(kj <= qi)(_step)
+    else:
+        _step()
 
     @pl.when(kj == n_k_blocks - 1)
     def _finish():
@@ -126,7 +140,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale: float,
-                 n_q_blocks: int):
+                 n_q_blocks: int, causal: bool, kv_len: int):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -135,7 +149,6 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(qi >= kj)  # causal: earlier query blocks never see these keys
     def _step():
         k = k_ref[0].astype(jnp.float32)                 # [BK, D]
         v = v_ref[0].astype(jnp.float32)
@@ -145,7 +158,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = _causal_mask(s, qi, kj)
+        s = _mask_tile(s, qi, kj, causal, kv_len)
         p = jnp.exp(s - lse)                             # [BQ, BK]
         # dv += p^T @ dO
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
@@ -159,6 +172,12 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    if causal:
+        # Earlier query blocks never see these keys — skip them.
+        pl.when(qi >= kj)(_step)
+    else:
+        _step()
+
     @pl.when(qi == n_q_blocks - 1)
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
@@ -166,7 +185,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, sm_scale: float, n_k_blocks: int):
+               dq_scr, *, sm_scale: float, n_k_blocks: int, causal: bool,
+               kv_len: int):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -174,7 +194,6 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(kj <= qi)
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
@@ -184,13 +203,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = _causal_mask(s, qi, kj)
+        s = _mask_tile(s, qi, kj, causal, kv_len)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_scr[:] = dq_scr[:] + jnp.dot(
             ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kj <= qi)(_step)
+    else:
+        _step()
 
     @pl.when(kj == n_k_blocks - 1)
     def _finish():
@@ -205,13 +229,15 @@ def _pad_seq(x, block):
     return x
 
 
-def _fwd_call(q, k, v, sm_scale, interpret):
-    """q/k/v: [BH, S, D] (S already padded). Returns (o, lse)."""
+def _fwd_call(q, k, v, sm_scale, causal, kv_len, interpret):
+    """q/k/v: [BH, S, D] (S already padded; ``kv_len`` is the real key
+    count before padding). Returns (o, lse)."""
     bh, s, d = q.shape
     n_q = s // BLOCK_Q
     n_k = s // BLOCK_K
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
-                               n_k_blocks=n_k)
+                               n_k_blocks=n_k, causal=causal,
+                               kv_len=kv_len)
     return pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -240,21 +266,21 @@ def _fwd_call(q, k, v, sm_scale, interpret):
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_bhsd(q, k, v, sm_scale):
-    o, _ = _fwd_call(q, k, v, sm_scale, _use_interpret())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, sm_scale, causal, kv_len):
+    o, _ = _fwd_call(q, k, v, sm_scale, causal, kv_len, _use_interpret())
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, sm_scale):
-    o, lse = _fwd_call(q, k, v, sm_scale, _use_interpret())
+def _flash_bhsd_fwd(q, k, v, sm_scale, causal, kv_len):
+    o, lse = _fwd_call(q, k, v, sm_scale, causal, kv_len, _use_interpret())
     # Residual carries ONE lane of the lane-replicated stats: holding the
     # [bh, s, 128] form across the whole fwd->bwd interval would cost 128x
     # the logical bytes per layer; the backward re-broadcasts transiently.
     return o, (q, k, v, o, lse[..., :1])
 
 
-def _flash_bhsd_bwd(sm_scale, res, do):
+def _flash_bhsd_bwd(sm_scale, causal, kv_len, res, do):
     q, k, v, o, lse = res
     interpret = _use_interpret()
     bh, s, d = q.shape
@@ -270,7 +296,7 @@ def _flash_bhsd_bwd(sm_scale, res, do):
                 axis=-1, keepdims=True), (bh, s, _LANES))
 
     dkdv = functools.partial(_dkdv_kernel, sm_scale=sm_scale,
-                             n_q_blocks=n_q)
+                             n_q_blocks=n_q, causal=causal, kv_len=kv_len)
     dk, dv = pl.pallas_call(
         dkdv,
         grid=(bh, n_k, n_q),
@@ -301,7 +327,8 @@ def _flash_bhsd_bwd(sm_scale, res, do):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    dqk = functools.partial(_dq_kernel, sm_scale=sm_scale, n_k_blocks=n_k)
+    dqk = functools.partial(_dq_kernel, sm_scale=sm_scale, n_k_blocks=n_k,
+                            causal=causal, kv_len=kv_len)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, n_q, n_k),
@@ -328,17 +355,15 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True):
-    """Fused causal attention. q: ``[B, S, H, D]`` (the layout the GPT
-    blocks use); k/v: ``[B, S, Hkv, D]`` where ``Hkv`` may divide ``H``
+    """Fused attention. q: ``[B, S, H, D]`` (the layout the GPT blocks
+    use); k/v: ``[B, S, Hkv, D]`` where ``Hkv`` may divide ``H``
     (grouped-query attention — kv heads tile up locally, mirroring ring
     attention's contract). Differentiable (custom VJP, flash backward).
-    Only ``causal=True`` is supported — the causal structure is also what
-    makes tail-padding to the 128-row block size free.
+
+    ``causal=True`` (decoder) skips the upper-triangle blocks entirely;
+    ``causal=False`` (encoder/bidirectional) computes all blocks with the
+    tail padding masked out of the key axis.
     """
-    if not causal:
-        raise NotImplementedError(
-            "flash_attention is causal-only; use default_attention for "
-            "bidirectional attention")
     # GQA: repeat before the kernel (no-op when heads match; also
     # validates BOTH k and v against the query head count).
     k = repeat_kv_heads(k, q.shape[2])
@@ -350,5 +375,6 @@ def flash_attention(q, k, v, causal: bool = True):
         return _pad_seq(x.transpose(0, 2, 1, 3).reshape(b * h, s, d),
                         BLOCK_Q)
 
-    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale)
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale,
+                    bool(causal), s)
     return o[:, :s, :].reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -99,7 +99,37 @@ def test_grouped_query_attention():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_non_causal_rejected():
-    q, k, v = _qkv(1, 128, 1, 64)
-    with pytest.raises(NotImplementedError, match="causal-only"):
-        flash_attention(q, k, v, causal=False)
+@pytest.mark.parametrize("s", [128, 256])
+def test_non_causal_matches_dense_forward(s):
+    q, k, v = _qkv(2, s, 2, 64, seed=5)
+    out = flash_attention(q, k, v, causal=False)
+    ref = default_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_non_causal_unaligned_seq_masks_padding():
+    # 200 pads to 256: without the key-axis padding mask every query would
+    # attend the zero-filled tail (zero logits still win softmax weight).
+    q, k, v = _qkv(1, 200, 2, 64, seed=6)
+    out = flash_attention(q, k, v, causal=False)
+    ref = default_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("s", [256, 200])  # 200: padded rows in the bwd too
+def test_non_causal_gradients_match_dense(s):
+    q, k, v = _qkv(1, s, 2, 32, seed=7)
+    w = jax.random.normal(jax.random.PRNGKey(9), q.shape) * 0.1
+
+    def loss(fn):
+        def inner(a, b, c):
+            return jnp.sum(fn(a, b, c, causal=False) * w)
+        return jax.grad(inner, argnums=(0, 1, 2))(q, k, v)
+
+    g_flash = loss(flash_attention)
+    g_ref = loss(default_attention)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4)
