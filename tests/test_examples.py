@@ -44,6 +44,8 @@ def _run_example(name, args, timeout=420):
     ("compression_benchmark.py", ["--bits", "4", "--size", "65536"], None),
     ("torch_mnist.py", ["--epochs", "1", "--batch-size", "64"], None),
     ("estimator_parquet.py", ["--epochs", "2"], None),
+    ("torch_estimator_train.py", ["--epochs", "4", "--rows", "256"],
+     "torch estimator ok"),
     ("hierarchical_cross_slice.py", ["--steps", "2"],
      "hierarchical cross-slice training ok"),
     ("jax_synthetic_benchmark.py",
