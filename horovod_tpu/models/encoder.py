@@ -1,0 +1,62 @@
+"""Bidirectional (BERT-style) Transformer encoder with a masked-LM head.
+
+The reference is a collective-communication library whose model surface is
+whatever its examples exercise (CNNs + users' TF/torch models); this
+encoder extends the zoo with the bidirectional family so the non-causal
+flash kernel (:mod:`horovod_tpu.ops.flash_attention`, ``causal=False``)
+has a first-class consumer, mirroring how the decoder ``Transformer`` /
+``models/gpt.py`` consume the causal kernel.
+
+Structure: token embedding → N pre-norm bidirectional blocks (RoPE
+positions, same ``Block`` the decoder uses with ``causal=False``) → final
+RMSNorm → vocab logits. ``masked_lm_loss`` applies the standard BERT
+objective: cross-entropy at the masked positions only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .transformer import Block, default_attention
+
+
+class Encoder(nn.Module):
+    """Bidirectional encoder LM. ``attn_fn`` swaps in the fused flash
+    kernel (``flash_attention``) — every token attends every token."""
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 64
+    embed_dim: int = 512
+    mlp_dim: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, tokens, positions: Optional[jnp.ndarray] = None):
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape)
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     param_dtype=jnp.float32, dtype=self.dtype)(tokens)
+        for _ in range(self.num_layers):
+            x = Block(self.num_heads, self.head_dim, self.mlp_dim,
+                      self.dtype, self.attn_fn, causal=False)(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          param_dtype=jnp.float32)(x)
+        return logits.astype(jnp.float32)
+
+
+def masked_lm_loss(logits, targets, mask):
+    """Mean cross-entropy over the masked positions only (the BERT MLM
+    objective). ``logits``: [B, S, V]; ``targets``: [B, S] original token
+    ids; ``mask``: [B, S] 1.0 where the input was masked/corrupted."""
+    logp = jnp.take_along_axis(
+        nn.log_softmax(logits, axis=-1), targets[..., None], axis=-1)[..., 0]
+    mask = mask.astype(logp.dtype)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return -(logp * mask).sum() / denom

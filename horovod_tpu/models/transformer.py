@@ -47,6 +47,7 @@ class Attention(nn.Module):
     head_dim: int
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = default_attention
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x, positions):
@@ -57,7 +58,7 @@ class Attention(nn.Module):
         v = dense(features=(self.num_heads, self.head_dim), name="v")(x)
         q = rope(q, positions)
         k = rope(k, positions)
-        out = self.attn_fn(q, k, v, causal=True)
+        out = self.attn_fn(q, k, v, causal=self.causal)
         return nn.DenseGeneral(features=x.shape[-1], axis=(-2, -1),
                                dtype=self.dtype, param_dtype=jnp.float32,
                                name="o")(out)
@@ -69,12 +70,13 @@ class Block(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     attn_fn: Callable = default_attention
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x, positions):
         h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         x = x + Attention(self.num_heads, self.head_dim, self.dtype,
-                          self.attn_fn)(h, positions)
+                          self.attn_fn, self.causal)(h, positions)
         h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
                      param_dtype=jnp.float32)(h)

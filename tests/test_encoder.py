@@ -1,0 +1,99 @@
+"""Bidirectional encoder (BERT-style) model: flash/dense parity, MLM
+objective, and data-parallel training on the virtual mesh."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu.models import Encoder, masked_lm_loss
+from horovod_tpu.models.encoder import default_attention
+from horovod_tpu.ops.flash_attention import flash_attention
+
+
+def _tiny(attn_fn=default_attention):
+    return Encoder(vocab_size=64, num_layers=2, num_heads=2, head_dim=32,
+                   embed_dim=64, mlp_dim=128, dtype=jnp.float32,
+                   attn_fn=attn_fn)
+
+
+def test_forward_shape_and_bidirectional():
+    model = _tiny()
+    tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 40)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 40, 64)
+    # Bidirectional: changing a LATE token must change EARLY positions'
+    # logits (a causal model would leave them untouched).
+    tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 1) % 64)
+    logits2 = model.apply(params, tokens2)
+    assert not np.allclose(np.asarray(logits[:, 0]),
+                           np.asarray(logits2[:, 0]))
+
+
+def test_flash_matches_dense_inside_model():
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 64, (2, 130)))
+    dense_m = _tiny(default_attention)
+    flash_m = _tiny(flash_attention)
+    params = dense_m.init(jax.random.PRNGKey(0), tokens)
+    out_d = dense_m.apply(params, tokens)
+    out_f = flash_m.apply(params, tokens)  # same params, swapped attention
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_masked_lm_loss_masks():
+    logits = jnp.zeros((1, 4, 8))
+    targets = jnp.asarray([[1, 2, 3, 4]])
+    none_masked = masked_lm_loss(logits, targets, jnp.zeros((1, 4)))
+    all_masked = masked_lm_loss(logits, targets, jnp.ones((1, 4)))
+    # Uniform logits: per-masked-position CE is log(8); unmasked → 0/1.
+    np.testing.assert_allclose(float(all_masked), np.log(8), rtol=1e-5)
+    assert float(none_masked) == 0.0
+    # Only the masked position's target matters.
+    l1 = masked_lm_loss(logits, targets, jnp.asarray([[1.0, 0, 0, 0]]))
+    targets2 = targets.at[:, 1:].set(0)
+    l2 = masked_lm_loss(logits, targets2, jnp.asarray([[1.0, 0, 0, 0]]))
+    np.testing.assert_allclose(float(l1), float(l2))
+
+
+def test_mlm_training_converges_data_parallel(spmd8):
+    """Masked-token recovery on a toy periodic language, trained
+    data-parallel over the 8-device mesh through run_step."""
+    import horovod_tpu as hvd
+
+    rng = np.random.RandomState(0)
+    vocab, seq, batch = 16, 32, 16
+    base = np.arange(seq) % vocab  # fully predictable from positions
+    tokens = np.tile(base, (batch, 1)).astype(np.int32)
+    mask = (rng.rand(batch, seq) < 0.3).astype(np.float32)
+    corrupted = np.where(mask > 0, (tokens + 7) % vocab, tokens)
+
+    model = Encoder(vocab_size=vocab, num_layers=1, num_heads=2,
+                    head_dim=16, embed_dim=32, mlp_dim=64,
+                    dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(corrupted[:1]))
+    opt = hvd.DistributedOptimizer(optax.adam(5e-3))
+    state = opt.init(params)
+
+    def step(p, s, batch_):
+        inp, tgt, msk = batch_
+
+        def loss_fn(q):
+            return masked_lm_loss(model.apply(q, inp), tgt, msk)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, s, hvd.allreduce(l, op=hvd.Average)
+
+    dstep = hvd.data_parallel_step(step, donate_state=False)
+    losses = []
+    sharded = hvd.shard_batch((jnp.asarray(corrupted), jnp.asarray(tokens),
+                               jnp.asarray(mask)))
+    for _ in range(120):
+        params, state, l = dstep(params, state, sharded)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
