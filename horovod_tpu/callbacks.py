@@ -163,3 +163,43 @@ class BestModelCheckpoint:
         pkl = self.path if self.path.endswith(".pkl") else self.path + ".pkl"
         with open(pkl, "rb") as f:
             return pickle.load(f)
+
+
+class StopTraining(Exception):
+    """Raised by a callback's ``on_epoch_end`` to end training after the
+    current epoch (both estimator families catch it; reference: Keras
+    ``model.stop_training`` set by EarlyStopping)."""
+
+
+class EarlyStopping:
+    """Stop when a monitored metric stops improving (reference: users pass
+    keras/torch early-stop callbacks through the estimators' ``callbacks``
+    param). Runs on rank 0; the estimators broadcast the stop decision so
+    all ranks leave the collective loop together."""
+
+    def __init__(self, monitor: str = "val_loss", min_delta: float = 0.0,
+                 patience: int = 0):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self._best = float("inf")
+        self._wait = 0
+
+    def on_train_begin(self, logs=None):
+        self._best = float("inf")
+        self._wait = 0
+
+    def on_epoch_end(self, epoch: int, logs: Dict[str, float]):
+        value = logs.get(self.monitor)
+        if value is None:
+            raise KeyError(
+                f"EarlyStopping monitors {self.monitor!r} but the epoch "
+                f"logs only have {sorted(logs)} — pass validation data for "
+                "val_* metrics")
+        if value < self._best - self.min_delta:
+            self._best = value
+            self._wait = 0
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                raise StopTraining()

@@ -39,12 +39,15 @@ class EstimatorModel:
     checkpoint and serves ``transform``)."""
 
     def __init__(self, model, params, run_id: str, history,
-                 val_history=None):
+                 val_history=None, logs=None):
         self.model = model
         self.params = params
         self.run_id = run_id
         self.history = history  # list of per-epoch train losses
         self.val_history = val_history  # per-epoch val losses, or None
+        # Per-epoch logs dicts (loss/val_loss + any metrics) — the richer
+        # view the callbacks receive (reference: Keras History.history).
+        self.logs = logs or []
 
     def transform(self, x):
         """Predict on a host batch (reference: model.transform(df))."""
@@ -57,7 +60,8 @@ class EstimatorModel:
         blob = pickle.loads(store.load(run_id))
         params = jax.tree.map(lambda a: a, blob["params"])
         return cls(model, params, run_id, blob.get("history", []),
-                   val_history=blob.get("val_history"))
+                   val_history=blob.get("val_history"),
+                   logs=blob.get("logs"))
 
 
 def _remote_fit(estimator: "Estimator", train_path: str,
@@ -106,7 +110,10 @@ class Estimator:
                  run_id: Optional[str] = None, seed: int = 0,
                  feature_cols: Optional[list] = None,
                  label_col: Optional[str] = None,
-                 sample_input=None):
+                 sample_input=None,
+                 metrics: Optional[dict] = None,
+                 callbacks: Optional[list] = None,
+                 resume: bool = True):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -121,6 +128,18 @@ class Estimator:
         # driver never materializes a batch (first shard batch is used when
         # omitted).
         self.sample_input = sample_input
+        # ``{name: fn(pred, y) -> scalar}`` — computed inside the jitted
+        # step (so they must be jittable) and averaged over the epoch and
+        # across ranks into the epoch logs (reference: estimator
+        # ``metrics`` param + MetricAverageCallback semantics).
+        self.metrics = dict(metrics or {})
+        # Objects with optional on_train_begin(logs)/on_epoch_end(epoch,
+        # logs); raise callbacks.StopTraining (e.g. EarlyStopping) to stop.
+        # Run on rank 0; the stop decision is broadcast in process mode.
+        self.callbacks = list(callbacks or [])
+        # Resume from the per-epoch training checkpoint under the same
+        # run_id (reference: _load_checkpoint → last_checkpoint_state).
+        self.resume = resume
 
     # ------------------------------------------------------------------
     def fit(self, data, num_proc: Optional[int] = None,
@@ -194,7 +213,8 @@ class Estimator:
                 val_batches=val_batches)
         blob = pickle.loads(self.store.load(self.run_id))
         return EstimatorModel(self.model, blob["params"], self.run_id,
-                              history, val_history=val_history)
+                              history, val_history=val_history,
+                              logs=blob.get("logs"))
 
     # ------------------------------------------------------------------
     def _as_spark_df(self, data):
@@ -253,7 +273,8 @@ class Estimator:
                                               val_batches=val_batches)
         blob = pickle.loads(self.store.load(self.run_id))
         return EstimatorModel(self.model, blob["params"], self.run_id,
-                              history, val_history=val_history)
+                              history, val_history=val_history,
+                              logs=blob.get("logs"))
 
     def _fit_loop(self, batches: Callable, distributed: bool,
                   local_steps: Optional[int] = None,
@@ -278,6 +299,7 @@ class Estimator:
         import optax
 
         import horovod_tpu as hvd
+        from ..callbacks import StopTraining
 
         if not hvd.is_initialized():
             hvd.init()
@@ -318,6 +340,10 @@ class Estimator:
         opt = hvd.DistributedOptimizer(self.optimizer)
         opt_state = opt.init(params)
         model, loss_fn = self.model, self.loss
+        metric_items = tuple(self.metrics.items())
+
+        def with_metrics(pred, yb):
+            return {name: fn(pred, yb) for name, fn in metric_items}
 
         if distributed:
             # Process mode: local jitted grads; cross-rank averaging happens
@@ -326,66 +352,121 @@ class Estimator:
 
             @jax.jit
             def grad_step(p, xb, yb):
-                return jax.value_and_grad(
-                    lambda q: loss_fn(model.apply(q, xb), yb))(p)
+                def objective(q):
+                    pred = model.apply(q, xb)
+                    return loss_fn(pred, yb), with_metrics(pred, yb)
+                return jax.value_and_grad(objective, has_aux=True)(p)
 
             apply = jax.jit(optax.apply_updates)
 
             def run_batch(p, s, xb, yb):
-                l, g = grad_step(p, jnp.asarray(xb), jnp.asarray(yb))
+                (l, metr), g = grad_step(p, jnp.asarray(xb),
+                                         jnp.asarray(yb))
                 updates, s = opt.update(g, s, p)
-                return apply(p, updates), s, float(np.asarray(
+                l = float(np.asarray(
                     hvd.allreduce(np.asarray(l), op=hvd.Average)))
+                metr = {k: float(np.asarray(hvd.allreduce(
+                    np.asarray(v), op=hvd.Average, name=f"est.m.{k}")))
+                    for k, v in metr.items()}
+                return apply(p, updates), s, l, metr
         else:
             def train_step(p, s, batch):
                 xb, yb = batch
 
                 def objective(q):
-                    return loss_fn(model.apply(q, xb), yb)
+                    pred = model.apply(q, xb)
+                    return loss_fn(pred, yb), with_metrics(pred, yb)
 
-                l, g = jax.value_and_grad(objective)(p)
+                (l, metr), g = jax.value_and_grad(
+                    objective, has_aux=True)(p)
                 updates, s = opt.update(g, s, p)
                 p = optax.apply_updates(p, updates)
-                return p, s, hvd.allreduce(l, op=hvd.Average)
+                # Metrics are per-shard values: average across the mesh
+                # (also what makes them VMA-replicated outputs).
+                metr = {k: hvd.allreduce(v, op=hvd.Average,
+                                         name=f"est.m.{k}")
+                        for k, v in metr.items()}
+                return p, s, hvd.allreduce(l, op=hvd.Average), metr
 
             step = hvd.data_parallel_step(train_step, donate_state=False)
 
             def run_batch(p, s, xb, yb):
                 batch = hvd.shard_batch((jnp.asarray(xb), jnp.asarray(yb)))
-                p, s, l = step(p, s, batch)
-                return p, s, float(l)
+                p, s, l, metr = step(p, s, batch)
+                return p, s, float(l), {k: float(v)
+                                        for k, v in metr.items()}
 
-        # Eval step (no update): local jitted loss, averaged across ranks in
-        # distributed mode (the SPMD-local val batch is replicated).
-        eval_loss = jax.jit(
-            lambda p, xb, yb: loss_fn(model.apply(p, xb), yb))
+        # Eval step (no update): local jitted loss+metrics, averaged across
+        # ranks in distributed mode (the SPMD-local val batch is
+        # replicated).
+        @jax.jit
+        def eval_step(p, xb, yb):
+            pred = model.apply(p, xb)
+            return loss_fn(pred, yb), with_metrics(pred, yb)
 
         def run_val(p, it):
-            losses = []
+            losses, msums = [], {}
             for xv, yv in it:
-                l = eval_loss(p, jnp.asarray(xv), jnp.asarray(yv))
+                l, metr = eval_step(p, jnp.asarray(xv), jnp.asarray(yv))
                 if distributed:
                     l = hvd.allreduce(np.asarray(l), op=hvd.Average)
+                    metr = {k: hvd.allreduce(np.asarray(v), op=hvd.Average,
+                                             name=f"est.vm.{k}")
+                            for k, v in metr.items()}
                 losses.append(float(np.asarray(l)))
+                for k, v in metr.items():
+                    msums[k] = msums.get(k, 0.0) + float(np.asarray(v))
             if not losses:
                 # A silent 0.0 would win best-epoch selection at epoch 0
                 # and freeze the untrained params.
                 raise ValueError(
                     "validation produced zero full batches (val set smaller "
                     "than batch_size)")
-            return float(np.mean(losses))
+            return (float(np.mean(losses)),
+                    {k: v / len(losses) for k, v in msums.items()})
 
+        # Resume from the per-epoch training checkpoint (reference:
+        # _load_checkpoint -> remote last_checkpoint_state). The training
+        # state (params + optimizer + epoch) lives NEXT TO the final model
+        # blob: store.save(run_id) owns get_checkpoint_path itself.
+        start_epoch, best = 0, float("inf")
         history = []
         val_history = [] if val_batches is not None else None
-        best = float("inf")
-        for epoch in range(self.epochs):
-            epoch_losses = []
+        logs_list = []
+        train_ckpt = self.store.get_checkpoint_path(
+            self.run_id) + ".training"
+        if self.resume and self.store.exists(train_ckpt):
+            blob = pickle.loads(self.store.read(train_ckpt))
+            params = jax.tree.map(jnp.asarray, blob["params"])
+            opt_state = jax.tree.map(
+                lambda a: jnp.asarray(a) if isinstance(
+                    a, (np.ndarray, np.generic)) else a,
+                blob["opt_state"])
+            start_epoch = blob["epoch"] + 1
+            best = blob.get("best", float("inf"))
+            history = list(blob.get("history", []))
+            logs_list = list(blob.get("logs", []))
+            if val_history is not None:
+                val_history = list(blob.get("val_history") or [])
+
+        rank0 = hvd.rank() == 0
+        for cb in self.callbacks:
+            if rank0 and hasattr(cb, "on_train_begin"):
+                cb.on_train_begin({})
+
+        stop = False
+        cb_error = None
+        for epoch in range(start_epoch, self.epochs):
+            epoch_losses, msums = [], {}
             it = batches(epoch)
             if steps_per_epoch is not None:
                 it = itertools.islice(it, steps_per_epoch)
             for xb, yb in it:
-                params, opt_state, l = run_batch(params, opt_state, xb, yb)
+                params, opt_state, l, metr = run_batch(
+                    params, opt_state, xb, yb)
                 epoch_losses.append(l)
+                for k, v in metr.items():
+                    msums[k] = msums.get(k, 0.0) + v
             if not epoch_losses:
                 # A silent loss=0.0 epoch would win best-epoch selection
                 # and checkpoint the untrained params.
@@ -395,6 +476,9 @@ class Estimator:
                     "batch_size")
             epoch_loss = float(np.mean(epoch_losses))
             history.append(epoch_loss)
+            logs = {"loss": epoch_loss}
+            logs.update({k: v / len(epoch_losses)
+                         for k, v in msums.items()})
             # Best-epoch selection on validation loss when given, training
             # loss otherwise (reference: estimators checkpoint on the
             # monitored metric, BestModelCheckpoint).
@@ -403,14 +487,46 @@ class Estimator:
                 vit = val_batches()
                 if val_steps_per_epoch is not None:
                     vit = itertools.islice(vit, val_steps_per_epoch)
-                val_loss = run_val(params, vit)
+                val_loss, val_metr = run_val(params, vit)
                 val_history.append(val_loss)
+                logs["val_loss"] = val_loss
+                logs.update({f"val_{k}": v for k, v in val_metr.items()})
                 monitored = val_loss
-            if monitored < best:
-                best = monitored
-                if hvd.rank() == 0:
-                    host_params = jax.tree.map(np.asarray, params)
+            logs_list.append(logs)
+            if rank0:
+                host_params = jax.tree.map(np.asarray, params)
+                if monitored < best:
+                    best = monitored
                     self.store.save(self.run_id, pickle.dumps(
                         {"params": host_params, "history": history,
-                         "val_history": val_history}))
+                         "val_history": val_history, "logs": logs_list}))
+                host_opt = jax.tree.map(
+                    lambda a: np.asarray(a) if hasattr(a, "shape") else a,
+                    opt_state)
+                self.store.write(train_ckpt, pickle.dumps(
+                    {"params": host_params, "opt_state": host_opt,
+                     "epoch": epoch, "best": min(best, monitored),
+                     "history": history, "val_history": val_history,
+                     "logs": logs_list}))
+                try:
+                    for cb in self.callbacks:
+                        if hasattr(cb, "on_epoch_end"):
+                            cb.on_epoch_end(epoch, dict(logs))
+                except StopTraining:
+                    stop = True
+                except Exception as exc:
+                    # A broken callback must not wedge the world: the
+                    # other ranks are about to block in the stop
+                    # broadcast, so release them before re-raising.
+                    cb_error = exc
+                    stop = True
+            if distributed:
+                from .. import functions as _functions
+                stop = bool(_functions.broadcast_object(
+                    stop, root_rank=0, name="est.stop"))
+            if cb_error is not None:
+                raise cb_error
+            if stop:
+                break
+        self._last_logs = logs_list
         return history, val_history

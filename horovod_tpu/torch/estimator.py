@@ -31,44 +31,11 @@ import torch
 from ..spark.store import Store
 
 
-class _StopTraining(Exception):
-    """Raised by a callback to end training after the current epoch."""
+# Shared with the flax estimator (both families accept the same callback
+# protocol); re-exported here for the torch-facing surface.
+from ..callbacks import EarlyStopping, StopTraining  # noqa: E402,F401
 
-
-class EarlyStopping:
-    """Stop when a monitored metric stops improving (reference: estimator
-    users pass keras/torch early-stop callbacks through ``callbacks``).
-
-    Runs on rank 0; the estimator broadcasts the stop decision so all ranks
-    leave the collective loop together.
-    """
-
-    def __init__(self, monitor: str = "val_loss", min_delta: float = 0.0,
-                 patience: int = 0):
-        self.monitor = monitor
-        self.min_delta = min_delta
-        self.patience = patience
-        self._best = float("inf")
-        self._wait = 0
-
-    def on_train_begin(self, logs=None):
-        self._best = float("inf")
-        self._wait = 0
-
-    def on_epoch_end(self, epoch: int, logs: Dict[str, float]):
-        value = logs.get(self.monitor)
-        if value is None:
-            raise KeyError(
-                f"EarlyStopping monitors {self.monitor!r} but the epoch "
-                f"logs only have {sorted(logs)} — pass validation data for "
-                "val_* metrics")
-        if value < self._best - self.min_delta:
-            self._best = value
-            self._wait = 0
-        else:
-            self._wait += 1
-            if self._wait > self.patience:
-                raise _StopTraining()
+_StopTraining = StopTraining  # back-compat alias
 
 
 class TorchModel:
