@@ -27,15 +27,34 @@ def mse(pred, target):
     return ((pred[:, 0] - target) ** 2).mean()
 
 
-est = Estimator(model=MLP(features=(16, 1)), optimizer=optax.adam(5e-2),
-                loss=mse, store=LocalStore(store_dir), epochs=8,
-                batch_size=32, run_id="proc1",
-                feature_cols=["f0", "f1"], label_col="label")
+def mae(pred, target):
+    import jax.numpy as jnp
+    return jnp.abs(pred[:, 0] - target).mean()
+
+
+def make_est(epochs):
+    return Estimator(model=MLP(features=(16, 1)), optimizer=optax.adam(5e-2),
+                     loss=mse, store=LocalStore(store_dir), epochs=epochs,
+                     batch_size=32, run_id="proc1",
+                     feature_cols=["f0", "f1"], label_col="label",
+                     metrics={"mae": mae})
+
+
+est = make_est(epochs=8)
 hvd.init()
 history, _val_history = _remote_fit(est, data_dir)
 assert history[-1] < history[0] * 0.8, history
+assert est._last_logs and "mae" in est._last_logs[-1], \
+    "metrics must land in the distributed epoch logs"
 if hvd.rank() == 0:
     assert os.path.exists(
         est.store.get_checkpoint_path("proc1")), "rank 0 must checkpoint"
+
+# Resume under the same run_id: two more epochs continue (all ranks agree
+# on the loaded start epoch via the shared store + broadcast stop path).
+est2 = make_est(epochs=10)
+history2, _ = _remote_fit(est2, data_dir)
+assert len(history2) == 10, (len(history), len(history2))
+assert history2[:8] == history, "resume must keep the first fit's history"
 hvd.shutdown()
 print("ALL OK")
