@@ -40,6 +40,19 @@ import jax, jax.numpy as jnp
 sys.path.insert(0, %(repo)r)
 import bench
 print("MARK devices " + str(jax.devices()), flush=True)
+# One flash compile FIRST and streamed immediately: a tunnel window too
+# short for the full check still answers the round's #1 question (does
+# the kernel lower through Mosaic on the real chip).
+t0 = time.time()
+try:
+    from horovod_tpu.ops.flash_attention import flash_attention
+    q = jnp.zeros((1, 256, 2, 64), jnp.bfloat16)
+    jax.jit(lambda a, b, c: flash_attention(a, b, c)).lower(q, q, q).compile()
+    print("MARK flash_first_compile_ok %%.1fs" %% (time.time() - t0),
+          flush=True)
+except Exception as e:
+    print("MARK flash_first_compile_FAIL %%s: %%s"
+          %% (type(e).__name__, str(e)[:400]), flush=True)
 t0 = time.time()
 kc = bench._kernel_compile_check(jax, jnp)
 print("MARK kernel_compile_check %%.1fs " %% (time.time() - t0)
