@@ -39,7 +39,8 @@ class EstimatorModel:
     checkpoint and serves ``transform``)."""
 
     def __init__(self, model, params, run_id: str, history,
-                 val_history=None, logs=None):
+                 val_history=None, logs=None, feature_cols=None,
+                 label_col=None):
         self.model = model
         self.params = params
         self.run_id = run_id
@@ -48,11 +49,40 @@ class EstimatorModel:
         # Per-epoch logs dicts (loss/val_loss + any metrics) — the richer
         # view the callbacks receive (reference: Keras History.history).
         self.logs = logs or []
+        self.feature_cols = feature_cols
+        self.label_col = label_col
 
     def transform(self, x):
-        """Predict on a host batch (reference: model.transform(df))."""
+        """Predict. An array predicts directly; a pandas DataFrame returns
+        a copy with a ``<label>__output`` column (reference:
+        ``TransformerModel.transform`` adds output columns to the Spark
+        DataFrame; same semantics as ``TorchModel.transform``)."""
         import jax.numpy as jnp
-        return self.model.apply(self.params, jnp.asarray(x))
+        import numpy as np
+        try:
+            import pandas as pd
+            is_df = isinstance(x, pd.DataFrame)
+        except ImportError:
+            is_df = False
+        if not is_df:
+            return self.model.apply(self.params, jnp.asarray(x))
+        if not self.feature_cols:
+            raise ValueError("transform(DataFrame) needs feature_cols "
+                             "(fit with feature_cols, or set them)")
+        # Same column semantics as the training reader (table_to_x):
+        # scalar columns stack; a single list-typed column is used as-is.
+        cols = [np.asarray(x[c].tolist()) for c in self.feature_cols]
+        if len(cols) == 1:
+            xa = cols[0]
+        else:
+            cols = [c[..., None] if c.ndim == 1 else c for c in cols]
+            xa = np.concatenate(cols, axis=-1)
+        out = np.asarray(self.model.apply(self.params, jnp.asarray(xa)))
+        out_df = x.copy()
+        name = f"{self.label_col or 'pred'}__output"
+        out_df[name] = list(out) if out.ndim > 1 and out.shape[-1] > 1 \
+            else np.asarray(out).reshape(len(out_df), -1)[:, 0]
+        return out_df
 
     @classmethod
     def load(cls, model, store: Store, run_id: str) -> "EstimatorModel":
@@ -61,7 +91,9 @@ class EstimatorModel:
         params = jax.tree.map(lambda a: a, blob["params"])
         return cls(model, params, run_id, blob.get("history", []),
                    val_history=blob.get("val_history"),
-                   logs=blob.get("logs"))
+                   logs=blob.get("logs"),
+                   feature_cols=blob.get("feature_cols"),
+                   label_col=blob.get("label_col"))
 
 
 def _remote_fit(estimator: "Estimator", train_path: str,
@@ -113,7 +145,8 @@ class Estimator:
                  sample_input=None,
                  metrics: Optional[dict] = None,
                  callbacks: Optional[list] = None,
-                 resume: bool = True):
+                 resume: bool = True,
+                 gradient_compression=None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -140,6 +173,11 @@ class Estimator:
         # Resume from the per-epoch training checkpoint under the same
         # run_id (reference: _load_checkpoint → last_checkpoint_state).
         self.resume = resume
+        # Wire compression for the gradient averaging (reference:
+        # estimators' gradient_compression param) — forwarded to
+        # hvd.DistributedOptimizer (fp16/bf16, a Compressor, or a
+        # per-layer CompressionConfig).
+        self.gradient_compression = gradient_compression
 
     # ------------------------------------------------------------------
     def fit(self, data, num_proc: Optional[int] = None,
@@ -214,7 +252,9 @@ class Estimator:
         blob = pickle.loads(self.store.load(self.run_id))
         return EstimatorModel(self.model, blob["params"], self.run_id,
                               history, val_history=val_history,
-                              logs=blob.get("logs"))
+                              logs=blob.get("logs"),
+                              feature_cols=self.feature_cols,
+                              label_col=self.label_col)
 
     # ------------------------------------------------------------------
     def _as_spark_df(self, data):
@@ -274,7 +314,9 @@ class Estimator:
         blob = pickle.loads(self.store.load(self.run_id))
         return EstimatorModel(self.model, blob["params"], self.run_id,
                               history, val_history=val_history,
-                              logs=blob.get("logs"))
+                              logs=blob.get("logs"),
+                              feature_cols=self.feature_cols,
+                              label_col=self.label_col)
 
     def _fit_loop(self, batches: Callable, distributed: bool,
                   local_steps: Optional[int] = None,
@@ -337,7 +379,8 @@ class Estimator:
 
         rng = jax.random.PRNGKey(self.seed)
         params = self.model.init(rng, jnp.asarray(sample))
-        opt = hvd.DistributedOptimizer(self.optimizer)
+        opt = hvd.DistributedOptimizer(
+            self.optimizer, compression=self.gradient_compression)
         opt_state = opt.init(params)
         model, loss_fn = self.model, self.loss
         metric_items = tuple(self.metrics.items())
@@ -499,7 +542,9 @@ class Estimator:
                     best = monitored
                     self.store.save(self.run_id, pickle.dumps(
                         {"params": host_params, "history": history,
-                         "val_history": val_history, "logs": logs_list}))
+                         "val_history": val_history, "logs": logs_list,
+                         "feature_cols": self.feature_cols,
+                         "label_col": self.label_col}))
                 host_opt = jax.tree.map(
                     lambda a: np.asarray(a) if hasattr(a, "shape") else a,
                     opt_state)

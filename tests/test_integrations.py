@@ -473,3 +473,30 @@ class TestEstimatorTrainingFeatures:
         est2, _, _ = self._fit(tmp_path, spmd8, epochs=4, resume=False)
         m2 = est2.fit((X, Y))
         assert len(m2.history) == 4
+
+    def test_dataframe_transform_adds_output_column(self, spmd8, tmp_path):
+        import pandas as pd
+        est, X, Y = self._fit(tmp_path, spmd8,
+                              feature_cols=[f"f{i}" for i in range(8)],
+                              label_col="label")
+        df = pd.DataFrame({f"f{i}": X[:, i] for i in range(8)})
+        df["label"] = Y[:, 0]
+        trained = est.fit(df)
+        out = trained.transform(df.head(16))
+        assert "label__output" in out.columns
+        assert len(out) == 16
+        # Round-trip through the store keeps the column metadata.
+        from horovod_tpu.integrations import EstimatorModel
+        from horovod_tpu.models import MLP
+        loaded = EstimatorModel.load(MLP(features=(16, 1)), est.store,
+                                     est.run_id)
+        out2 = loaded.transform(df.head(16))
+        np.testing.assert_allclose(out["label__output"],
+                                   out2["label__output"])
+
+    def test_gradient_compression_passthrough(self, spmd8, tmp_path):
+        from horovod_tpu.compression import Compression
+        est, X, Y = self._fit(tmp_path, spmd8,
+                              gradient_compression=Compression.fp16)
+        trained = est.fit((X, Y))
+        assert trained.history[-1] < trained.history[0] * 0.5
