@@ -110,7 +110,8 @@ def _remote_fit(estimator: "Estimator", train_path: str,
         hvd.init()
     reader = ParquetShardReader(
         train_path, estimator.feature_cols, estimator.label_col,
-        batch_size=estimator.batch_size, rank=hvd.rank(), size=hvd.size())
+        batch_size=estimator.batch_size, rank=hvd.rank(), size=hvd.size(),
+        weight_col=getattr(estimator, "sample_weight_col", None))
     # Every step issues blocking cross-rank collectives, so all ranks MUST
     # run the same number of steps; shards can be uneven (fragment sizes,
     # dropped partials) — agree on the minimum full-batch count.
@@ -120,7 +121,8 @@ def _remote_fit(estimator: "Estimator", train_path: str,
         val_reader = ParquetShardReader(
             val_path, estimator.feature_cols, estimator.label_col,
             batch_size=estimator.batch_size, rank=hvd.rank(),
-            size=hvd.size())
+            size=hvd.size(),
+            weight_col=getattr(estimator, "sample_weight_col", None))
         val_batches = lambda: val_reader.batches()  # noqa: E731
         val_local_steps = val_reader.rows() // estimator.batch_size
     return estimator._fit_loop(lambda _epoch: reader.batches(),
@@ -146,7 +148,8 @@ class Estimator:
                  metrics: Optional[dict] = None,
                  callbacks: Optional[list] = None,
                  resume: bool = True,
-                 gradient_compression=None):
+                 gradient_compression=None,
+                 sample_weight_col: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -178,6 +181,11 @@ class Estimator:
         # hvd.DistributedOptimizer (fp16/bf16, a Compressor, or a
         # per-layer CompressionConfig).
         self.gradient_compression = gradient_compression
+        # Per-row weight column (reference: sample_weight_col). Weighted
+        # training needs a PER-SAMPLE loss: ``loss(pred, y)`` must return
+        # a vector, which the loop weight-averages (same contract as the
+        # torch estimator's reduction='none' requirement).
+        self.sample_weight_col = sample_weight_col
 
     # ------------------------------------------------------------------
     def fit(self, data, num_proc: Optional[int] = None,
@@ -210,8 +218,7 @@ class Estimator:
         if isinstance(data, str):
             return self.fit_on_parquet(data, num_proc=num_proc,
                                        val_path=validation)
-        x, y = data
-        return self._fit_arrays(x, y, validation=validation)
+        return self._fit_arrays(*data, validation=validation)
 
     def fit_on_parquet(self, train_path: str,
                        num_proc: Optional[int] = None,
@@ -239,12 +246,14 @@ class Estimator:
             bs = max(self.batch_size // n_shards * n_shards, n_shards)
             reader = ParquetShardReader(
                 train_path, self.feature_cols, self.label_col,
-                batch_size=bs, rank=0, size=1)
+                batch_size=bs, rank=0, size=1,
+                weight_col=self.sample_weight_col)
             val_batches = None
             if val_path:
                 val_reader = ParquetShardReader(
                     val_path, self.feature_cols, self.label_col,
-                    batch_size=bs, rank=0, size=1)
+                    batch_size=bs, rank=0, size=1,
+                    weight_col=self.sample_weight_col)
                 val_batches = lambda: val_reader.batches()  # noqa: E731
             history, val_history = self._fit_loop(
                 lambda _e: reader.batches(), distributed=False,
@@ -264,31 +273,33 @@ class Estimator:
         from ..spark.fit_dispatch import as_dataframe
         return as_dataframe(data)
 
-    def _fit_arrays(self, x, y, validation=None) -> EstimatorModel:
+    def _fit_arrays(self, x, y, w=None, validation=None) -> EstimatorModel:
         import numpy as np
 
         import horovod_tpu as hvd
         if not hvd.is_initialized():
             hvd.init()
-        x = np.asarray(x)
-        y = np.asarray(y)
-        val_xy = None
+        arrays = [np.asarray(x), np.asarray(y)]
+        if w is not None:
+            arrays.append(np.asarray(w))
+        val_arrays = None
         if isinstance(validation, float):
             # Fraction split (reference: validation as a ratio,
             # spark/common/params.py validation docs).
-            n_val = int(len(x) * validation)
-            if not 0 < n_val < len(x):
+            n = len(arrays[0])
+            n_val = int(n * validation)
+            if not 0 < n_val < n:
                 raise ValueError(f"validation fraction {validation} leaves "
                                  "no train or no val rows")
-            val_xy = (x[-n_val:], y[-n_val:])
-            x, y = x[:-n_val], y[:-n_val]
+            val_arrays = [a[-n_val:] for a in arrays]
+            arrays = [a[:-n_val] for a in arrays]
         elif validation is not None:
             if not (isinstance(validation, (tuple, list))
-                    and len(validation) == 2):
+                    and len(validation) in (2, 3)):
                 raise ValueError(
                     "validation for array data must be a float fraction or "
-                    "an (x, y) pair")
-            val_xy = (np.asarray(validation[0]), np.asarray(validation[1]))
+                    "an (x, y[, weights]) tuple")
+            val_arrays = [np.asarray(a) for a in validation]
         # Batches must tile the mesh's data axis evenly; trim the remainder
         # (the reference's Petastorm loader repartitions for the same
         # reason).
@@ -296,18 +307,18 @@ class Estimator:
         bs = max(self.batch_size // n_shards * n_shards, n_shards)
 
         def batches(_epoch):
-            for i in range(0, len(x) - bs + 1, bs):
-                yield x[i:i + bs], y[i:i + bs]
+            n = len(arrays[0])
+            for i in range(0, n - bs + 1, bs):
+                yield tuple(a[i:i + bs] for a in arrays)
 
         val_batches = None
-        if val_xy is not None:
-            xv, yv = val_xy
-            nv = len(xv) // n_shards * n_shards
+        if val_arrays is not None:
+            nv = len(val_arrays[0]) // n_shards * n_shards
             if nv == 0:
                 raise ValueError("validation set smaller than the mesh")
 
             def val_batches():
-                yield xv[:nv], yv[:nv]
+                yield tuple(a[:nv] for a in val_arrays)
 
         history, val_history = self._fit_loop(batches, distributed=False,
                                               val_batches=val_batches)
@@ -388,23 +399,42 @@ class Estimator:
         def with_metrics(pred, yb):
             return {name: fn(pred, yb) for name, fn in metric_items}
 
+        def unpack(b):
+            return b if len(b) == 3 else (b[0], b[1], None)
+
+        def combined_loss(pred, yb, wb):
+            l = loss_fn(pred, yb)
+            if wb is not None:
+                # Static (trace-time) shape check: weighting needs the
+                # per-sample vector (same contract as the torch
+                # estimator's reduction='none' requirement).
+                if l.ndim == 0:
+                    raise ValueError(
+                        "sample weights need a per-sample loss: "
+                        "loss(pred, y) must return a vector (no mean) "
+                        "so weights can be applied")
+                return (l * wb).sum() / jnp.maximum(wb.sum(), 1e-38)
+            return l if l.ndim == 0 else l.mean()
+
         if distributed:
             # Process mode: local jitted grads; cross-rank averaging happens
             # in opt.update through the eager collective plane.
             params = hvd.broadcast_parameters(params, root_rank=0)
 
             @jax.jit
-            def grad_step(p, xb, yb):
+            def grad_step(p, xb, yb, wb):
                 def objective(q):
                     pred = model.apply(q, xb)
-                    return loss_fn(pred, yb), with_metrics(pred, yb)
+                    return combined_loss(pred, yb, wb), \
+                        with_metrics(pred, yb)
                 return jax.value_and_grad(objective, has_aux=True)(p)
 
             apply = jax.jit(optax.apply_updates)
 
-            def run_batch(p, s, xb, yb):
-                (l, metr), g = grad_step(p, jnp.asarray(xb),
-                                         jnp.asarray(yb))
+            def run_batch(p, s, xb, yb, wb):
+                (l, metr), g = grad_step(
+                    p, jnp.asarray(xb), jnp.asarray(yb),
+                    None if wb is None else jnp.asarray(wb))
                 updates, s = opt.update(g, s, p)
                 l = float(np.asarray(
                     hvd.allreduce(np.asarray(l), op=hvd.Average)))
@@ -414,11 +444,12 @@ class Estimator:
                 return apply(p, updates), s, l, metr
         else:
             def train_step(p, s, batch):
-                xb, yb = batch
+                xb, yb, wb = unpack(batch)
 
                 def objective(q):
                     pred = model.apply(q, xb)
-                    return loss_fn(pred, yb), with_metrics(pred, yb)
+                    return combined_loss(pred, yb, wb), \
+                        with_metrics(pred, yb)
 
                 (l, metr), g = jax.value_and_grad(
                     objective, has_aux=True)(p)
@@ -433,9 +464,11 @@ class Estimator:
 
             step = hvd.data_parallel_step(train_step, donate_state=False)
 
-            def run_batch(p, s, xb, yb):
-                batch = hvd.shard_batch((jnp.asarray(xb), jnp.asarray(yb)))
-                p, s, l, metr = step(p, s, batch)
+            def run_batch(p, s, xb, yb, wb):
+                parts = [jnp.asarray(xb), jnp.asarray(yb)]
+                if wb is not None:
+                    parts.append(jnp.asarray(wb))
+                p, s, l, metr = step(p, s, hvd.shard_batch(tuple(parts)))
                 return p, s, float(l), {k: float(v)
                                         for k, v in metr.items()}
 
@@ -443,14 +476,17 @@ class Estimator:
         # ranks in distributed mode (the SPMD-local val batch is
         # replicated).
         @jax.jit
-        def eval_step(p, xb, yb):
+        def eval_step(p, xb, yb, wb):
             pred = model.apply(p, xb)
-            return loss_fn(pred, yb), with_metrics(pred, yb)
+            return combined_loss(pred, yb, wb), with_metrics(pred, yb)
 
         def run_val(p, it):
             losses, msums = [], {}
-            for xv, yv in it:
-                l, metr = eval_step(p, jnp.asarray(xv), jnp.asarray(yv))
+            for b in it:
+                xv, yv, wv = unpack(b)
+                l, metr = eval_step(
+                    p, jnp.asarray(xv), jnp.asarray(yv),
+                    None if wv is None else jnp.asarray(wv))
                 if distributed:
                     l = hvd.allreduce(np.asarray(l), op=hvd.Average)
                     metr = {k: hvd.allreduce(np.asarray(v), op=hvd.Average,
@@ -504,9 +540,10 @@ class Estimator:
             it = batches(epoch)
             if steps_per_epoch is not None:
                 it = itertools.islice(it, steps_per_epoch)
-            for xb, yb in it:
+            for b in it:
+                xb, yb, wb = unpack(b)
                 params, opt_state, l, metr = run_batch(
-                    params, opt_state, xb, yb)
+                    params, opt_state, xb, yb, wb)
                 epoch_losses.append(l)
                 for k, v in metr.items():
                     msums[k] = msums.get(k, 0.0) + v

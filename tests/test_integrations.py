@@ -500,3 +500,25 @@ class TestEstimatorTrainingFeatures:
                               gradient_compression=Compression.fp16)
         trained = est.fit((X, Y))
         assert trained.history[-1] < trained.history[0] * 0.5
+
+    def test_sample_weights_mask_rows(self, spmd8, tmp_path):
+        # Poisoned labels with zero weight must not affect training
+        # (weights actually applied through the SPMD step).
+        import jax.numpy as jnp
+        est, X, Y = self._fit(
+            tmp_path, spmd8, epochs=10,
+            loss=lambda p, t: ((p - t) ** 2).mean(axis=-1))
+        y_poison = Y.copy()
+        y_poison[::2] += 100.0
+        w = np.ones(len(Y), np.float32)
+        w[::2] = 0.0
+        trained = est.fit((X, y_poison, w))
+        pred = np.asarray(trained.transform(X))
+        assert float(np.mean((pred - Y) ** 2)) < 1.0
+
+    def test_sample_weights_need_per_sample_loss(self, spmd8, tmp_path):
+        est, X, Y = self._fit(tmp_path, spmd8, epochs=1)  # scalar loss
+        w = np.ones(len(Y), np.float32)
+        import pytest
+        with pytest.raises(ValueError, match="per-sample"):
+            est.fit((X, Y, w))
