@@ -55,7 +55,7 @@ from .parallel.ulysses import (ulysses_attention, ulysses_attention_p,
 from .ops.flash_attention import flash_attention
 
 # Compression (reference: horovod/torch/compression.py + IST fork subsystem).
-from .compression import Compression
+from .compression import Compression, set_quantization_levels
 
 # Object collectives (reference: horovod/torch/functions.py).
 from .functions import broadcast_object, allgather_object

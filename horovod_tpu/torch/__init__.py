@@ -36,10 +36,17 @@ from .. import (mpi_threads_supported, mpi_enabled, mpi_built,  # noqa: F401
                 gloo_enabled, gloo_built, nccl_built, ddl_built, ccl_built,
                 cuda_built, rocm_built)
 from .optimizer import DistributedOptimizer
-from .compression import Compression
+from .compression import (Compression, Compressor, NoneCompressor,
+                          FP16Compressor, FP32Compressor)
 from .sync_batch_norm import SyncBatchNorm
 from .estimator import TorchEstimator, TorchModel, EarlyStopping
 from . import elastic
+# Reference users import these through the framework namespace
+# (horovod.torch re-exports HorovodInternalError & the quantization-level
+# hook; reference: torch/__init__.py imports from common).
+from ..exceptions import (HvdTpuInternalError, HostsUpdatedInterrupt,
+                          NotInitializedError)
+from ..compression import set_quantization_levels
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
@@ -52,7 +59,10 @@ __all__ = [
     "join", "poll", "synchronize",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer", "Compression",
+    "Compressor", "NoneCompressor", "FP16Compressor", "FP32Compressor",
     "SyncBatchNorm", "TorchEstimator", "TorchModel", "EarlyStopping",
+    "HvdTpuInternalError", "HostsUpdatedInterrupt", "NotInitializedError",
+    "set_quantization_levels",
     "mpi_threads_supported", "mpi_enabled", "mpi_built", "gloo_enabled",
     "gloo_built", "nccl_built", "ddl_built", "ccl_built", "cuda_built",
     "rocm_built",

@@ -10,7 +10,22 @@ from __future__ import annotations
 import torch
 
 
-class NoneCompressor:
+class Compressor:
+    """Base interface (reference: ``Compressor``,
+    torch/compression.py): ``compress(tensor) -> (wire, ctx)`` and
+    ``decompress(wire, ctx) -> tensor``. Subclass to plug a custom wire
+    format into ``DistributedOptimizer(compression=...)``."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
     @staticmethod
     def compress(tensor: torch.Tensor):
         return tensor, None
@@ -20,7 +35,12 @@ class NoneCompressor:
         return tensor
 
 
-class FP16Compressor:
+# Reference parity alias (torch/compression.py FP32Compressor: a no-op
+# "compress to fp32" used as the none-compression default there).
+FP32Compressor = NoneCompressor
+
+
+class FP16Compressor(Compressor):
     """Cast to fp16 for the wire, back to the original dtype after
     (reference: FP16Compressor, torch/compression.py)."""
 
