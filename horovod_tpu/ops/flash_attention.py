@@ -122,7 +122,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # Upper-triangle blocks contribute nothing — skip their DMA+FLOPs.
         pl.when(kj <= qi)(_step)
     else:
-        _step()
+        # Trivially-true predicate, NOT a bare _step() call: interpret
+        # mode's vma tracing (CPU-mesh shard_map) only standardizes the
+        # block-fetch slice's varying axes along the pl.when path — an
+        # unguarded body trips "dynamic_slice requires varying manual
+        # axes to match". Compiled Mosaic folds the constant predicate.
+        pl.when(kj >= 0)(_step)
 
     @pl.when(kj == n_k_blocks - 1)
     def _finish():
@@ -176,7 +181,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # Earlier query blocks never see these keys — skip them.
         pl.when(qi >= kj)(_step)
     else:
-        _step()
+        pl.when(qi >= 0)(_step)  # trivially true; see _fwd_kernel note
 
     @pl.when(qi == n_q_blocks - 1)
     def _finish():
@@ -214,7 +219,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     if causal:
         pl.when(kj <= qi)(_step)
     else:
-        _step()
+        pl.when(kj >= 0)(_step)  # trivially true; see _fwd_kernel note
 
     @pl.when(kj == n_k_blocks - 1)
     def _finish():
