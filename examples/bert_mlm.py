@@ -1,0 +1,89 @@
+"""Masked-LM pretraining of the bidirectional encoder, data-parallel.
+
+The BERT-style counterpart of ``gpt_parallel.py``: corrupt a fraction of
+tokens, train the encoder to recover them at the masked positions only,
+sharded over the mesh through ``data_parallel_step``. ``--attention flash``
+uses the fused non-causal Pallas kernel (interpret mode off-TPU).
+
+    python examples/bert_mlm.py --steps 30
+    python examples/bert_mlm.py --attention flash --seq 256
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import Encoder, masked_lm_loss
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--seq", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=64)
+    parser.add_argument("--mask-rate", type=float, default=0.3)
+    parser.add_argument("--attention", choices=["dense", "flash"],
+                        default="dense")
+    args = parser.parse_args()
+
+    hvd.init()
+    rng = np.random.RandomState(hvd.rank())
+
+    if args.attention == "flash":
+        from horovod_tpu.ops.flash_attention import flash_attention
+        attn_fn = flash_attention
+    else:
+        from horovod_tpu.models import default_attention
+        attn_fn = default_attention
+
+    model = Encoder(vocab_size=args.vocab, num_layers=2, num_heads=4,
+                    head_dim=16, embed_dim=64, mlp_dim=128,
+                    dtype=jnp.float32, attn_fn=attn_fn)
+
+    # Toy periodic language: token = position (mod vocab) — fully
+    # recoverable from bidirectional context.
+    base = np.arange(args.seq) % args.vocab
+    tokens = np.tile(base, (args.batch, 1)).astype(np.int32)
+    mask = (rng.rand(args.batch, args.seq) < args.mask_rate).astype(
+        np.float32)
+    corrupted = np.where(mask > 0, (tokens + 7) % args.vocab, tokens)
+
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(corrupted[:1]))
+    opt = hvd.DistributedOptimizer(optax.adam(5e-3))
+    state = opt.init(params)
+
+    def train_step(p, s, batch):
+        inp, tgt, msk = batch
+
+        def loss_fn(q):
+            return masked_lm_loss(model.apply(q, inp), tgt, msk)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, hvd.allreduce(
+            l, op=hvd.Average)
+
+    step = hvd.data_parallel_step(train_step, donate_state=False)
+    batch = hvd.shard_batch((jnp.asarray(corrupted), jnp.asarray(tokens),
+                             jnp.asarray(mask)))
+    first = last = None
+    for i in range(args.steps):
+        params, state, loss = step(params, state, batch)
+        last = float(loss)
+        first = first if first is not None else last
+        if i % 10 == 0:
+            print(f"step {i:4d}  mlm loss {last:.4f}")
+    print(f"loss {first:.4f} -> {last:.4f} over {args.steps} steps "
+          f"({hvd.size()} shards, {args.attention} attention)")
+    assert last < first, "masked-LM loss did not improve"
+    print("bert mlm ok")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
