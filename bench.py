@@ -1282,6 +1282,13 @@ def _supervise():
                     f"tunnel hang): {sig}")), flush=True)
                 return 1
             if fast:
+                # Fast-crash respawn path: keep the best-so-far line
+                # current anyway (the crash may follow completed phases).
+                _partial.clear()
+                _partial.update(load("partial.json") or {})
+                print(json.dumps(_fallback_result(
+                    f"interim: child attempt {attempt} crashed fast "
+                    f"({sig}); supervisor still running")), flush=True)
                 time.sleep(2.0)
                 continue
         else:
@@ -1311,6 +1318,16 @@ def _supervise():
                     pass
                 elif key:
                     skip.add(key)
+        # Interim best-so-far JSON line after EVERY attempt: consumers read
+        # the LAST stdout line, so if the driver's own timeout kills this
+        # supervisor mid-run, the record still carries every measurement
+        # landed so far instead of nothing (later lines supersede this).
+        _partial.clear()
+        _partial.update(load("partial.json") or {})
+        print(json.dumps(_fallback_result(
+            f"interim: child attempt {attempt} did not finish "
+            f"(last phase {last_phase}); supervisor still running")),
+            flush=True)
         time.sleep(min(10.0, max(0.0, budget_end - time.monotonic())))
 
     partial = load("partial.json") or {}
