@@ -1134,6 +1134,24 @@ def _child_main():
     return 0
 
 
+def _skip_key(struck: str):
+    """Which phase key (if any) to skip after repeated strikes attributed
+    to ``struck``. ``"after:X"`` attributions (hang/crash between phases)
+    map to X's SUCCESSOR in the phase order — its pre-guard code is where
+    the child is stuck (a completed phase emits no event, so attribution
+    lands on the next live phase). ``backend_init`` is never skippable:
+    nothing can run without a backend."""
+    key = struck.split("(")[0]
+    if key.startswith("after:"):
+        order = list(_PHASE_DEADLINES)
+        prev = key[len("after:"):]
+        if prev in order and order.index(prev) + 1 < len(order):
+            key = order[order.index(prev) + 1]
+        else:
+            return None
+    return None if key == "backend_init" else key
+
+
 def _read_events(path):
     events = []
     try:
@@ -1299,24 +1317,8 @@ def _supervise():
         if struck:
             stall_counts[struck] = stall_counts.get(struck, 0) + 1
             if stall_counts[struck] >= 2:
-                key = struck.split("(")[0]
-                if key.startswith("after:"):
-                    # The hang sits between phases: phase_end(X) was seen
-                    # but the next phase_start never came. Skip X's
-                    # SUCCESSOR — its pre-guard code is where the child is
-                    # stuck (a guarded key that already completed emits no
-                    # event, so attribution lands on the next live phase).
-                    order = list(_PHASE_DEADLINES)
-                    prev = key[len("after:"):]
-                    if prev in order and order.index(prev) + 1 < len(order):
-                        key = order[order.index(prev) + 1]
-                    else:
-                        key = None
-                if key == "backend_init":
-                    # Not skippable: nothing can run without a backend.
-                    # Keep retrying — each child is a fresh connection.
-                    pass
-                elif key:
+                key = _skip_key(struck)
+                if key:
                     skip.add(key)
         # Interim best-so-far JSON line after EVERY attempt: consumers read
         # the LAST stdout line, so if the driver's own timeout kills this

@@ -67,6 +67,22 @@ def test_bench_cli_contract():
     assert "skipped" in result["gpt_long_context_flash"]
 
 
+def test_supervisor_skip_key_mapping():
+    """Two stalls in a phase skip THAT phase; between-phase attributions
+    ("after:X") skip X's successor; backend_init is never skippable."""
+    import bench
+
+    assert bench._skip_key("gpt") == "gpt"
+    assert bench._skip_key("backend_init") is None
+    assert bench._skip_key("backend_init(pre-event)") is None
+    order = list(bench._PHASE_DEADLINES)
+    for prev, nxt in zip(order, order[1:]):
+        assert bench._skip_key(f"after:{prev}") == \
+            (None if nxt == "backend_init" else nxt)
+    assert bench._skip_key(f"after:{order[-1]}") is None
+    assert bench._skip_key("after:unknown") is None
+
+
 def test_bench_probe_bails_on_deterministic_failure():
     """A broken platform knob must produce a fast, precisely-diagnosed
     error — not 900 s of retries blamed on the tunnel (r03 postmortem)."""
