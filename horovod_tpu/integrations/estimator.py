@@ -516,7 +516,20 @@ class Estimator:
             self.run_id) + ".training"
         if self.resume and self.store.exists(train_ckpt):
             blob = pickle.loads(self.store.read(train_ckpt))
-            params = jax.tree.map(jnp.asarray, blob["params"])
+            loaded = jax.tree.map(jnp.asarray, blob["params"])
+            # A stale checkpoint from a DIFFERENT model under the same
+            # run_id would otherwise replace the fresh params and fail
+            # deep inside flax with an opaque apply error.
+            fresh_td = jax.tree.structure(params)
+            loaded_td = jax.tree.structure(loaded)
+            if fresh_td != loaded_td or any(
+                    a.shape != b.shape for a, b in zip(
+                        jax.tree.leaves(params), jax.tree.leaves(loaded))):
+                raise ValueError(
+                    f"run_id {self.run_id!r} has a training checkpoint for "
+                    "a different model (param tree/shape mismatch); use a "
+                    "new run_id or pass resume=False to restart")
+            params = loaded
             opt_state = jax.tree.map(
                 lambda a: jnp.asarray(a) if isinstance(
                     a, (np.ndarray, np.generic)) else a,

@@ -522,3 +522,17 @@ class TestEstimatorTrainingFeatures:
         import pytest
         with pytest.raises(ValueError, match="per-sample"):
             est.fit((X, Y, w))
+
+    def test_resume_with_different_model_raises(self, spmd8, tmp_path):
+        import optax
+        from horovod_tpu.integrations import Estimator, LocalStore
+        from horovod_tpu.models import MLP
+        est, X, Y = self._fit(tmp_path, spmd8, epochs=2)
+        est.fit((X, Y))
+        other = Estimator(model=MLP(features=(32, 32, 1)),  # different arch
+                          optimizer=optax.adam(1e-2),
+                          loss=lambda p, t: ((p - t) ** 2).mean(),
+                          store=LocalStore(str(tmp_path)), epochs=3,
+                          batch_size=64, run_id="feat1")
+        with pytest.raises(ValueError, match="different model"):
+            other.fit((X, Y))
