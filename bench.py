@@ -1112,6 +1112,20 @@ def _child_main():
     parent enforces per-phase deadlines; no in-child watchdog is needed —
     a C-level hang is exactly what the parent's kill path is for."""
     try:
+        # Persistent XLA compilation cache shared through the state dir:
+        # a killed child's compiles warm its successor, so a respawn costs
+        # seconds instead of repeating every ~20-40 s compile — a short
+        # tunnel window measures instead of recompiling.
+        if _STATE_DIR:
+            try:
+                import jax
+                jax.config.update("jax_compilation_cache_dir",
+                                  _state_path("xla_cache"))
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+            except Exception as exc:
+                print(f"bench: compilation cache unavailable: {exc}",
+                      file=sys.stderr)
         result = _run()
     except BaseException as exc:
         import traceback

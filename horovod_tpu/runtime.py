@@ -303,6 +303,20 @@ def init(comm: Optional[Sequence[int]] = None,
         _init_kwargs = dict(comm=comm, mode=mode, mesh_shape=mesh_shape,
                             axis_names=axis_names, dp_axis=dp_axis,
                             devices=devices)
+        # Persistent XLA compilation cache (HVDTPU_COMPILATION_CACHE_DIR):
+        # restarts — elastic resets, respawned jobs — reuse prior compiles
+        # instead of paying the 20-40 s first-compile again. Mirrors the
+        # reference's persist-tuned-state ethos (HOROVOD_AUTOTUNE_LOG);
+        # here the expensive state is the compiled XLA program.
+        cache_dir = os.environ.get("HVDTPU_COMPILATION_CACHE_DIR")
+        if cache_dir:
+            try:
+                import jax as _jax
+                _jax.config.update("jax_compilation_cache_dir", cache_dir)
+                _jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+            except Exception as exc:  # never fail init over a cache knob
+                log.warning("compilation cache unavailable: %s", exc)
         mode = mode or _detect_mode()
         st = _RuntimeState(mode=mode, epoch=_state.epoch + 1)
         if mode == "process":

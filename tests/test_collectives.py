@@ -414,6 +414,25 @@ class TestTopology:
         with pytest.raises(hvd.NotInitializedError):
             hvd.rank()
 
+    def test_compilation_cache_env_knob(self, tmp_path, monkeypatch):
+        """HVDTPU_COMPILATION_CACHE_DIR points the persistent XLA compile
+        cache (restart-warm compiles; the supervisor bench shares one
+        through its state dir the same way)."""
+        import jax
+
+        monkeypatch.setenv("HVDTPU_COMPILATION_CACHE_DIR",
+                           str(tmp_path / "cc"))
+        hvd.shutdown()
+        try:
+            hvd.init()
+            assert jax.config.jax_compilation_cache_dir == \
+                str(tmp_path / "cc")
+        finally:
+            hvd.shutdown()
+            # Unset for the rest of the process: later tests must not
+            # write cache entries into this test's deleted tmp dir.
+            jax.config.update("jax_compilation_cache_dir", None)
+
     def test_custom_mesh(self, make_runtime):
         h = make_runtime(mesh_shape={"dp": 4, "tp": 2})
         assert h.size() == 8
