@@ -18,6 +18,7 @@ from horovod_tpu.torch.estimator import (TorchEstimator,  # noqa: E402
 
 data_dir = os.environ["EST_DATA_DIR"]
 store_dir = os.environ["EST_STORE_DIR"]
+val_dir = os.environ.get("EST_VAL_DIR")  # optional: distributed val path
 
 model = torch.nn.Linear(2, 1)
 est = TorchEstimator(
@@ -28,9 +29,15 @@ est = TorchEstimator(
     metrics={"mae": lambda out, lab: (out[:, 0] - lab).abs().mean()},
     feature_cols=["f0", "f1"], label_cols=["label"], run_id="tproc1")
 hvd.init()
-history = _remote_fit_torch(est, data_dir)
+history = _remote_fit_torch(est, data_dir, val_dir)
 assert history[-1]["loss"] < history[0]["loss"] * 0.8, history
 assert "mae" in history[-1], history[-1]
+if val_dir:
+    # Validation ran every epoch: rank-averaged val_loss/val_mae present
+    # and improving (reference: remote.py validation loop).
+    assert "val_loss" in history[-1] and "val_mae" in history[-1], \
+        history[-1]
+    assert history[-1]["val_loss"] < history[0]["val_loss"], history
 if hvd.rank() == 0:
     assert os.path.exists(
         est.store.get_checkpoint_path("tproc1")), "rank 0 must checkpoint"

@@ -278,27 +278,35 @@ class TestDataFramePath:
 
 class TestDistributed:
     def test_remote_fit_two_processes(self, tmp_path):
-        """The process-mode body on 2 real worker processes over a sharded
-        parquet dir (reference: test_spark.py's estimator round-trips)."""
+        """The process-mode body on 2 real worker processes over sharded
+        train AND validation parquet dirs (reference: test_spark.py's
+        estimator round-trips + remote.py validation loop)."""
         from conftest import assert_all_ok, launch_world
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         rng = np.random.RandomState(3)
-        data_dir = tmp_path / "train_data"
-        data_dir.mkdir()
         w = rng.randn(2).astype(np.float32)
-        for part in range(4):
-            f0 = rng.randn(64).astype(np.float32)
-            f1 = rng.randn(64).astype(np.float32)
-            label = (f0 * w[0] + f1 * w[1]).astype(np.float32)
-            pq.write_table(
-                pa.table({"f0": f0, "f1": f1, "label": label}),
-                str(data_dir / f"part-{part}.parquet"))
+
+        def write(dirname, parts, rows):
+            d = tmp_path / dirname
+            d.mkdir()
+            for part in range(parts):
+                f0 = rng.randn(rows).astype(np.float32)
+                f1 = rng.randn(rows).astype(np.float32)
+                label = (f0 * w[0] + f1 * w[1]).astype(np.float32)
+                pq.write_table(
+                    pa.table({"f0": f0, "f1": f1, "label": label}),
+                    str(d / f"part-{part}.parquet"))
+            return d
+
+        data_dir = write("train_data", 4, 64)
+        val_dir = write("val_data", 2, 64)
         worker = os.path.join(REPO_ROOT, "tests", "data",
                               "torch_estimator_worker.py")
         results = launch_world(2, worker, extra_env={
             "EST_DATA_DIR": str(data_dir),
+            "EST_VAL_DIR": str(val_dir),
             "EST_STORE_DIR": str(tmp_path / "store"),
         })
         assert_all_ok(results)
