@@ -52,20 +52,31 @@ class EstimatorModel:
         self.feature_cols = feature_cols
         self.label_col = label_col
 
-    def transform(self, x):
+    def transform(self, x, batch_size=None):
         """Predict. An array predicts directly; a pandas DataFrame returns
         a copy with a ``<label>__output`` column (reference:
         ``TransformerModel.transform`` adds output columns to the Spark
-        DataFrame; same semantics as ``TorchModel.transform``)."""
+        DataFrame; same semantics as ``TorchModel.transform``).
+        ``batch_size`` scores in chunks so a large input never
+        materializes one giant activation set."""
         import jax.numpy as jnp
         import numpy as np
+
+        def apply(arr):
+            arr = jnp.asarray(arr)
+            if batch_size is None or arr.shape[0] <= batch_size:
+                return self.model.apply(self.params, arr)
+            return jnp.concatenate(
+                [self.model.apply(self.params, arr[i:i + batch_size])
+                 for i in range(0, arr.shape[0], batch_size)])
+
         try:
             import pandas as pd
             is_df = isinstance(x, pd.DataFrame)
         except ImportError:
             is_df = False
         if not is_df:
-            return self.model.apply(self.params, jnp.asarray(x))
+            return apply(x)
         if not self.feature_cols:
             raise ValueError("transform(DataFrame) needs feature_cols "
                              "(fit with feature_cols, or set them)")
@@ -77,7 +88,7 @@ class EstimatorModel:
         else:
             cols = [c[..., None] if c.ndim == 1 else c for c in cols]
             xa = np.concatenate(cols, axis=-1)
-        out = np.asarray(self.model.apply(self.params, jnp.asarray(xa)))
+        out = np.asarray(apply(xa))
         out_df = x.copy()
         name = f"{self.label_col or 'pred'}__output"
         out_df[name] = list(out) if out.ndim > 1 and out.shape[-1] > 1 \

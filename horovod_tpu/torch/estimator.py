@@ -54,11 +54,12 @@ class TorchModel:
         self.label_cols = label_cols
 
     @torch.no_grad()
-    def transform(self, data):
+    def transform(self, data, batch_size: Optional[int] = None):
         """Predict. A numpy array / tensor returns predictions directly; a
         pandas DataFrame returns a copy with one ``<label>__output`` column
         per head (reference: ``TorchModel.transform`` adds output columns to
-        the Spark DataFrame)."""
+        the Spark DataFrame). ``batch_size`` scores in chunks so a large
+        input never materializes one giant activation set."""
         self.model.eval()
         try:
             import pandas as pd
@@ -82,7 +83,7 @@ class TorchModel:
                 xa = np.concatenate(cols, axis=-1)
             x = torch.as_tensor(np.ascontiguousarray(xa),
                                 dtype=torch.float32)
-            outputs = self.model(x)
+            outputs = self._forward_batched(x, batch_size)
             if not isinstance(outputs, (tuple, list)):
                 outputs = [outputs]
             out_df = data.copy()
@@ -94,10 +95,20 @@ class TorchModel:
                     else o
             return out_df
         x = torch.as_tensor(np.asarray(data), dtype=torch.float32)
-        out = self.model(x)
+        out = self._forward_batched(x, batch_size)
         if isinstance(out, (tuple, list)):
             return [o.detach().numpy() for o in out]
         return out.detach().numpy()
+
+    def _forward_batched(self, x, batch_size):
+        if batch_size is None or len(x) <= batch_size:
+            return self.model(x)
+        chunks = [self.model(x[i:i + batch_size])
+                  for i in range(0, len(x), batch_size)]
+        if isinstance(chunks[0], (tuple, list)):
+            return [torch.cat([c[h] for c in chunks])
+                    for h in range(len(chunks[0]))]
+        return torch.cat(chunks)
 
     @classmethod
     def load(cls, model: torch.nn.Module, store: Store,

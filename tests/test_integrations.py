@@ -536,3 +536,10 @@ class TestEstimatorTrainingFeatures:
                           batch_size=64, run_id="feat1")
         with pytest.raises(ValueError, match="different model"):
             other.fit((X, Y))
+
+    def test_transform_batched_matches_unbatched(self, spmd8, tmp_path):
+        est, X, Y = self._fit(tmp_path, spmd8, epochs=3)
+        trained = est.fit((X, Y))
+        np.testing.assert_allclose(
+            np.asarray(trained.transform(X)),
+            np.asarray(trained.transform(X, batch_size=48)), rtol=1e-6)

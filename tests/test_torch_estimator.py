@@ -315,3 +315,11 @@ class TestDistributed:
                                  LocalStore(str(tmp_path / "store")),
                                  "tproc1")
         assert loaded.history
+
+
+def test_transform_batched_matches_unbatched(tmp_path):
+    x, y = _linear_data(n=100)
+    est = _estimator(tmp_path, epochs=4)
+    model = est.fit((x, y))
+    np.testing.assert_allclose(model.transform(x),
+                               model.transform(x, batch_size=16))
