@@ -20,11 +20,26 @@ import time
 from typing import Any, Callable, Optional
 
 __all__ = ["run", "run_elastic", "Store", "LocalStore", "FilesystemStore",
-           "HDFSStore", "DBFSLocalStore", "PandasDataFrame"]
+           "HDFSStore", "DBFSLocalStore", "PandasDataFrame",
+           "Estimator", "EstimatorModel", "TorchEstimator", "TorchModel"]
 
 from .store import (Store, LocalStore, FilesystemStore,  # noqa: E402,F401
                     HDFSStore, DBFSLocalStore)
 from .pandas_df import PandasDataFrame  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # Estimators re-exported where reference users look for them
+    # (``horovod.spark.keras.KerasEstimator`` / ``horovod.spark.torch
+    # .TorchEstimator``) — lazily, so importing the spark runner never
+    # drags in flax or torch.
+    if name in ("Estimator", "EstimatorModel"):
+        from ..integrations import estimator as _e
+        return getattr(_e, name)
+    if name in ("TorchEstimator", "TorchModel"):
+        from ..torch import estimator as _te
+        return getattr(_te, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 _POLL_S = 0.25
 

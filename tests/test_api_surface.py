@@ -50,6 +50,16 @@ def test_torch_surface():
     assert not missing, missing
 
 
+def test_spark_namespace_estimators():
+    """Reference users find estimators under the spark namespace
+    (horovod.spark.keras / horovod.spark.torch); re-exported lazily."""
+    import horovod_tpu.spark as s
+    from horovod_tpu.integrations.estimator import Estimator
+    from horovod_tpu.torch.estimator import TorchEstimator
+    assert s.Estimator is Estimator
+    assert s.TorchEstimator is TorchEstimator
+
+
 def test_elastic_surface():
     for mod, state in ((hvd.elastic, "TpuState"), (ht.elastic, "TorchState")):
         assert hasattr(mod, "run"), mod
