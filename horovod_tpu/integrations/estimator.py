@@ -160,7 +160,8 @@ class Estimator:
                  callbacks: Optional[list] = None,
                  resume: bool = True,
                  gradient_compression=None,
-                 sample_weight_col: Optional[str] = None):
+                 sample_weight_col: Optional[str] = None,
+                 verbose: int = 0):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -197,6 +198,9 @@ class Estimator:
         # a vector, which the loop weight-averages (same contract as the
         # torch estimator's reduction='none' requirement).
         self.sample_weight_col = sample_weight_col
+        # Reference param of the same name: 1 prints per-epoch logs on
+        # rank 0 (spark/common/params.py verbose).
+        self.verbose = verbose
 
     # ------------------------------------------------------------------
     def fit(self, data, num_proc: Optional[int] = None,
@@ -597,6 +601,10 @@ class Estimator:
                 logs.update({f"val_{k}": v for k, v in val_metr.items()})
                 monitored = val_loss
             logs_list.append(logs)
+            if getattr(self, "verbose", 0) and rank0:
+                print(f"[estimator {self.run_id}] epoch {epoch}: "
+                      + " ".join(f"{k}={v:.5f}" for k, v in logs.items()),
+                      flush=True)
             if rank0:
                 host_params = jax.tree.map(np.asarray, params)
                 if monitored < best:

@@ -204,7 +204,7 @@ class TorchEstimator:
                  validation_steps_per_epoch: Optional[int] = None,
                  transformation_fn: Optional[Callable] = None,
                  run_id: Optional[str] = None, seed: int = 0,
-                 shuffle: bool = True):
+                 shuffle: bool = True, verbose: int = 0):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -227,6 +227,9 @@ class TorchEstimator:
         self.run_id = run_id or "torch-run"
         self.seed = seed
         self.shuffle = shuffle
+        # Reference param of the same name: 1 prints per-epoch logs on
+        # rank 0 (spark/torch/estimator.py verbose).
+        self.verbose = verbose
         if isinstance(loss, (list, tuple)):
             if not label_cols or len(label_cols) != len(loss):
                 raise ValueError(
@@ -572,6 +575,10 @@ class TorchEstimator:
                         total / max(vcount, 1), f"torch_est.val_{name}")
 
             history.append(logs)
+            if self.verbose and rank0:
+                print(f"[torch-estimator {self.run_id}] epoch {epoch}: "
+                      + " ".join(f"{k}={v:.5f}" for k, v in logs.items()),
+                      flush=True)
 
             if rank0:
                 # Per-epoch checkpoint for resume (reference: remote.py
