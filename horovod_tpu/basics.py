@@ -32,6 +32,10 @@ _OP_TYPES = {"allreduce": 0, "allgather": 1, "broadcast": 2, "alltoall": 3,
 _DTYPES = {"uint8": 0, "int8": 1, "int32": 4, "int64": 5, "float16": 6,
            "float32": 7, "float64": 8, "bool": 9, "bfloat16": 10}
 
+# Matches hvdtpu::AllreduceAlgo (native/data_plane.h).
+_ALLREDUCE_ALGOS = {name: code
+                    for code, name in enumerate(ev.ALLREDUCE_ALGOS)}
+
 
 def _ensure_built() -> str:
     # HVDTPU_NATIVE_LIB points at an alternative build of the core — the
@@ -100,6 +104,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_stall_shutdown.restype = ctypes.c_int
     lib.hvdtpu_set_stall_shutdown.argtypes = [ctypes.c_void_p,
                                               ctypes.c_double]
+    lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
+    lib.hvdtpu_set_allreduce_tuning.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong]
     lib.hvdtpu_set_autotune.restype = ctypes.c_int
     lib.hvdtpu_set_autotune.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -184,6 +191,19 @@ class NativeCore:
         self._lib.hvdtpu_set_stall_shutdown(
             self._core,
             ev.get_float(ev.HVDTPU_STALL_SHUTDOWN_TIME_SECONDS, 0.0))
+        # Allreduce algorithm menu (reference fork: ring/scatter-allgather/
+        # tree selection). auto = size-adaptive: recursive doubling at or
+        # below the (autotuned) crossover, pipelined ring above it.
+        algo = (ev.get_str(ev.HVDTPU_ALLREDUCE_ALGO, "auto") or
+                "auto").strip().lower()
+        if algo not in _ALLREDUCE_ALGOS:
+            raise ValueError(
+                f"{ev.HVDTPU_ALLREDUCE_ALGO} must be one of "
+                f"{list(ev.ALLREDUCE_ALGOS)}, got {algo!r}")
+        self._lib.hvdtpu_set_allreduce_tuning(
+            self._core, _ALLREDUCE_ALGOS[algo],
+            ev.get_int(ev.HVDTPU_ALLREDUCE_CROSSOVER, 0),
+            ev.get_int(ev.HVDTPU_ALLREDUCE_SEGMENT_BYTES, 0))
         # Autotune (reference: HOROVOD_AUTOTUNE + HOROVOD_AUTOTUNE_* knobs,
         # operations.cc:474-532).
         if ev.get_bool(ev.HVDTPU_AUTOTUNE):

@@ -163,6 +163,9 @@ namespace {
 // better resolution at the low end that matters for latency).
 constexpr double kCycleMinMs = 0.5, kCycleMaxMs = 50.0;
 constexpr double kFusionMin = 1 << 20, kFusionMax = 256u << 20;
+// Allreduce algorithm crossover (data_plane.h): recursive doubling below,
+// pipelined ring above. Log-scale 4 KB .. 4 MB.
+constexpr double kCrossMin = 4 << 10, kCrossMax = 4 << 20;
 
 double FromUnit(double u, double lo, double hi) {
   return lo * std::pow(hi / lo, u);
@@ -176,20 +179,22 @@ double ToUnit(double v, double lo, double hi) {
 
 void ParameterManager::Initialize(double cycle_time_ms,
                                   int64_t fusion_threshold, bool cache_enabled,
+                                  int64_t algo_crossover, bool tune_crossover,
                                   const std::string& log_path,
                                   int warmup_samples, int cycles_per_sample,
                                   int max_samples, double gp_noise) {
-  current_ = {cycle_time_ms, fusion_threshold, cache_enabled};
+  current_ = {cycle_time_ms, fusion_threshold, cache_enabled, algo_crossover};
+  tune_crossover_ = tune_crossover;
   warmup_samples_ = warmup_samples;
   warmup_left_ = warmup_samples;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
-  opt_ = BayesianOptimizer(3, gp_noise);
+  opt_ = BayesianOptimizer(tune_crossover ? 4 : 3, gp_noise);
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_ != nullptr) {
       fputs("cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
-            "score_bytes_per_sec\n",
+            "algo_crossover_bytes,score_bytes_per_sec\n",
             log_);
     }
   }
@@ -204,14 +209,19 @@ ParameterManager::~ParameterManager() {
   if (log_ != nullptr) fclose(log_);
 }
 
-std::vector<double> ParameterManager::ToVector(const Params& p) {
+std::vector<double> ParameterManager::ToVector(const Params& p) const {
   // Dim 2 is the categorical cache switch: a {0,1}-valued coordinate the
   // candidate sweep explores continuously and SetFromVector thresholds
   // (the GP analog of the reference's CategoricalParameter).
-  return {ToUnit(p.cycle_time_ms, kCycleMinMs, kCycleMaxMs),
-          ToUnit(static_cast<double>(p.fusion_threshold), kFusionMin,
-                 kFusionMax),
-          p.cache_enabled ? 1.0 : 0.0};
+  std::vector<double> x = {
+      ToUnit(p.cycle_time_ms, kCycleMinMs, kCycleMaxMs),
+      ToUnit(static_cast<double>(p.fusion_threshold), kFusionMin, kFusionMax),
+      p.cache_enabled ? 1.0 : 0.0};
+  if (tune_crossover_) {
+    x.push_back(
+        ToUnit(static_cast<double>(p.algo_crossover), kCrossMin, kCrossMax));
+  }
+  return x;
 }
 
 void ParameterManager::SetFromVector(const std::vector<double>& x) {
@@ -223,13 +233,18 @@ void ParameterManager::SetFromVector(const std::vector<double>& x) {
       static_cast<int64_t>(std::llround(FromUnit(x[1], kFusionMin,
                                                  kFusionMax)));
   current_.cache_enabled = x[2] >= 0.5;
+  if (tune_crossover_ && x.size() > 3) {
+    current_.algo_crossover = static_cast<int64_t>(
+        std::llround(FromUnit(x[3], kCrossMin, kCrossMax)));
+  }
 }
 
 void ParameterManager::LogSample(double score) {
   if (log_ == nullptr) return;
-  fprintf(log_, "%.3f,%lld,%d,%.1f\n", current_.cycle_time_ms,
+  fprintf(log_, "%.3f,%lld,%d,%lld,%.1f\n", current_.cycle_time_ms,
           static_cast<long long>(current_.fusion_threshold),
-          current_.cache_enabled ? 1 : 0, score);
+          current_.cache_enabled ? 1 : 0,
+          static_cast<long long>(current_.algo_crossover), score);
   fflush(log_);
 }
 

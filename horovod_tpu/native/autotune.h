@@ -69,7 +69,8 @@ class BayesianOptimizer {
   uint64_t rng_ = 0x9e3779b97f4a7c15ull;  // deterministic across ranks/runs
 };
 
-// Tunes cycle time, fusion threshold, and the response-cache on/off switch
+// Tunes cycle time, fusion threshold, the response-cache on/off switch, and
+// the allreduce ring/latency-algorithm crossover size
 // online, scored by bytes/sec. Coordinator-only; winning values are
 // broadcast to workers by the core (reference: ParameterManager lives in
 // HorovodGlobalState and is driven from the background loop,
@@ -82,10 +83,19 @@ class ParameterManager {
     double cycle_time_ms;
     int64_t fusion_threshold;
     bool cache_enabled;
+    // AUTO-algorithm crossover: allreduces at or below this many bytes take
+    // the latency algorithm (recursive doubling), larger ones the pipelined
+    // ring (data_plane.h AllreduceAlgo).
+    int64_t algo_crossover;
   };
 
+  // tune_crossover: include the algo crossover as a 4th GP dimension only
+  // when the data plane is in AUTO mode — with a pinned algorithm the
+  // coordinate cannot affect the score and would just dilute the sample
+  // budget; the value is then held constant at algo_crossover.
   void Initialize(double cycle_time_ms, int64_t fusion_threshold,
-                  bool cache_enabled, const std::string& log_path,
+                  bool cache_enabled, int64_t algo_crossover,
+                  bool tune_crossover, const std::string& log_path,
                   int warmup_samples, int cycles_per_sample, int max_samples,
                   double gp_noise);
   ~ParameterManager();
@@ -103,13 +113,14 @@ class ParameterManager {
 
  private:
   void SetFromVector(const std::vector<double>& x);
-  static std::vector<double> ToVector(const Params& p);
+  std::vector<double> ToVector(const Params& p) const;
   void LogSample(double score);
 
   bool active_ = false;
   bool frozen_ = false;
-  Params current_{1.0, 64 << 20, true};
-  BayesianOptimizer opt_{3};
+  bool tune_crossover_ = true;
+  Params current_{1.0, 64 << 20, true, 32 << 10};
+  BayesianOptimizer opt_{4};
   int warmup_samples_ = 3;
   int cycles_per_sample_ = 50;
   int max_samples_ = 30;

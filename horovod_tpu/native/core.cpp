@@ -42,6 +42,9 @@
 #include "timeline.h"
 
 #include <execinfo.h>
+#include <poll.h>
+#include <unistd.h>
+#include <fcntl.h>
 
 namespace hvdtpu {
 
@@ -248,6 +251,11 @@ struct CoreConfig {
   int autotune_cycles_per_sample = 50;
   int autotune_max_samples = 30;
   double autotune_gp_noise = 0.2;
+  // Allreduce algorithm selection (HVDTPU_ALLREDUCE_ALGO; data_plane.h
+  // AllreduceAlgo). Crossover/segment <= 0 keep the data-plane defaults.
+  int32_t allreduce_algo = 0;  // AUTO
+  int64_t allreduce_crossover = 0;
+  int64_t allreduce_segment = 0;
 };
 
 class Core {
@@ -255,7 +263,11 @@ class Core {
   explicit Core(const CoreConfig& cfg)
       : cfg_(cfg), data_plane_(cfg.rank, cfg.size) {}
 
-  ~Core() { Shutdown(); }
+  ~Core() {
+    Shutdown();
+    CloseFd(wake_pipe_[0]);
+    CloseFd(wake_pipe_[1]);
+  }
 
   Status Start();
   void Shutdown();
@@ -281,6 +293,8 @@ class Core {
 
  private:
   void BackgroundLoop();
+  void WaitForWork();                // poll control fds + wake pipe
+  void Wake();                       // nudge the background loop
   void PumpControlPlane();           // role-dependent per-cycle work
   void CoordinatorIngest();          // rank 0: read worker frames
   void CoordinatorEmitResponses();   // rank 0: match + fuse + broadcast
@@ -303,6 +317,13 @@ class Core {
   int coord_listen_fd_ = -1;           // rank 0
   std::vector<int> worker_fds_;        // rank 0: fd per rank (self = -1)
   int control_fd_ = -1;                // workers: connection to rank 0
+
+  // Self-pipe waking the background loop's poll() the instant work arrives
+  // (local enqueue/join/shutdown). Control-plane frames wake it by their fd
+  // becoming readable, so small collectives are event-driven end to end
+  // instead of paying up to one cycle_time_ms sleep per hop; the cycle time
+  // degrades to the idle-poll timeout.
+  int wake_pipe_[2] = {-1, -1};
 
   // Tensor queue + outstanding table (reference: tensor_queue.{h,cc}).
   std::mutex mu_;
@@ -390,14 +411,33 @@ Status Core::Start() {
     timeline_.Initialize(cfg_.timeline_path, cfg_.rank);
   }
   cache_.SetCapacity(cfg_.cache_capacity);
+  data_plane_.set_allreduce_algo(
+      static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
+  data_plane_.set_crossover_bytes(cfg_.allreduce_crossover);
+  data_plane_.set_segment_bytes(cfg_.allreduce_segment);
   if (cfg_.autotune && cfg_.rank == 0) {
     param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
                               cfg_.cache_capacity > 0,
+                              data_plane_.crossover_bytes(),
+                              data_plane_.allreduce_algo() ==
+                                  AllreduceAlgo::AUTO,
                               cfg_.autotune_log, cfg_.autotune_warmup_samples,
                               cfg_.autotune_cycles_per_sample,
                               cfg_.autotune_max_samples,
                               cfg_.autotune_gp_noise);
   }
+  // (Re)create the wake pipe. The previous pipe, if any, is closed only
+  // here and in the destructor — never in Shutdown — so a user thread's
+  // Wake() racing a concurrent Shutdown can at worst write one byte into a
+  // still-open pipe, not into a closed-and-reused fd.
+  CloseFd(wake_pipe_[0]);
+  CloseFd(wake_pipe_[1]);
+  if (pipe(wake_pipe_) != 0) {
+    wake_pipe_[0] = wake_pipe_[1] = -1;
+    return Status::Error(StatusCode::ABORTED, "cannot create wake pipe");
+  }
+  fcntl(wake_pipe_[0], F_SETFL, O_NONBLOCK);
+  fcntl(wake_pipe_[1], F_SETFL, O_NONBLOCK);
   Status st = data_plane_.Listen();
   if (!st.ok()) return st;
 
@@ -545,6 +585,7 @@ void Core::Shutdown() {
     shutdown_ = true;  // under mu_: no lost wakeups for waiters
   }
   cv_.notify_all();
+  Wake();
   if (background_.joinable()) background_.join();
   // Fail any still-outstanding handles.
   {
@@ -600,6 +641,7 @@ int64_t Core::Enqueue(TensorEntry entry, Status* status) {
   int64_t h = e->handle;
   lk.unlock();
   cv_.notify_all();
+  Wake();
   return h;
 }
 
@@ -654,26 +696,53 @@ int64_t Core::Join() {
     join_done_ = false;
   }
   cv_.notify_all();
+  Wake();
   std::unique_lock<std::mutex> lk(mu_);
   cv_.wait(lk, [&] { return join_done_.load() || shutdown_.load(); });
   if (!join_done_.load()) return -2;  // woken by a broken world, not a join
   return last_joined_rank_.load();
 }
 
-void Core::BackgroundLoop() {
-  // Reference: RunLoopOnce (operations.cc:591) — sleep to the cycle time,
-  // negotiate, execute. The condition variable shortcut skips the sleep when
-  // work arrives (lower latency than the reference's fixed sleep).
-  while (!shutdown_) {
-    {
-      std::unique_lock<std::mutex> lk(mu_);
-      cv_.wait_for(lk, std::chrono::duration<double, std::milli>(
-                           cfg_.cycle_time_ms),
-                   [&] {
-                     return shutdown_.load() || !pending_.empty() ||
-                            join_pending_local_;
-                   });
+void Core::Wake() {
+  if (wake_pipe_[1] >= 0) {
+    char b = 1;
+    // Nonblocking: a full pipe already guarantees a pending wakeup.
+    (void)!write(wake_pipe_[1], &b, 1);
+  }
+}
+
+void Core::WaitForWork() {
+  // Event-driven cycle gate (replaces the reference's fixed RunLoopOnce
+  // sleep, operations.cc:591): poll the wake pipe (local enqueue/join/
+  // shutdown) plus every control-plane fd, so both coordinator and workers
+  // react to frames the moment they land instead of sleeping out the cycle
+  // time. The cycle time remains the idle-poll timeout (autotune still owns
+  // it; its floor is poll's 1 ms granularity).
+  std::vector<pollfd> pfds;
+  pfds.push_back({wake_pipe_[0], POLLIN, 0});
+  if (cfg_.rank == 0) {
+    for (int fd : worker_fds_) {
+      if (fd >= 0) pfds.push_back({fd, POLLIN, 0});
     }
+  } else if (control_fd_ >= 0) {
+    pfds.push_back({control_fd_, POLLIN, 0});
+  }
+  double cycle_ms;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    cycle_ms = cfg_.cycle_time_ms;
+  }
+  int timeout = std::max(1, static_cast<int>(std::lround(cycle_ms)));
+  (void)poll(pfds.data(), pfds.size(), timeout);
+  // Drain the pipe: it is level-triggered bookkeeping, not a byte count.
+  char buf[256];
+  while (read(wake_pipe_[0], buf, sizeof(buf)) > 0) {
+  }
+}
+
+void Core::BackgroundLoop() {
+  while (!shutdown_) {
+    WaitForWork();
     if (shutdown_) break;
     ApplyTimelineRequest();
     if (cfg_.timeline_mark_cycles) timeline_.MarkCycle();
@@ -815,6 +884,13 @@ void Core::PumpControlPlane() {
         double cycle = r.F64();
         int64_t fusion = r.I64();
         bool cache_on = r.I32() != 0;
+        int64_t crossover = r.I64();
+        if (!r.ok()) {
+          LogBadFrame(cfg_.rank, "worker PARAMS", frame);
+          continue;
+        }
+        // data_plane_ is driven by this (background) thread only.
+        data_plane_.set_crossover_bytes(crossover);
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = cycle;
         cfg_.fusion_threshold = fusion;
@@ -1233,6 +1309,13 @@ void Core::CoordinatorEmitResponses() {
     }
   }
 
+  // Execute BEFORE adopting any new autotuned parameters: the RESPONSES
+  // frame for this list is already on the wire, and workers apply a PARAMS
+  // frame only after executing it — if rank 0 adopted a new algo crossover
+  // first, both sides could pick different allreduce algorithms for the
+  // same tensor and desynchronize the data plane.
+  ExecuteResponseList(list);
+
   if (param_manager_.active()) {
     // Score this cycle by payload bytes moved; adopt + broadcast any new
     // parameters (reference: ParameterManager::Update scored bytes/sec,
@@ -1250,6 +1333,7 @@ void Core::CoordinatorEmitResponses() {
     // parameter_manager.cc:142-160).
     if (bytes > 0 && param_manager_.Update(bytes, NowSeconds())) {
       ParameterManager::Params p = param_manager_.Current();
+      data_plane_.set_crossover_bytes(p.algo_crossover);
       {
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = p.cycle_time_ms;
@@ -1262,6 +1346,7 @@ void Core::CoordinatorEmitResponses() {
         w.F64(p.cycle_time_ms);
         w.I64(p.fusion_threshold);
         w.I32(p.cache_enabled ? 1 : 0);
+        w.I64(p.algo_crossover);
         std::vector<uint8_t> payload = w.Take();
         for (int rank = 1; rank < cfg_.size; ++rank) {
           if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
@@ -1269,7 +1354,6 @@ void Core::CoordinatorEmitResponses() {
       }
     }
   }
-  ExecuteResponseList(list);
 }
 
 void Core::ExecuteResponseList(const std::vector<Response>& list) {
@@ -1514,6 +1598,39 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
   size_t elem = DataTypeSize(resp.dtype);
   int64_t total_elems = 0;
   for (const auto& s : resp.shapes) total_elems += NumElements(s);
+
+  if (entries.size() == 1) {
+    // Unfused: the entry's output buffer IS the working buffer — one big
+    // copy (and one allocation) less than staging through a fusion buffer.
+    TensorEntry* e = entries[0];
+    const size_t nbytes = static_cast<size_t>(total_elems) * elem;
+    if (e->input != nullptr) {
+      // Range-insert, not assign(n, 0) + memcpy: skips a full zero-fill
+      // pass over a buffer that is immediately overwritten.
+      const uint8_t* in = static_cast<const uint8_t*>(e->input);
+      e->output.clear();
+      e->output.insert(e->output.end(), in, in + nbytes);
+      ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->prescale);
+    } else {
+      e->output.assign(nbytes, 0);
+    }
+    Status st;
+    if (resp.reduce_op == ReduceOp::ADASUM) {
+      st = data_plane_.AdasumAllreduce(e->output.data(), total_elems,
+                                       resp.dtype);
+    } else {
+      st = data_plane_.Allreduce(e->output.data(), total_elems, resp.dtype,
+                                 resp.reduce_op);
+    }
+    if (st.ok()) {
+      ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->postscale);
+    }
+    timeline_.ActivityEnd(e->name);
+    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason);
+    if (e->handle >= 0) CompleteEntry(e, st);
+    return;
+  }
+
   std::vector<uint8_t> fusion(static_cast<size_t>(total_elems) * elem, 0);
 
   int64_t off = 0;
@@ -1717,6 +1834,21 @@ int hvdtpu_hmac_hex(const char* key, const char* msg, char* out,
 
 int hvdtpu_set_secret(void* core, const char* secret) {
   static_cast<Core*>(core)->mutable_config()->secret = secret ? secret : "";
+  return 0;
+}
+
+// Allreduce algorithm selection (data_plane.h AllreduceAlgo: 0 auto, 1 ring,
+// 2 recursive_doubling, 3 tree). crossover_bytes tunes the AUTO ring/latency
+// switchover, segment_bytes the ring pipeline granularity; values <= 0 keep
+// the defaults (and AUTO's crossover stays under autotune ownership).
+int hvdtpu_set_allreduce_tuning(void* core, int algo,
+                                long long crossover_bytes,
+                                long long segment_bytes) {
+  if (algo < 0 || algo > 3) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->allreduce_algo = algo;
+  cfg->allreduce_crossover = crossover_bytes;
+  cfg->allreduce_segment = segment_bytes;
   return 0;
 }
 

@@ -1,11 +1,18 @@
 #include "data_plane.h"
 
+#include <sys/socket.h>
+
 #include <algorithm>
 #include <cmath>
 #include <cstring>
 #include <thread>
 
 #include "socket_util.h"
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+#include <immintrin.h>
+#endif
 
 namespace hvdtpu {
 
@@ -54,16 +61,23 @@ inline uint16_t FloatToHalf(float f) {
     return static_cast<uint16_t>(sign | 0x7c00u);
   }
   if (exp <= 0) {
+    // Subnormal result. Round-to-nearest-EVEN on the dropped bits (the old
+    // round-half-up biased every exact tie upward, e.g. 2^-25 -> 2^-24
+    // instead of 0), matching IEEE 754 and the F16C hardware path.
     if (exp < -10) return static_cast<uint16_t>(sign);
     mant |= 0x800000u;
     uint32_t shift = static_cast<uint32_t>(14 - exp);
     uint16_t h = static_cast<uint16_t>(sign | (mant >> shift));
-    // round-to-nearest
-    if ((mant >> (shift - 1)) & 1u) h++;
+    uint32_t rem = mant & ((1u << shift) - 1u);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (h & 1u))) h++;
     return h;
   }
+  // Normal result: round-to-nearest-even on the 13 dropped mantissa bits.
+  // A mantissa carry correctly rolls into the exponent (and 65520+ to inf).
   uint16_t h = static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
-  if (mant & 0x1000u) h++;  // round
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (h & 1u))) h++;
   return h;
 }
 
@@ -86,21 +100,27 @@ inline uint16_t FloatToBf16(float f) {
   return static_cast<uint16_t>(rounded >> 16);
 }
 
-template <typename T>
-inline T Combine(T a, T b, ReduceOp op) {
-  switch (op) {
-    case ReduceOp::SUM:
-    case ReduceOp::AVERAGE:
-    case ReduceOp::ADASUM:
-      return a + b;
-    case ReduceOp::MIN:
-      return std::min(a, b);
-    case ReduceOp::MAX:
-      return std::max(a, b);
-    case ReduceOp::PRODUCT:
-      return a * b;
-  }
-  return a;
+// --- reduction kernels ------------------------------------------------------
+// The op is resolved ONCE per buffer (functor template parameter), never per
+// element, and the inner loops carry __restrict__ so -O3 can vectorize them.
+
+struct SumOp {
+  template <typename T> T operator()(T a, T b) const { return a + b; }
+};
+struct MinOp {
+  template <typename T> T operator()(T a, T b) const { return std::min(a, b); }
+};
+struct MaxOp {
+  template <typename T> T operator()(T a, T b) const { return std::max(a, b); }
+};
+struct ProdOp {
+  template <typename T> T operator()(T a, T b) const { return a * b; }
+};
+
+template <typename T, typename Op>
+void ReduceLoop(T* __restrict__ dst, const T* __restrict__ src, int64_t count,
+                Op op) {
+  for (int64_t i = 0; i < count; ++i) dst[i] = op(dst[i], src[i]);
 }
 
 template <typename T>
@@ -109,16 +129,171 @@ void ReduceTyped(T* dst, const T* src, int64_t count, ReduceOp op) {
     case ReduceOp::SUM:
     case ReduceOp::AVERAGE:
     case ReduceOp::ADASUM:
-      for (int64_t i = 0; i < count; ++i) dst[i] += src[i];
+      ReduceLoop(dst, src, count, SumOp{});
       break;
     case ReduceOp::MIN:
-      for (int64_t i = 0; i < count; ++i) dst[i] = std::min(dst[i], src[i]);
+      ReduceLoop(dst, src, count, MinOp{});
       break;
     case ReduceOp::MAX:
-      for (int64_t i = 0; i < count; ++i) dst[i] = std::max(dst[i], src[i]);
+      ReduceLoop(dst, src, count, MaxOp{});
       break;
     case ReduceOp::PRODUCT:
-      for (int64_t i = 0; i < count; ++i) dst[i] *= src[i];
+      ReduceLoop(dst, src, count, ProdOp{});
+      break;
+  }
+}
+
+#if defined(__x86_64__)
+// Fused fp16 convert+add+convert, 8 lanes per step (F16C). The hardware
+// conversions are full IEEE round-to-nearest-even including subnormals, so
+// this is bit-identical to the scalar HalfToFloat/FloatToHalf path for
+// numeric values (NaNs stay NaN but may carry a different payload).
+__attribute__((target("avx2,f16c")))
+void HalfSumF16C(uint16_t* __restrict__ dst, const uint16_t* __restrict__ src,
+                 int64_t count) {
+  int64_t i = 0;
+  for (; i + 8 <= count; i += 8) {
+    __m256 a = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(dst + i)));
+    __m256 b = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i)));
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm256_cvtps_ph(_mm256_add_ps(a, b),
+                                     _MM_FROUND_TO_NEAREST_INT));
+  }
+  for (; i < count; ++i) {
+    dst[i] = FloatToHalf(HalfToFloat(dst[i]) + HalfToFloat(src[i]));
+  }
+}
+
+// Fused bf16 convert+add+convert, 8 lanes per step: widen by shift, add as
+// f32, round-to-nearest-even back by integer arithmetic (same formula as
+// the scalar FloatToBf16, including the NaN-quieting blend).
+__attribute__((target("avx2")))
+void Bf16SumAvx2(uint16_t* __restrict__ dst, const uint16_t* __restrict__ src,
+                 int64_t count) {
+  const __m256i vexpmask = _mm256_set1_epi32(0x7fffffff);
+  const __m256i vinf = _mm256_set1_epi32(0x7f800000);
+  const __m256i vbias = _mm256_set1_epi32(0x7fff);
+  const __m256i vone = _mm256_set1_epi32(1);
+  const __m256i vquiet = _mm256_set1_epi32(0x0040);
+  int64_t i = 0;
+  for (; i + 8 <= count; i += 8) {
+    __m256i a = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(dst + i))), 16);
+    __m256i b = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(src + i))), 16);
+    __m256i s = _mm256_castps_si256(_mm256_add_ps(_mm256_castsi256_ps(a),
+                                                  _mm256_castsi256_ps(b)));
+    // round-to-nearest-even: bits + 0x7fff + ((bits >> 16) & 1)
+    __m256i rounded = _mm256_srli_epi32(
+        _mm256_add_epi32(_mm256_add_epi32(s, vbias),
+                         _mm256_and_si256(_mm256_srli_epi32(s, 16), vone)),
+        16);
+    // NaN sum (|bits| > inf): quiet NaN instead of letting the rounding add
+    // carry the mantissa into the exponent/sign.
+    __m256i nan_mask = _mm256_cmpgt_epi32(_mm256_and_si256(s, vexpmask), vinf);
+    __m256i quieted = _mm256_or_si256(_mm256_srli_epi32(s, 16), vquiet);
+    __m256i out32 = _mm256_blendv_epi8(rounded, quieted, nan_mask);
+    // pack the low words of the 8 lanes back to 8 x u16 (packus after
+    // clamping is safe: values are already <= 0xffff)
+    __m256i packed = _mm256_packus_epi32(out32, out32);
+    __m128i lo = _mm256_castsi256_si128(packed);
+    __m128i hi = _mm256_extracti128_si256(packed, 1);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i),
+                     _mm_unpacklo_epi64(lo, hi));
+  }
+  for (; i < count; ++i) {
+    dst[i] = FloatToBf16(Bf16ToFloat(dst[i]) + Bf16ToFloat(src[i]));
+  }
+}
+
+bool HaveF16C() {
+  // gcc 10's __builtin_cpu_supports has no "f16c"; read CPUID leaf 1 ECX
+  // bit 29 directly.
+  static const bool ok = [] {
+    unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    return (ecx & (1u << 29)) != 0 && __builtin_cpu_supports("avx2") != 0;
+  }();
+  return ok;
+}
+
+bool HaveAvx2() {
+  static const bool ok = __builtin_cpu_supports("avx2");
+  return ok;
+}
+#endif  // __x86_64__
+
+// Half-precision buffers reduce through float in ONE pass: convert, combine,
+// convert back per element (vectorized 8-wide for the SUM hot path), instead
+// of a per-element op dispatch.
+template <typename Op>
+void ReduceHalfLoop(uint16_t* __restrict__ dst, const uint16_t* __restrict__ src,
+                    int64_t count, Op op) {
+  for (int64_t i = 0; i < count; ++i) {
+    dst[i] = FloatToHalf(op(HalfToFloat(dst[i]), HalfToFloat(src[i])));
+  }
+}
+
+template <typename Op>
+void ReduceBf16Loop(uint16_t* __restrict__ dst, const uint16_t* __restrict__ src,
+                    int64_t count, Op op) {
+  for (int64_t i = 0; i < count; ++i) {
+    dst[i] = FloatToBf16(op(Bf16ToFloat(dst[i]), Bf16ToFloat(src[i])));
+  }
+}
+
+void ReduceHalf(uint16_t* dst, const uint16_t* src, int64_t count,
+                ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+#if defined(__x86_64__)
+      if (HaveF16C()) {
+        HalfSumF16C(dst, src, count);
+        return;
+      }
+#endif
+      ReduceHalfLoop(dst, src, count, SumOp{});
+      break;
+    case ReduceOp::MIN:
+      ReduceHalfLoop(dst, src, count, MinOp{});
+      break;
+    case ReduceOp::MAX:
+      ReduceHalfLoop(dst, src, count, MaxOp{});
+      break;
+    case ReduceOp::PRODUCT:
+      ReduceHalfLoop(dst, src, count, ProdOp{});
+      break;
+  }
+}
+
+void ReduceBf16(uint16_t* dst, const uint16_t* src, int64_t count,
+                ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+#if defined(__x86_64__)
+      if (HaveAvx2()) {
+        Bf16SumAvx2(dst, src, count);
+        return;
+      }
+#endif
+      ReduceBf16Loop(dst, src, count, SumOp{});
+      break;
+    case ReduceOp::MIN:
+      ReduceBf16Loop(dst, src, count, MinOp{});
+      break;
+    case ReduceOp::MAX:
+      ReduceBf16Loop(dst, src, count, MaxOp{});
+      break;
+    case ReduceOp::PRODUCT:
+      ReduceBf16Loop(dst, src, count, ProdOp{});
       break;
   }
 }
@@ -168,24 +343,14 @@ void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
       }
       break;
     }
-    case DataType::FLOAT16: {
-      uint16_t* d = static_cast<uint16_t*>(dst);
-      const uint16_t* s = static_cast<const uint16_t*>(src);
-      for (int64_t i = 0; i < count; ++i) {
-        d[i] = FloatToHalf(
-            Combine(HalfToFloat(d[i]), HalfToFloat(s[i]), op));
-      }
+    case DataType::FLOAT16:
+      ReduceHalf(static_cast<uint16_t*>(dst),
+                 static_cast<const uint16_t*>(src), count, op);
       break;
-    }
-    case DataType::BFLOAT16: {
-      uint16_t* d = static_cast<uint16_t*>(dst);
-      const uint16_t* s = static_cast<const uint16_t*>(src);
-      for (int64_t i = 0; i < count; ++i) {
-        d[i] = FloatToBf16(
-            Combine(Bf16ToFloat(d[i]), Bf16ToFloat(s[i]), op));
-      }
+    case DataType::BFLOAT16:
+      ReduceBf16(static_cast<uint16_t*>(dst),
+                 static_cast<const uint16_t*>(src), count, op);
       break;
-    }
   }
 }
 
@@ -232,6 +397,26 @@ Status DataPlane::Connect(const std::vector<PeerAddr>& peers) {
     }
     fds_[who] = fd;
   }
+
+  // Size the inline (send-then-recv, no sender thread) SendRecv fast path
+  // from the ACTUAL kernel buffer sizes: a payload at most a quarter of the
+  // smallest send/receive buffer on the mesh can never wedge even when both
+  // peers send first. Hosts tuned down to the 4 KB tcp_wmem minimum simply
+  // get a (correct) tiny threshold instead of a deadlock.
+  int64_t lim = 32 * 1024;
+  for (int fd : fds_) {
+    if (fd < 0) continue;
+    int val = 0;
+    socklen_t len = sizeof(val);
+    if (getsockopt(fd, SOL_SOCKET, SO_SNDBUF, &val, &len) == 0) {
+      lim = std::min(lim, static_cast<int64_t>(val) / 4);
+    }
+    len = sizeof(val);
+    if (getsockopt(fd, SOL_SOCKET, SO_RCVBUF, &val, &len) == 0) {
+      lim = std::min(lim, static_cast<int64_t>(val) / 4);
+    }
+  }
+  inline_max_bytes_ = std::max<int64_t>(lim, 0);
   return Status::OK();
 }
 
@@ -247,6 +432,25 @@ void DataPlane::Shutdown() {
 Status DataPlane::SendRecv(int send_fd, const void* send_buf,
                            int64_t send_bytes, int recv_fd, void* recv_buf,
                            int64_t recv_bytes) {
+  // Inline fast path: payloads the kernel socket buffers are known to absorb
+  // (inline_max_bytes_, measured per connection in Connect) are sent
+  // blocking-then-received on the calling thread — both peers sending first
+  // cannot deadlock, and skipping the per-call sender thread is the bulk of
+  // the small-message latency win. Larger payloads always take the
+  // concurrent path; inline_max_bytes_ is 0 until Connect establishes it.
+  if (send_bytes <= inline_max_bytes_ && recv_bytes <= inline_max_bytes_) {
+    int rc = 0;
+    if (send_bytes > 0) {
+      rc = SendAll(send_fd, send_buf, static_cast<size_t>(send_bytes));
+    }
+    if (rc == 0 && recv_bytes > 0) {
+      rc = RecvAll(recv_fd, recv_buf, static_cast<size_t>(recv_bytes));
+    }
+    if (rc != 0) {
+      return Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
+    }
+    return Status::OK();
+  }
   // Concurrent send+recv so large payloads can't deadlock on socket buffers.
   int send_rc = 0;
   std::thread sender([&] {
@@ -268,6 +472,26 @@ Status DataPlane::SendRecv(int send_fd, const void* send_buf,
 Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
                             ReduceOp op) {
   if (size_ == 1 || count == 0) return Status::OK();
+  AllreduceAlgo algo = algo_;
+  if (algo == AllreduceAlgo::AUTO) {
+    const int64_t bytes = count * static_cast<int64_t>(DataTypeSize(dtype));
+    algo = bytes <= crossover_bytes_ ? AllreduceAlgo::RECURSIVE_DOUBLING
+                                     : AllreduceAlgo::RING;
+  }
+  switch (algo) {
+    case AllreduceAlgo::RECURSIVE_DOUBLING:
+      return RecursiveDoublingAllreduce(data, count, dtype, op);
+    case AllreduceAlgo::TREE:
+      return TreeAllreduce(data, count, dtype, op);
+    case AllreduceAlgo::AUTO:
+    case AllreduceAlgo::RING:
+      break;
+  }
+  return RingAllreduce(data, count, dtype, op);
+}
+
+Status DataPlane::RingAllreduce(void* data, int64_t count, DataType dtype,
+                                ReduceOp op) {
   const size_t elem = DataTypeSize(dtype);
   uint8_t* buf = static_cast<uint8_t*>(data);
   const int right = (rank_ + 1) % size_;
@@ -284,23 +508,44 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   int64_t max_chunk = base + (rem > 0 ? 1 : 0);
   std::vector<uint8_t> recv_tmp(static_cast<size_t>(max_chunk) * elem);
 
+  // Element-aligned pipeline segment.
+  int64_t seg = segment_bytes_ - segment_bytes_ % static_cast<int64_t>(elem);
+  if (seg <= 0) seg = static_cast<int64_t>(elem);
+
   // Phase 1: ring reduce-scatter. After step s, chunk (rank - s - 1) holds
   // the partial sum of s + 2 ranks; after size-1 steps, chunk (rank + 1)
   // holds the full reduction on this rank... (standard ring schedule: send
-  // chunk (rank - s), receive + reduce chunk (rank - s - 1)).
+  // chunk (rank - s), receive + reduce chunk (rank - s - 1)). Chunks of two
+  // or more segments stream through SendRecvSegmented so the reduction of
+  // segment k overlaps the transfer of segment k+1.
   for (int s = 0; s < size_ - 1; ++s) {
     int send_c = ((rank_ - s) % size_ + size_) % size_;
     int recv_c = ((rank_ - s - 1) % size_ + size_) % size_;
-    Status st = SendRecv(fds_[right], chunk_ptr(send_c),
-                         chunk_count(send_c) * static_cast<int64_t>(elem),
-                         fds_[left], recv_tmp.data(),
-                         chunk_count(recv_c) * static_cast<int64_t>(elem));
-    if (!st.ok()) return st;
-    ReduceBuffer(chunk_ptr(recv_c), recv_tmp.data(), chunk_count(recv_c),
-                 dtype, op);
+    int64_t send_bytes = chunk_count(send_c) * static_cast<int64_t>(elem);
+    int64_t recv_bytes = chunk_count(recv_c) * static_cast<int64_t>(elem);
+    if (recv_bytes >= 2 * seg) {
+      uint8_t* dst = chunk_ptr(recv_c);
+      if (SendRecvSegmented(
+              fds_[right], chunk_ptr(send_c), static_cast<size_t>(send_bytes),
+              fds_[left], recv_tmp.data(), static_cast<size_t>(recv_bytes),
+              static_cast<size_t>(seg), [&](size_t off, size_t len) {
+                ReduceBuffer(dst + off, recv_tmp.data() + off,
+                             static_cast<int64_t>(len / elem), dtype, op);
+              }) != 0) {
+        return Status::Error(StatusCode::ABORTED,
+                             "data plane: transfer failed");
+      }
+    } else {
+      Status st = SendRecv(fds_[right], chunk_ptr(send_c), send_bytes,
+                           fds_[left], recv_tmp.data(), recv_bytes);
+      if (!st.ok()) return st;
+      ReduceBuffer(chunk_ptr(recv_c), recv_tmp.data(), chunk_count(recv_c),
+                   dtype, op);
+    }
   }
 
-  // Phase 2: ring allgather of the reduced chunks.
+  // Phase 2: ring allgather of the reduced chunks (already full-duplex; no
+  // per-segment work to overlap).
   for (int s = 0; s < size_ - 1; ++s) {
     int send_c = ((rank_ + 1 - s) % size_ + size_) % size_;
     int recv_c = ((rank_ - s) % size_ + size_) % size_;
@@ -309,6 +554,97 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
                          fds_[left], chunk_ptr(recv_c),
                          chunk_count(recv_c) * static_cast<int64_t>(elem));
     if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::RecursiveDoublingAllreduce(void* data, int64_t count,
+                                             DataType dtype, ReduceOp op) {
+  const size_t elem = DataTypeSize(dtype);
+  const int64_t bytes = count * static_cast<int64_t>(elem);
+  std::vector<uint8_t> other(static_cast<size_t>(bytes));
+
+  // Largest power-of-two subgroup; the r extra ranks fold into their partner
+  // first and receive the result last (same shape as AdasumAllreduce).
+  int p = 1;
+  while (p * 2 <= size_) p *= 2;
+  const int r = size_ - p;
+
+  if (rank_ >= p) {
+    if (SendAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "rd fold send failed");
+    }
+  } else if (rank_ < r) {
+    if (RecvAll(fds_[rank_ + p], other.data(), static_cast<size_t>(bytes)) !=
+        0) {
+      return Status::Error(StatusCode::ABORTED, "rd fold recv failed");
+    }
+    ReduceBuffer(data, other.data(), count, dtype, op);
+  }
+
+  if (rank_ < p) {
+    for (int distance = 1; distance < p; distance *= 2) {
+      int peer = rank_ ^ distance;
+      Status st =
+          SendRecv(fds_[peer], data, bytes, fds_[peer], other.data(), bytes);
+      if (!st.ok()) return st;
+      ReduceBuffer(data, other.data(), count, dtype, op);
+    }
+  }
+
+  if (rank_ < r) {
+    if (SendAll(fds_[rank_ + p], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "rd unfold send failed");
+    }
+  } else if (rank_ >= p) {
+    if (RecvAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "rd unfold recv failed");
+    }
+  }
+  return Status::OK();
+}
+
+Status DataPlane::TreeAllreduce(void* data, int64_t count, DataType dtype,
+                                ReduceOp op) {
+  const size_t elem = DataTypeSize(dtype);
+  const int64_t bytes = count * static_cast<int64_t>(elem);
+  std::vector<uint8_t> other(static_cast<size_t>(bytes));
+
+  // Binomial reduce toward rank 0: at distance d, ranks with bit d set send
+  // up and leave; the rest absorb a child (if present) and continue.
+  for (int d = 1; d < size_; d <<= 1) {
+    if (rank_ & d) {
+      if (SendAll(fds_[rank_ - d], data, static_cast<size_t>(bytes)) != 0) {
+        return Status::Error(StatusCode::ABORTED, "tree reduce send failed");
+      }
+      break;
+    }
+    if (rank_ + d < size_) {
+      if (RecvAll(fds_[rank_ + d], other.data(), static_cast<size_t>(bytes)) !=
+          0) {
+        return Status::Error(StatusCode::ABORTED, "tree reduce recv failed");
+      }
+      ReduceBuffer(data, other.data(), count, dtype, op);
+    }
+  }
+
+  // Binomial broadcast back down the same tree (parent first, then forward
+  // to children in decreasing-distance order — each edge is one-directional,
+  // so plain blocking sends cannot deadlock).
+  int top = 1;
+  while (top < size_) top <<= 1;
+  int lsb = rank_ == 0 ? top : (rank_ & -rank_);
+  if (rank_ != 0) {
+    if (RecvAll(fds_[rank_ - lsb], data, static_cast<size_t>(bytes)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "tree bcast recv failed");
+    }
+  }
+  for (int d = lsb >> 1; d >= 1; d >>= 1) {
+    if (rank_ + d < size_) {
+      if (SendAll(fds_[rank_ + d], data, static_cast<size_t>(bytes)) != 0) {
+        return Status::Error(StatusCode::ABORTED, "tree bcast send failed");
+      }
+    }
   }
   return Status::OK();
 }

@@ -20,6 +20,28 @@ struct PeerAddr {
   int port = 0;
 };
 
+// Allreduce algorithm menu (reference fork: the IST-DASLab layer's
+// ring / scatter-allgather / tree reduction selection). AUTO picks the
+// latency algorithm (recursive doubling) at or below the crossover size and
+// the pipelined ring above it; the crossover is owned by the autotune
+// machinery (autotune.h ParameterManager).
+enum class AllreduceAlgo : int32_t {
+  AUTO = 0,
+  RING = 1,
+  RECURSIVE_DOUBLING = 2,
+  TREE = 3,
+};
+
+// Default ring/latency-algorithm crossover: messages at or below this ride
+// recursive doubling (log2(n) full-size exchanges beat 2(n-1) chunk rounds
+// when per-round latency dominates). Conservative — measured on loopback,
+// recursive doubling loses to the ring well before 256 KB at larger worlds;
+// the autotuner owns the workload-specific value (4 KB .. 4 MB range).
+constexpr int64_t kDefaultAlgoCrossoverBytes = 32 * 1024;
+// Default ring pipeline segment: each ring chunk is streamed in segments of
+// this size so reduction of segment k overlaps the transfer of segment k+1.
+constexpr int64_t kDefaultSegmentBytes = 1 << 20;
+
 class DataPlane {
  public:
   DataPlane(int rank, int size);
@@ -34,9 +56,22 @@ class DataPlane {
 
   void Shutdown();
 
-  // In-place ring allreduce over `count` elements (SUM/MIN/MAX/PRODUCT;
-  // AVERAGE is SUM + caller-side postscale, reference operations.cc:928).
+  // In-place allreduce over `count` elements (SUM/MIN/MAX/PRODUCT; AVERAGE
+  // is SUM + caller-side postscale, reference operations.cc:928). Dispatches
+  // by the configured algorithm: pipelined ring (reduce-scatter + allgather
+  // with segment-level reduce/transfer overlap), recursive doubling, or
+  // binomial tree; AUTO selects by message size vs the crossover.
   Status Allreduce(void* data, int64_t count, DataType dtype, ReduceOp op);
+
+  // Algorithm-selection knobs (hvdtpu_allreduce_algo surface + autotuned
+  // crossover). Call from the thread that runs the collectives (the core's
+  // background loop) or before it starts; values <= 0 are ignored.
+  void set_allreduce_algo(AllreduceAlgo algo) { algo_ = algo; }
+  void set_crossover_bytes(int64_t b) { if (b > 0) crossover_bytes_ = b; }
+  void set_segment_bytes(int64_t b) { if (b > 0) segment_bytes_ = b; }
+  AllreduceAlgo allreduce_algo() const { return algo_; }
+  int64_t crossover_bytes() const { return crossover_bytes_; }
+  int64_t segment_bytes() const { return segment_bytes_; }
 
   // Gather variable-length byte blocks from every rank; out = concatenated in
   // rank order. block_bytes[r] gives each rank's contribution size.
@@ -67,11 +102,34 @@ class DataPlane {
   Status SendRecv(int send_fd, const void* send_buf, int64_t send_bytes,
                   int recv_fd, void* recv_buf, int64_t recv_bytes);
 
+  // Bandwidth path: ring reduce-scatter + allgather; each reduce-scatter
+  // step streams the incoming chunk in segments so ReduceBuffer of segment
+  // k overlaps the socket transfer of segment k+1 (socket_util
+  // SendRecvSegmented).
+  Status RingAllreduce(void* data, int64_t count, DataType dtype,
+                       ReduceOp op);
+  // Latency path: log2(p) full-message pairwise exchanges; non-power-of-two
+  // worlds fold the extra ranks in by reduction first (like Adasum).
+  Status RecursiveDoublingAllreduce(void* data, int64_t count, DataType dtype,
+                                    ReduceOp op);
+  // Binomial reduce-to-0 + binomial broadcast (reference fork's tree menu
+  // entry; half the exchange volume of recursive doubling, twice the depth).
+  Status TreeAllreduce(void* data, int64_t count, DataType dtype,
+                       ReduceOp op);
+
   int rank_;
   int size_;
   int listen_fd_ = -1;
   int port_ = 0;
   std::vector<int> fds_;  // per-peer connection; -1 for self
+
+  AllreduceAlgo algo_ = AllreduceAlgo::AUTO;
+  int64_t crossover_bytes_ = kDefaultAlgoCrossoverBytes;
+  int64_t segment_bytes_ = kDefaultSegmentBytes;
+  // Largest payload SendRecv may exchange inline (blocking send, then recv)
+  // without a deadlock risk; measured against the mesh's socket buffer
+  // sizes in Connect(). 0 (pre-Connect) = always use the concurrent path.
+  int64_t inline_max_bytes_ = 0;
 };
 
 // dst[i] = dst[i] OP src[i], accumulating fp16/bf16 in float.

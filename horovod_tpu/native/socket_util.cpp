@@ -11,6 +11,8 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <condition_variable>
+#include <mutex>
 #include <thread>
 
 namespace hvdtpu {
@@ -112,6 +114,76 @@ int RecvAll(int fd, void* buf, size_t len) {
     len -= static_cast<size_t>(n);
   }
   return 0;
+}
+
+int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
+                      int recv_fd, void* recv_buf, size_t recv_bytes,
+                      size_t segment_bytes,
+                      const std::function<void(size_t, size_t)>& on_segment) {
+  if (segment_bytes == 0 || segment_bytes > recv_bytes) {
+    segment_bytes = recv_bytes;
+  }
+  int send_rc = 0;
+  std::thread sender([&] {
+    if (send_bytes > 0) send_rc = SendAll(send_fd, send_buf, send_bytes);
+  });
+  int recv_rc = 0;
+  if (recv_bytes > 0) {
+    if (!on_segment) {
+      recv_rc = RecvAll(recv_fd, recv_buf, recv_bytes);
+    } else {
+      // Receiver thread lands segments and publishes a high-water mark; the
+      // calling thread consumes them (runs on_segment) as they arrive.
+      // Segments are disjoint, so the mutex only guards the counters — the
+      // handoff of each buffer region rides the received/consumed ordering.
+      std::mutex mu;
+      std::condition_variable cv;
+      size_t received = 0;
+      bool done = false;
+      std::thread receiver([&] {
+        char* p = static_cast<char*>(recv_buf);
+        size_t off = 0;
+        int rc = 0;
+        while (off < recv_bytes) {
+          size_t len = std::min(segment_bytes, recv_bytes - off);
+          rc = RecvAll(recv_fd, p + off, len);
+          if (rc != 0) break;
+          off += len;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            received = off;
+          }
+          cv.notify_one();
+        }
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          done = true;
+          if (rc != 0) recv_rc = rc;
+        }
+        cv.notify_one();
+      });
+      size_t consumed = 0;
+      while (consumed < recv_bytes) {
+        size_t avail;
+        bool finished;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] { return received > consumed || done; });
+          avail = received;
+          finished = done;
+        }
+        if (avail > consumed) {
+          on_segment(consumed, avail - consumed);
+          consumed = avail;
+        } else if (finished) {
+          break;  // receive error: recv_rc is set
+        }
+      }
+      receiver.join();
+    }
+  }
+  sender.join();
+  return (send_rc != 0 || recv_rc != 0) ? -1 : 0;
 }
 
 int SendFrame(int fd, const std::vector<uint8_t>& payload) {

@@ -6,6 +6,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -27,6 +28,18 @@ int TcpConnectRetry(const std::string& host, int port, int timeout_ms);
 // Exact-length send/recv (loop over partial transfers). 0 on success.
 int SendAll(int fd, const void* buf, size_t len);
 int RecvAll(int fd, void* buf, size_t len);
+
+// Full-duplex segmented transfer: streams send_bytes out of send_fd while
+// receiving recv_bytes into recv_buf, invoking on_segment(offset, length) on
+// the CALLING thread as each received segment lands — later segments keep
+// streaming in a background thread, so per-segment work (e.g. reduction)
+// overlaps the wire time. Offsets/lengths are multiples of segment_bytes
+// except the final segment. segment_bytes == 0 means one segment; a null
+// on_segment degrades to a plain concurrent send+recv. 0 on success.
+int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
+                      int recv_fd, void* recv_buf, size_t recv_bytes,
+                      size_t segment_bytes,
+                      const std::function<void(size_t, size_t)>& on_segment);
 
 // Length-prefixed frame: [u64 length][payload].
 int SendFrame(int fd, const std::vector<uint8_t>& payload);

@@ -8,17 +8,26 @@
 // Built and run by `make check` (tests/test_sanitizers.py-style integration
 // lives in tests/test_native_features.py; this binary needs no Python).
 
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
 #include <cassert>
 #include <cmath>
+#include <condition_variable>
 #include <cstdio>
 #include <cstring>
 #include <limits>
+#include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "autotune.h"
 #include "data_plane.h"
 #include "message.h"
+#include "socket_util.h"
 
 namespace hvdtpu {
 namespace {
@@ -149,6 +158,264 @@ void TestHalfConversionSpecialValues() {
   CHECK_TRUE(std::fabs(back - sub) < 1e-6f);
 }
 
+void TestHalfConversionExhaustive() {
+  // Every one of the 65536 fp16 bit patterns must survive a float round
+  // trip bit-exactly (NaNs must stay NaN; payloads may be canonicalized).
+  for (uint32_t u = 0; u < 0x10000u; ++u) {
+    uint16_t h = static_cast<uint16_t>(u);
+    bool is_nan = (h & 0x7c00u) == 0x7c00u && (h & 0x3ffu) != 0;
+    float f = HalfToFloatPublic(h);
+    uint16_t back = FloatToHalfPublic(f);
+    if (is_nan) {
+      if (!((back & 0x7c00u) == 0x7c00u && (back & 0x3ffu) != 0)) {
+        std::fprintf(stderr, "FAIL fp16 NaN roundtrip: %04x -> %04x\n", h,
+                     back);
+        ++failures;
+      }
+    } else if (back != h) {
+      std::fprintf(stderr, "FAIL fp16 roundtrip: %04x -> %g -> %04x\n", h, f,
+                   back);
+      ++failures;
+      return;  // don't spam 65k lines
+    }
+  }
+  // Same for bfloat16 (every pattern is an exact float truncation).
+  for (uint32_t u = 0; u < 0x10000u; ++u) {
+    uint16_t h = static_cast<uint16_t>(u);
+    bool is_nan = (h & 0x7f80u) == 0x7f80u && (h & 0x7fu) != 0;
+    float f = Bf16ToFloatPublic(h);
+    uint16_t back = FloatToBf16Public(f);
+    if (is_nan) {
+      if (!((back & 0x7f80u) == 0x7f80u && (back & 0x7fu) != 0)) {
+        std::fprintf(stderr, "FAIL bf16 NaN roundtrip: %04x -> %04x\n", h,
+                     back);
+        ++failures;
+      }
+    } else if (back != h) {
+      std::fprintf(stderr, "FAIL bf16 roundtrip: %04x -> %g -> %04x\n", h, f,
+                   back);
+      ++failures;
+      return;
+    }
+  }
+}
+
+void TestHalfRoundToNearestEven() {
+  // Subnormal ties round to even, not up (the seed's round-half-up bug):
+  // 2^-25 is exactly halfway between 0 and the smallest subnormal 2^-24.
+  CHECK_TRUE(FloatToHalfPublic(std::ldexp(1.0f, -25)) == 0x0000);
+  CHECK_TRUE(FloatToHalfPublic(-std::ldexp(1.0f, -25)) == 0x8000);
+  // Just above the tie rounds away from zero.
+  CHECK_TRUE(FloatToHalfPublic(std::nextafterf(std::ldexp(1.0f, -25), 1.0f)) ==
+             0x0001);
+  // 3 * 2^-25 (halfway between subnormals 1 and 2) rounds to even (2).
+  CHECK_TRUE(FloatToHalfPublic(3.0f * std::ldexp(1.0f, -25)) == 0x0002);
+  // Normal-path tie: 1 + 2^-11 is halfway between 1.0 and 1 + 2^-10;
+  // round-to-even keeps 1.0 (mantissa 0 is even).
+  CHECK_TRUE(FloatToHalfPublic(1.0f + std::ldexp(1.0f, -11)) == 0x3c00);
+  // 1 + 3*2^-11 is halfway between 1+2^-10 and 1+2^-9 -> even (1+2^-9).
+  CHECK_TRUE(FloatToHalfPublic(1.0f + 3.0f * std::ldexp(1.0f, -11)) == 0x3c02);
+  // Overflow rounding: 65520 (tie between 65504 and out-of-range 65536)
+  // rounds up to infinity; just below stays at the max normal.
+  CHECK_TRUE(FloatToHalfPublic(65520.0f) == 0x7c00);
+  CHECK_TRUE(FloatToHalfPublic(std::nextafterf(65520.0f, 0.0f)) == 0x7bff);
+}
+
+void TestReduceBufferHalfMatchesScalar() {
+  // The fp16/bf16 SUM kernels take a SIMD path when the CPU supports it;
+  // verify bit-exact agreement with the scalar convert-combine-convert
+  // reference over every finite fp16 value (paired with a fixed addend) and
+  // that NaN inputs still propagate.
+  const int64_t n = 0x10000;
+  std::vector<uint16_t> dst(n), src(n), expect(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint16_t h = static_cast<uint16_t>(i);
+    bool is_nan = (h & 0x7c00u) == 0x7c00u && (h & 0x3ffu) != 0;
+    dst[i] = h;
+    src[i] = FloatToHalfPublic(0.37109375f);  // exact in fp16
+    expect[i] = is_nan ? 0xffffu  // placeholder: checked via isnan below
+                       : FloatToHalfPublic(HalfToFloatPublic(dst[i]) +
+                                           HalfToFloatPublic(src[i]));
+  }
+  ReduceBuffer(dst.data(), src.data(), n, DataType::FLOAT16, ReduceOp::SUM);
+  for (int64_t i = 0; i < n; ++i) {
+    uint16_t h = static_cast<uint16_t>(i);
+    bool is_nan = (h & 0x7c00u) == 0x7c00u && (h & 0x3ffu) != 0;
+    if (is_nan) {
+      if (!((dst[i] & 0x7c00u) == 0x7c00u && (dst[i] & 0x3ffu) != 0)) {
+        std::fprintf(stderr, "FAIL fp16 sum NaN propagation at %04x -> %04x\n",
+                     h, dst[i]);
+        ++failures;
+      }
+    } else if (dst[i] != expect[i]) {
+      std::fprintf(stderr, "FAIL fp16 sum kernel mismatch at %04x: %04x vs "
+                   "scalar %04x\n", h, dst[i], expect[i]);
+      ++failures;
+      return;
+    }
+  }
+  // bf16: same sweep.
+  for (int64_t i = 0; i < n; ++i) {
+    uint16_t h = static_cast<uint16_t>(i);
+    bool is_nan = (h & 0x7f80u) == 0x7f80u && (h & 0x7fu) != 0;
+    dst[i] = h;
+    src[i] = FloatToBf16Public(1.5f);
+    expect[i] = is_nan ? 0xffffu
+                       : FloatToBf16Public(Bf16ToFloatPublic(dst[i]) +
+                                           Bf16ToFloatPublic(src[i]));
+  }
+  ReduceBuffer(dst.data(), src.data(), n, DataType::BFLOAT16, ReduceOp::SUM);
+  for (int64_t i = 0; i < n; ++i) {
+    uint16_t h = static_cast<uint16_t>(i);
+    bool is_nan = (h & 0x7f80u) == 0x7f80u && (h & 0x7fu) != 0;
+    if (is_nan) {
+      if (!((dst[i] & 0x7f80u) == 0x7f80u && (dst[i] & 0x7fu) != 0)) {
+        std::fprintf(stderr, "FAIL bf16 sum NaN propagation at %04x -> %04x\n",
+                     h, dst[i]);
+        ++failures;
+      }
+    } else if (dst[i] != expect[i]) {
+      std::fprintf(stderr, "FAIL bf16 sum kernel mismatch at %04x: %04x vs "
+                   "scalar %04x\n", h, dst[i], expect[i]);
+      ++failures;
+      return;
+    }
+  }
+}
+
+void TestSendRecvSegmented() {
+  // Full-duplex segmented transfer over a socketpair: side A uses the
+  // segmented path with an on-the-fly reduction callback, side B a plain
+  // concurrent send+recv. Odd segment size exercises the short tail.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  const size_t kBytes = 1 << 20;
+  std::vector<uint8_t> a_send(kBytes), a_recv(kBytes), b_send(kBytes),
+      b_recv(kBytes);
+  for (size_t i = 0; i < kBytes; ++i) {
+    a_send[i] = static_cast<uint8_t>(i * 7);
+    b_send[i] = static_cast<uint8_t>(i * 13 + 1);
+  }
+  std::atomic<int> b_rc{-1};
+  std::thread side_b([&] {
+    int send_rc = 0;
+    std::thread sender([&] {
+      send_rc = SendAll(sv[1], b_send.data(), kBytes);
+    });
+    int recv_rc = RecvAll(sv[1], b_recv.data(), kBytes);
+    sender.join();
+    b_rc = (send_rc == 0 && recv_rc == 0) ? 0 : 1;
+  });
+  size_t callback_bytes = 0;
+  size_t calls = 0;
+  int rc = SendRecvSegmented(
+      sv[0], a_send.data(), kBytes, sv[0], a_recv.data(), kBytes,
+      /*segment_bytes=*/100000, [&](size_t off, size_t len) {
+        // Segments arrive in order, disjoint, and fully landed.
+        CHECK_TRUE(off == callback_bytes);
+        for (size_t i = 0; i < len; i += 9973) {
+          CHECK_TRUE(a_recv[off + i] == static_cast<uint8_t>((off + i) * 13
+                                                             + 1));
+        }
+        callback_bytes += len;
+        ++calls;
+      });
+  side_b.join();
+  CHECK_TRUE(rc == 0);
+  CHECK_TRUE(b_rc == 0);
+  CHECK_TRUE(callback_bytes == kBytes);
+  // calls is scheduling-dependent (1 if the receiver outran the consumer,
+  // up to 11 with no coalescing) — only its lower bound is guaranteed.
+  CHECK_TRUE(calls >= 1);
+  CHECK_TRUE(b_recv == a_send);
+  close(sv[0]);
+  close(sv[1]);
+}
+
+// In-process world: one DataPlane per thread over localhost TCP, exercising
+// every allreduce algorithm (incl. the pipelined ring with a tiny segment
+// size) on even/odd world sizes and several dtypes.
+void TestDataPlaneAllreduceAlgos() {
+  for (int world : {2, 3, 4}) {
+    for (AllreduceAlgo algo :
+         {AllreduceAlgo::AUTO, AllreduceAlgo::RING,
+          AllreduceAlgo::RECURSIVE_DOUBLING, AllreduceAlgo::TREE}) {
+      std::vector<std::unique_ptr<DataPlane>> planes;
+      std::vector<PeerAddr> peers(world);
+      for (int r = 0; r < world; ++r) {
+        planes.emplace_back(new DataPlane(r, world));
+        CHECK_TRUE(planes[r]->Listen().ok());
+        peers[r] = {"127.0.0.1", planes[r]->port()};
+        planes[r]->set_allreduce_algo(algo);
+        planes[r]->set_segment_bytes(512);  // force pipelining on the ring
+        planes[r]->set_crossover_bytes(4096);
+      }
+      std::atomic<int> bad{0};
+      std::vector<std::thread> threads;
+      for (int r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+          if (!planes[r]->Connect(peers).ok()) {
+            ++bad;
+            return;
+          }
+          // float32 SUM, count straddling several 512 B segments per chunk
+          // (and an odd count so ring chunks are uneven).
+          {
+            const int64_t n = 4099;
+            std::vector<float> v(n);
+            for (int64_t i = 0; i < n; ++i) {
+              v[i] = static_cast<float>(r + 1) * (i % 11);
+            }
+            if (!planes[r]->Allreduce(v.data(), n, DataType::FLOAT32,
+                                      ReduceOp::SUM).ok()) {
+              ++bad;
+              return;
+            }
+            float scale = world * (world + 1) / 2.0f;
+            for (int64_t i = 0; i < n; ++i) {
+              if (v[i] != scale * (i % 11)) {
+                ++bad;
+                return;
+              }
+            }
+          }
+          // int32 MAX, small (latency path under AUTO).
+          {
+            std::vector<int32_t> v = {r, 100 - r, 7};
+            if (!planes[r]->Allreduce(v.data(), 3, DataType::INT32,
+                                      ReduceOp::MAX).ok()) {
+              ++bad;
+              return;
+            }
+            if (v[0] != world - 1 || v[1] != 100 || v[2] != 7) ++bad;
+          }
+          // fp16 SUM through the fused kernel.
+          {
+            const int64_t n = 1024;
+            std::vector<uint16_t> v(n, FloatToHalfPublic(0.25f));
+            if (!planes[r]->Allreduce(v.data(), n, DataType::FLOAT16,
+                                      ReduceOp::SUM).ok()) {
+              ++bad;
+              return;
+            }
+            for (int64_t i = 0; i < n; ++i) {
+              if (HalfToFloatPublic(v[i]) != 0.25f * world) ++bad;
+            }
+          }
+        });
+      }
+      for (auto& t : threads) t.join();
+      if (bad != 0) {
+        std::fprintf(stderr,
+                     "FAIL DataPlane allreduce world=%d algo=%d (%d bad)\n",
+                     world, static_cast<int>(algo), bad.load());
+        ++failures;
+      }
+      for (auto& p : planes) p->Shutdown();
+    }
+  }
+}
+
 void TestReduceBufferOps() {
   float dst[4] = {1, 2, 3, 4};
   float src[4] = {4, 3, 2, 1};
@@ -200,6 +467,7 @@ void TestBayesianOptimizerPicksBestSample() {
 void TestParameterManagerFreezesAtBest() {
   ParameterManager pm;
   pm.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
+                /*algo_crossover=*/256 << 10, /*tune_crossover=*/true,
                 /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
                 /*max_samples=*/4, /*gp_noise=*/0.1);
   CHECK_TRUE(pm.active());
@@ -215,6 +483,21 @@ void TestParameterManagerFreezesAtBest() {
   ParameterManager::Params p = pm.Current();
   CHECK_TRUE(p.cycle_time_ms >= 0.5 && p.cycle_time_ms <= 50.0);
   CHECK_TRUE(p.fusion_threshold >= (1 << 20));
+  CHECK_TRUE(p.algo_crossover >= (4 << 10) && p.algo_crossover <= (4 << 20));
+
+  // Pinned algorithm (tune_crossover=false): the crossover coordinate is
+  // excluded from the GP and held at its initial value.
+  ParameterManager pinned;
+  pinned.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
+                    /*algo_crossover=*/123456, /*tune_crossover=*/false,
+                    /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
+                    /*max_samples=*/4, /*gp_noise=*/0.1);
+  t = 0.0;
+  for (int i = 0; i < 64; ++i) {
+    t += 0.01;
+    pinned.Update(/*bytes=*/1 << 20, t);
+  }
+  CHECK_TRUE(pinned.Current().algo_crossover == 123456);
 }
 
 }  // namespace
@@ -227,6 +510,11 @@ int main() {
   TestReaderTruncationIsSafe();
   TestHalfConversionRoundtrip();
   TestHalfConversionSpecialValues();
+  TestHalfConversionExhaustive();
+  TestHalfRoundToNearestEven();
+  TestReduceBufferHalfMatchesScalar();
+  TestSendRecvSegmented();
+  TestDataPlaneAllreduceAlgos();
   TestReduceBufferOps();
   TestGaussianProcessInterpolates();
   TestBayesianOptimizerPicksBestSample();

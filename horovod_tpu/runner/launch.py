@@ -58,6 +58,12 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                         "HOROVOD_FUSION_THRESHOLD)")
     p.add_argument("--cycle-time-ms", type=float, default=1.0,
                    help="background cycle time (reference: HOROVOD_CYCLE_TIME)")
+    p.add_argument("--allreduce-algo", default="auto",
+                   choices=list(ev.ALLREDUCE_ALGOS),
+                   help="native allreduce algorithm: auto picks recursive "
+                        "doubling below the (autotuned) crossover size and "
+                        "the pipelined ring above it "
+                        "(HVDTPU_ALLREDUCE_ALGO)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
@@ -191,6 +197,7 @@ def _apply_tuning_env(env: dict, args) -> dict:
     env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
     env[ev.HVDTPU_FUSION_THRESHOLD] = str(
         int(args.fusion_threshold_mb * 1024 * 1024))
+    env[ev.HVDTPU_ALLREDUCE_ALGO] = args.allreduce_algo
     if args.timeline:
         # Base path; per-worker suffixing happens where the worker identity
         # is known (static: per rank here in _build_env; elastic: the driver).

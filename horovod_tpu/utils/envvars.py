@@ -45,6 +45,18 @@ HVDTPU_CONTROLLER_PORT = "HVDTPU_CONTROLLER_PORT"
 HVDTPU_FUSION_THRESHOLD = "HVDTPU_FUSION_THRESHOLD"
 HVDTPU_CYCLE_TIME = "HVDTPU_CYCLE_TIME"
 
+# Native allreduce algorithm selection (reference fork: the IST-DASLab
+# ring/scatter-allgather/tree menu; native/data_plane.h AllreduceAlgo).
+# ALGO: auto | ring | recursive_doubling | tree. CROSSOVER: AUTO's
+# ring/latency switchover in bytes (also autotuned). SEGMENT_BYTES: ring
+# pipeline segment granularity.
+HVDTPU_ALLREDUCE_ALGO = "HVDTPU_ALLREDUCE_ALGO"
+HVDTPU_ALLREDUCE_CROSSOVER = "HVDTPU_ALLREDUCE_CROSSOVER"
+HVDTPU_ALLREDUCE_SEGMENT_BYTES = "HVDTPU_ALLREDUCE_SEGMENT_BYTES"
+
+# Valid HVDTPU_ALLREDUCE_ALGO values, mapped to hvdtpu::AllreduceAlgo.
+ALLREDUCE_ALGOS = ("auto", "ring", "recursive_doubling", "tree")
+
 # Response cache (reference: HOROVOD_CACHE_CAPACITY)
 HVDTPU_CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
 

@@ -1,0 +1,330 @@
+#!/usr/bin/env python3
+"""Microbenchmark for the native allreduce data plane (process mode).
+
+Sweeps message sizes across reduction algorithms and world sizes over
+localhost TCP and emits a JSON report (plus a markdown table on stderr for
+pasting into docs/benchmarks.md). Drives the real native core — controller
+negotiation, fusion buffer, TCP data plane — through a minimal ctypes
+binding, so it needs neither JAX nor the horovod_tpu package and runs on a
+seed build of the library too (algorithm selection is skipped when the
+``hvdtpu_set_allreduce_tuning`` symbol is absent; only ``ring``/``auto``
+configs run there, measuring the seed ring).
+
+Usage:
+    python scripts/bench_native_allreduce.py                  # default sweep
+    python scripts/bench_native_allreduce.py --quick          # small sweep
+    python scripts/bench_native_allreduce.py \
+        --world-sizes 2,4,8 --algos auto,ring,recursive_doubling,tree \
+        --min-bytes 4096 --max-bytes 268435456 -o bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvdtpu_core.so")
+
+ALGOS = {"auto": 0, "ring": 1, "recursive_doubling": 2, "tree": 3}
+DTYPES = {"float32": (7, 4), "float16": (6, 2), "bfloat16": (10, 2)}
+OP_ALLREDUCE = 0
+REDUCE_SUM = 1
+
+
+def load_lib(path: str) -> ctypes.CDLL:
+    lib = ctypes.CDLL(path)
+    lib.hvdtpu_create.restype = ctypes.c_void_p
+    lib.hvdtpu_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_double, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_double]
+    lib.hvdtpu_start.restype = ctypes.c_int
+    lib.hvdtpu_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int]
+    lib.hvdtpu_shutdown.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_destroy.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_enqueue.restype = ctypes.c_longlong
+    lib.hvdtpu_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int]
+    lib.hvdtpu_wait.restype = ctypes.c_int
+    lib.hvdtpu_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                ctypes.c_char_p, ctypes.c_int]
+    lib.hvdtpu_result_bytes.restype = ctypes.c_longlong
+    lib.hvdtpu_result_bytes.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
+    lib.hvdtpu_copy_result.restype = ctypes.c_int
+    lib.hvdtpu_copy_result.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+        ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
+    try:
+        lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
+        lib.hvdtpu_set_allreduce_tuning.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_longlong]
+    except AttributeError:
+        pass  # seed build: no algorithm selection
+    return lib
+
+
+def parse_sizes(args) -> list:
+    sizes, b = [], args.min_bytes
+    while b <= args.max_bytes:
+        sizes.append(b)
+        b *= args.size_step
+    return sizes
+
+
+def iters_for(nbytes: int) -> tuple:
+    if nbytes <= 1 << 16:
+        return 60, 10
+    if nbytes <= 1 << 20:
+        return 30, 5
+    if nbytes <= 16 << 20:
+        return 10, 3
+    if nbytes <= 64 << 20:
+        return 5, 2
+    return 3, 1
+
+
+# --------------------------------------------------------------------------
+# Worker
+# --------------------------------------------------------------------------
+
+def run_worker(args) -> int:
+    lib = load_lib(args.lib)
+    rank, n = args.rank, args.world
+    dtype_code, itemsize = DTYPES[args.dtype]
+    core = lib.hvdtpu_create(rank, n, rank, n, 0, 1, b"127.0.0.1", args.port,
+                             b"127.0.0.1", args.cycle_time_ms,
+                             64 * 1024 * 1024, b"", 0, 600.0)
+    if hasattr(lib, "hvdtpu_set_allreduce_tuning"):
+        lib.hvdtpu_set_allreduce_tuning(core, ALGOS[args.algo],
+                                        args.crossover, args.segment)
+    elif args.algo not in ("auto", "ring"):
+        print(f"SKIP algo {args.algo}: library has no algorithm selection",
+              file=sys.stderr)
+        return 0
+    err = ctypes.create_string_buffer(1024)
+    if lib.hvdtpu_start(core, err, len(err)) != 0:
+        print(f"start failed: {err.value.decode()}", file=sys.stderr)
+        return 1
+
+    def allreduce(name: bytes, buf, count: int, out) -> None:
+        shape = (ctypes.c_longlong * 1)(count)
+        h = lib.hvdtpu_enqueue(core, name, OP_ALLREDUCE, REDUCE_SUM,
+                               dtype_code, shape, 1, buf, 1.0, 1.0, 0,
+                               None, 0, err, len(err))
+        if h < 0:
+            raise RuntimeError(f"enqueue: {err.value.decode()}")
+        if lib.hvdtpu_wait(core, h, err, len(err)) != 0:
+            raise RuntimeError(f"wait: {err.value.decode()}")
+        if lib.hvdtpu_copy_result(core, h, out, ctypes.sizeof(out), err,
+                                  len(err)) != 0:
+            raise RuntimeError(f"copy: {err.value.decode()}")
+
+    rc = 0
+    try:
+        for nbytes in [int(s) for s in args.sizes.split(",")]:
+            count = max(1, nbytes // itemsize)
+            buf = (ctypes.c_char * (count * itemsize))()
+            out = (ctypes.c_char * (count * itemsize))()
+            if args.dtype == "float32":
+                fbuf = ctypes.cast(buf, ctypes.POINTER(ctypes.c_float))
+                fbuf[0] = float(rank + 1)
+                fbuf[count - 1] = 2.0 * (rank + 1)
+            name = f"bench.{nbytes}".encode()
+            iters, warmup = iters_for(nbytes)
+            for _ in range(warmup):
+                allreduce(name, buf, count, out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                allreduce(name, buf, count, out)
+            dt = (time.perf_counter() - t0) / iters
+            if args.dtype == "float32":
+                fout = ctypes.cast(out, ctypes.POINTER(ctypes.c_float))
+                want = n * (n + 1) / 2.0
+                if abs(fout[0] - want) > 1e-3 * want or \
+                   abs(fout[count - 1] - 2 * want) > 2e-3 * want:
+                    raise RuntimeError(
+                        f"bad allreduce result at {nbytes}B: "
+                        f"{fout[0]} / {fout[count - 1]}, want {want}/{2*want}")
+            if rank == 0:
+                print(json.dumps({
+                    "bytes": nbytes, "iters": iters, "avg_s": dt,
+                    "algbw_gbps": nbytes / dt / 1e9,
+                    "busbw_gbps": nbytes * 2 * (n - 1) / n / dt / 1e9,
+                }), flush=True)
+    except Exception as e:  # pragma: no cover - surfaced by the parent
+        print(f"worker rank {rank} failed: {e}", file=sys.stderr)
+        rc = 1
+    finally:
+        lib.hvdtpu_shutdown(core)
+        lib.hvdtpu_destroy(core)
+    return rc
+
+
+# --------------------------------------------------------------------------
+# Parent
+# --------------------------------------------------------------------------
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_config(args, world: int, algo: str, sizes: list) -> tuple:
+    """Returns (rows, failed): rows from rank 0, failed=True when any rank
+    exited nonzero or timed out (rows may still be partial)."""
+    port = free_port()
+    procs = []
+    for r in range(world):
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--rank", str(r), "--world", str(world), "--port", str(port),
+               "--algo", algo, "--sizes", ",".join(map(str, sizes)),
+               "--lib", args.lib, "--dtype", args.dtype,
+               "--crossover", str(args.crossover),
+               "--segment", str(args.segment),
+               "--cycle-time-ms", str(args.cycle_time_ms)]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    rows, failed = [], False
+    try:
+        for r, p in enumerate(procs):
+            out, errtxt = p.communicate(timeout=args.timeout)
+            if p.returncode != 0:
+                failed = True
+                print(f"[world={world} algo={algo}] rank {r} rc="
+                      f"{p.returncode}:\n{errtxt[-2000:]}", file=sys.stderr)
+            if r == 0:
+                for line in out.splitlines():
+                    line = line.strip()
+                    if line.startswith("{"):
+                        rows.append(json.loads(line))
+    except subprocess.TimeoutExpired:
+        failed = True
+        print(f"[world={world} algo={algo}] timed out", file=sys.stderr)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for row in rows:
+        row.update({"world": world, "algo": algo, "dtype": args.dtype})
+    return rows, failed
+
+
+def human(nbytes: int) -> str:
+    if nbytes >= 1 << 20:
+        return f"{nbytes / (1 << 20):g} MB"
+    return f"{nbytes / 1024:g} KB"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--world", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--sizes", default="", help=argparse.SUPPRESS)
+    p.add_argument("--lib", default=os.environ.get("HVDTPU_NATIVE_LIB",
+                                                   DEFAULT_LIB))
+    p.add_argument("--algo", default="auto", choices=sorted(ALGOS))
+    p.add_argument("--algos", default="auto,ring,recursive_doubling,tree")
+    p.add_argument("--world-sizes", default="2,4,8")
+    p.add_argument("--dtype", default="float32", choices=sorted(DTYPES))
+    p.add_argument("--min-bytes", type=int, default=4096)
+    p.add_argument("--max-bytes", type=int, default=256 << 20)
+    p.add_argument("--size-step", type=int, default=16,
+                   help="geometric step between message sizes")
+    p.add_argument("--crossover", type=int, default=-1,
+                   help="ring/latency-algorithm crossover bytes (-1: default)")
+    p.add_argument("--segment", type=int, default=-1,
+                   help="ring pipeline segment bytes (-1: default)")
+    p.add_argument("--cycle-time-ms", type=float, default=1.0)
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--quick", action="store_true",
+                   help="2-size sweep at world 2 and 4 only")
+    p.add_argument("-o", "--output", default=None, help="write JSON here")
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args)
+
+    if not os.path.exists(args.lib):
+        print(f"native library not found: {args.lib} (make -C "
+              f"horovod_tpu/native)", file=sys.stderr)
+        return 1
+    sizes = parse_sizes(args)
+    worlds = [int(w) for w in args.world_sizes.split(",")]
+    algos = args.algos.split(",")
+    if args.quick:
+        sizes = [4096, 4 << 20]
+        worlds = [2, 4]
+    for a in algos:
+        if a not in ALGOS:
+            print(f"unknown algo {a!r}; choices: {sorted(ALGOS)}",
+                  file=sys.stderr)
+            return 2
+
+    results = []
+    failed_configs = []
+    for world in worlds:
+        for algo in algos:
+            t0 = time.time()
+            rows, failed = run_config(args, world, algo, sizes)
+            results.extend(rows)
+            if failed:
+                failed_configs.append(f"world={world} algo={algo}")
+            print(f"[world={world} algo={algo}] {len(rows)} sizes in "
+                  f"{time.time() - t0:.1f}s"
+                  f"{' (FAILED)' if failed else ''}", file=sys.stderr)
+
+    report = {"lib": args.lib, "dtype": args.dtype, "results": results,
+              "failed_configs": failed_configs}
+    text = json.dumps(report, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    print(text)
+
+    # Markdown table for docs/benchmarks.md.
+    by_key = {}
+    for row in results:
+        by_key.setdefault((row["world"], row["bytes"]), {})[row["algo"]] = row
+    lines = ["| world | size | " + " | ".join(algos) + " |",
+             "|---|---|" + "---|" * len(algos)]
+    for (world, nbytes), cells in sorted(by_key.items()):
+        vals = []
+        for a in algos:
+            row = cells.get(a)
+            if row is None:
+                vals.append("—")
+            elif nbytes >= 1 << 20:
+                vals.append(f"{row['algbw_gbps']:.2f} GB/s")
+            else:
+                vals.append(f"{row['avg_s'] * 1e6:.0f} µs")
+        lines.append(f"| {world} | {human(nbytes)} | " + " | ".join(vals) +
+                     " |")
+    print("\n".join(lines), file=sys.stderr)
+    if failed_configs:
+        print(f"FAILED configs: {', '.join(failed_configs)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Worker: exercise one native allreduce algorithm end to end.
+
+HVDTPU_ALLREDUCE_ALGO (read by basics.py at init) selects the algorithm;
+HVDTPU_ALLREDUCE_SEGMENT_BYTES can be shrunk so even modest tensors take the
+ring's segmented pipeline. Runs a small (latency-path under auto), a
+multi-chunk fp32, and an fp16 allreduce, checking exact results — also the
+TSan target for the pipelined path (tests/test_sanitizers.py).
+"""
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert hvd.mode() == "process", hvd.mode()
+
+iters = int(os.environ.get("TEST_ALGO_ITERS", "3"))
+for it in range(iters):
+    # Small: recursive doubling under auto.
+    s = np.full((512,), float(r + it), np.float32)
+    out = np.asarray(hvd.allreduce(s, name=f"s{it}", op=hvd.Sum))
+    np.testing.assert_allclose(out, sum(range(n)) + n * it, rtol=1e-6)
+
+    # Large enough for several pipeline segments per ring chunk at the
+    # (shrunken) segment size; odd count for uneven chunks.
+    count = 1_000_001
+    x = np.full((count,), float(r + 1), np.float32)
+    x[::1013] = 2.0 * (r + 1)
+    out = np.asarray(hvd.allreduce(x, name=f"x{it}", op=hvd.Sum))
+    want = n * (n + 1) / 2.0
+    np.testing.assert_allclose(out[1], want, rtol=1e-6)
+    np.testing.assert_allclose(out[::1013], 2 * want, rtol=1e-6)
+
+    # fp16 through the fused convert+reduce kernel.
+    h = np.full((4096,), 0.25, np.float16)
+    out = np.asarray(hvd.allreduce(h, name=f"h{it}", op=hvd.Sum))
+    np.testing.assert_allclose(out.astype(np.float32), 0.25 * n)
+
+    # min/max take the scalar kernels.
+    m = np.array([float(r), float(-r), 7.0], np.float32)
+    out = np.asarray(hvd.allreduce(m, name=f"m{it}", op=hvd.Min))
+    np.testing.assert_allclose(out, [0.0, -(n - 1), 7.0])
+
+hvd.shutdown()
+print("ALL OK")
+sys.exit(0)

@@ -37,9 +37,10 @@ if r == 0 and core is not None:
         assert len(lines) >= 2, lines  # header + >=1 scored sample
         assert lines[0].startswith("cycle_time_ms,"), lines[0]
         assert "cache_enabled" in lines[0], lines[0]
+        assert "algo_crossover_bytes" in lines[0], lines[0]
         rows = [l.split(",") for l in lines[1:]]
-        scored = [r_ for r_ in rows if float(r_[3]) >= 0]
-        frozen = [r_ for r_ in rows if float(r_[3]) < 0]
+        scored = [r_ for r_ in rows if float(r_[4]) >= 0]
+        frozen = [r_ for r_ in rows if float(r_[4]) < 0]
         # Categorical dimension is explored as a clean 0/1 switch
         # (reference: CategoricalParameter, parameter_manager.h:225).
         assert all(r_[2] in ("0", "1") for r_ in rows), rows
@@ -47,13 +48,13 @@ if r == 0 and core is not None:
             # Effectiveness: tuning concluded, and the frozen (chosen)
             # point is the best-scoring sampled point — i.e. it beats the
             # worst sampled point whenever the traffic differentiated them.
-            best = max(scored, key=lambda r_: float(r_[3]))
-            worst = min(scored, key=lambda r_: float(r_[3]))
-            assert frozen[-1][:3] == best[:3], (frozen[-1], best)
-            if float(best[3]) != float(worst[3]):
-                assert float(best[3]) > float(worst[3])
-            print(f"autotune froze at {best[:3]} "
-                  f"(best {best[3]} vs worst {worst[3]} bytes/s)")
+            best = max(scored, key=lambda r_: float(r_[4]))
+            worst = min(scored, key=lambda r_: float(r_[4]))
+            assert frozen[-1][:4] == best[:4], (frozen[-1], best)
+            if float(best[4]) != float(worst[4]):
+                assert float(best[4]) > float(worst[4])
+            print(f"autotune froze at {best[:4]} "
+                  f"(best {best[4]} vs worst {worst[4]} bytes/s)")
 
 hvd.shutdown()
 print("ALL OK")

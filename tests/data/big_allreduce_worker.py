@@ -1,0 +1,47 @@
+"""Worker: large-tensor allreduce regression (ISSUE 1 satellite).
+
+A >= 64 MB fp32 allreduce across 4 ranks pushes every ring chunk far past
+the kernel socket buffers, so any phase that ever sends without a concurrent
+receive (or consumes pipeline segments out of order) deadlocks here instead
+of in production. Size in MB comes from TEST_ALLREDUCE_MB (default 64).
+"""
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert hvd.mode() == "process", hvd.mode()
+
+mb = int(os.environ.get("TEST_ALLREDUCE_MB", "64"))
+count = mb * (1 << 20) // 4
+x = np.full((count,), float(r + 1), np.float32)
+# Deterministic spot pattern so a chunk landing at the wrong offset fails.
+x[::4096] = float((r + 1) * 3)
+
+for it in range(2):
+    out = np.asarray(hvd.allreduce(x, name=f"big{it}", op=hvd.Sum))
+    want = n * (n + 1) / 2.0
+    np.testing.assert_allclose(out[1], want, rtol=1e-6)
+    np.testing.assert_allclose(out[::4096], 3 * want, rtol=1e-6)
+    np.testing.assert_allclose(out[count - 1], want, rtol=1e-6)
+    np.testing.assert_allclose(float(out.sum(dtype=np.float64)),
+                               want * (count + 2 * (len(out[::4096]))),
+                               rtol=1e-5)
+
+# A small tensor right after the big one: the latency path and the ring
+# must coexist in one session.
+s = np.full((128,), float(r), np.float32)
+out = np.asarray(hvd.allreduce(s, name="small", op=hvd.Sum))
+np.testing.assert_allclose(out, sum(range(n)))
+
+hvd.shutdown()
+print("ALL OK")
+sys.exit(0)

@@ -75,6 +75,26 @@ class TestArgParsing:
         with pytest.raises(SystemExit):
             parse_args(["-np", "2"])
 
+    def test_allreduce_algo_flag(self):
+        """--allreduce-algo validates against the native menu and lands in
+        the workers' env as HVDTPU_ALLREDUCE_ALGO (ISSUE 1 satellite)."""
+        from horovod_tpu.runner.launch import _apply_tuning_env
+        from horovod_tpu.utils import envvars as ev
+
+        args = parse_args(["-np", "2", "--allreduce-algo",
+                           "recursive_doubling", "python", "x.py"])
+        assert args.allreduce_algo == "recursive_doubling"
+        env = _apply_tuning_env({}, args)
+        assert env[ev.HVDTPU_ALLREDUCE_ALGO] == "recursive_doubling"
+        # Default is auto (size-adaptive).
+        args = parse_args(["-np", "2", "python", "x.py"])
+        assert _apply_tuning_env({}, args)[ev.HVDTPU_ALLREDUCE_ALGO] == "auto"
+
+    def test_allreduce_algo_flag_rejects_unknown(self):
+        with pytest.raises(SystemExit):
+            parse_args(["-np", "2", "--allreduce-algo", "hypercube",
+                        "python", "x.py"])
+
 
 class TestPythonPlaceholder:
     """Per-slot interpreter substitution (a mixed local+remote job cannot
