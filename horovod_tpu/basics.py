@@ -107,6 +107,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
     lib.hvdtpu_set_allreduce_tuning.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong]
+    lib.hvdtpu_set_transport.restype = ctypes.c_int
+    lib.hvdtpu_set_transport.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
     lib.hvdtpu_set_autotune.restype = ctypes.c_int
     lib.hvdtpu_set_autotune.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -204,6 +207,19 @@ class NativeCore:
             self._core, _ALLREDUCE_ALGOS[algo],
             ev.get_int(ev.HVDTPU_ALLREDUCE_CROSSOVER, 0),
             ev.get_int(ev.HVDTPU_ALLREDUCE_SEGMENT_BYTES, 0))
+        # Transport subsystem (native/transport.h): same-host rank pairs ride
+        # POSIX shared-memory ring lanes unless HVDTPU_SHM=0; the two-level
+        # allreduce (HVDTPU_ALLREDUCE_HIER) defaults to autotuner-owned auto.
+        hier = (ev.get_str(ev.HVDTPU_ALLREDUCE_HIER, "auto") or
+                "auto").strip().lower()
+        if hier not in ev.ALLREDUCE_HIER_MODES:
+            raise ValueError(
+                f"{ev.HVDTPU_ALLREDUCE_HIER} must be one of "
+                f"{sorted(set(ev.ALLREDUCE_HIER_MODES) - {''})}, got {hier!r}")
+        self._lib.hvdtpu_set_transport(
+            self._core, int(ev.get_bool(ev.HVDTPU_SHM, default=True)),
+            ev.get_int(ev.HVDTPU_SHM_RING_BYTES, 0),
+            ev.ALLREDUCE_HIER_MODES[hier])
         # Autotune (reference: HOROVOD_AUTOTUNE + HOROVOD_AUTOTUNE_* knobs,
         # operations.cc:474-532).
         if ev.get_bool(ev.HVDTPU_AUTOTUNE):

@@ -180,21 +180,25 @@ double ToUnit(double v, double lo, double hi) {
 void ParameterManager::Initialize(double cycle_time_ms,
                                   int64_t fusion_threshold, bool cache_enabled,
                                   int64_t algo_crossover, bool tune_crossover,
+                                  bool hier_enabled, bool tune_hier,
                                   const std::string& log_path,
                                   int warmup_samples, int cycles_per_sample,
                                   int max_samples, double gp_noise) {
-  current_ = {cycle_time_ms, fusion_threshold, cache_enabled, algo_crossover};
+  current_ = {cycle_time_ms, fusion_threshold, cache_enabled, algo_crossover,
+              hier_enabled};
   tune_crossover_ = tune_crossover;
+  tune_hier_ = tune_hier;
   warmup_samples_ = warmup_samples;
   warmup_left_ = warmup_samples;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
-  opt_ = BayesianOptimizer(tune_crossover ? 4 : 3, gp_noise);
+  opt_ = BayesianOptimizer(3 + (tune_crossover ? 1 : 0) + (tune_hier ? 1 : 0),
+                           gp_noise);
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_ != nullptr) {
       fputs("cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
-            "algo_crossover_bytes,score_bytes_per_sec\n",
+            "algo_crossover_bytes,hier_enabled,score_bytes_per_sec\n",
             log_);
     }
   }
@@ -221,6 +225,7 @@ std::vector<double> ParameterManager::ToVector(const Params& p) const {
     x.push_back(
         ToUnit(static_cast<double>(p.algo_crossover), kCrossMin, kCrossMax));
   }
+  if (tune_hier_) x.push_back(p.hier_enabled ? 1.0 : 0.0);
   return x;
 }
 
@@ -233,18 +238,26 @@ void ParameterManager::SetFromVector(const std::vector<double>& x) {
       static_cast<int64_t>(std::llround(FromUnit(x[1], kFusionMin,
                                                  kFusionMax)));
   current_.cache_enabled = x[2] >= 0.5;
-  if (tune_crossover_ && x.size() > 3) {
+  size_t next = 3;
+  if (tune_crossover_ && x.size() > next) {
     current_.algo_crossover = static_cast<int64_t>(
-        std::llround(FromUnit(x[3], kCrossMin, kCrossMax)));
+        std::llround(FromUnit(x[next], kCrossMin, kCrossMax)));
+    ++next;
+  }
+  if (tune_hier_ && x.size() > next) {
+    // Categorical like the cache switch: explored continuously, thresholded
+    // here (reference: CategoricalParameter, parameter_manager.h:225).
+    current_.hier_enabled = x[next] >= 0.5;
   }
 }
 
 void ParameterManager::LogSample(double score) {
   if (log_ == nullptr) return;
-  fprintf(log_, "%.3f,%lld,%d,%lld,%.1f\n", current_.cycle_time_ms,
+  fprintf(log_, "%.3f,%lld,%d,%lld,%d,%.1f\n", current_.cycle_time_ms,
           static_cast<long long>(current_.fusion_threshold),
           current_.cache_enabled ? 1 : 0,
-          static_cast<long long>(current_.algo_crossover), score);
+          static_cast<long long>(current_.algo_crossover),
+          current_.hier_enabled ? 1 : 0, score);
   fflush(log_);
 }
 

@@ -69,8 +69,9 @@ class BayesianOptimizer {
   uint64_t rng_ = 0x9e3779b97f4a7c15ull;  // deterministic across ranks/runs
 };
 
-// Tunes cycle time, fusion threshold, the response-cache on/off switch, and
-// the allreduce ring/latency-algorithm crossover size
+// Tunes cycle time, fusion threshold, the response-cache on/off switch,
+// the allreduce ring/latency-algorithm crossover size, and the hierarchical
+// (two-level) allreduce on/off switch
 // online, scored by bytes/sec. Coordinator-only; winning values are
 // broadcast to workers by the core (reference: ParameterManager lives in
 // HorovodGlobalState and is driven from the background loop,
@@ -87,15 +88,22 @@ class ParameterManager {
     // the latency algorithm (recursive doubling), larger ones the pipelined
     // ring (data_plane.h AllreduceAlgo).
     int64_t algo_crossover;
+    // Hierarchical two-level allreduce (data_plane.h HierMode::AUTO): a
+    // categorical on/off dimension like the cache switch (reference analog:
+    // hierarchical_allreduce in BayesianParameter, parameter_manager.h:186).
+    bool hier_enabled;
   };
 
-  // tune_crossover: include the algo crossover as a 4th GP dimension only
-  // when the data plane is in AUTO mode — with a pinned algorithm the
+  // tune_crossover: include the algo crossover as an extra GP dimension
+  // only when the data plane is in AUTO mode — with a pinned algorithm the
   // coordinate cannot affect the score and would just dilute the sample
-  // budget; the value is then held constant at algo_crossover.
+  // budget; the value is then held constant at algo_crossover. tune_hier:
+  // include the hierarchical switch only when HVDTPU_ALLREDUCE_HIER=auto
+  // AND the topology is non-trivial (multiple hosts, multi-rank hosts).
   void Initialize(double cycle_time_ms, int64_t fusion_threshold,
                   bool cache_enabled, int64_t algo_crossover,
-                  bool tune_crossover, const std::string& log_path,
+                  bool tune_crossover, bool hier_enabled, bool tune_hier,
+                  const std::string& log_path,
                   int warmup_samples, int cycles_per_sample, int max_samples,
                   double gp_noise);
   ~ParameterManager();
@@ -119,7 +127,8 @@ class ParameterManager {
   bool active_ = false;
   bool frozen_ = false;
   bool tune_crossover_ = true;
-  Params current_{1.0, 64 << 20, true, 32 << 10};
+  bool tune_hier_ = false;
+  Params current_{1.0, 64 << 20, true, 32 << 10, false};
   BayesianOptimizer opt_{4};
   int warmup_samples_ = 3;
   int cycles_per_sample_ = 50;

@@ -256,6 +256,13 @@ struct CoreConfig {
   int32_t allreduce_algo = 0;  // AUTO
   int64_t allreduce_crossover = 0;
   int64_t allreduce_segment = 0;
+  // Transport subsystem (HVDTPU_SHM / HVDTPU_SHM_RING_BYTES /
+  // HVDTPU_ALLREDUCE_HIER; data_plane.h). shm defaults on — same-host pairs
+  // negotiate shared-memory lanes at Connect and fall back to TCP when
+  // either side fails setup. hier: 0 off, 1 on, 2 auto (autotuner-owned).
+  int32_t shm_enabled = 1;
+  int64_t shm_ring_bytes = 0;
+  int32_t allreduce_hier = 2;
 };
 
 class Core {
@@ -415,17 +422,9 @@ Status Core::Start() {
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
   data_plane_.set_crossover_bytes(cfg_.allreduce_crossover);
   data_plane_.set_segment_bytes(cfg_.allreduce_segment);
-  if (cfg_.autotune && cfg_.rank == 0) {
-    param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
-                              cfg_.cache_capacity > 0,
-                              data_plane_.crossover_bytes(),
-                              data_plane_.allreduce_algo() ==
-                                  AllreduceAlgo::AUTO,
-                              cfg_.autotune_log, cfg_.autotune_warmup_samples,
-                              cfg_.autotune_cycles_per_sample,
-                              cfg_.autotune_max_samples,
-                              cfg_.autotune_gp_noise);
-  }
+  data_plane_.set_shm_enabled(cfg_.shm_enabled != 0);
+  data_plane_.set_shm_ring_bytes(cfg_.shm_ring_bytes);
+  data_plane_.set_hier_mode(static_cast<HierMode>(cfg_.allreduce_hier));
   // (Re)create the wake pipe. The previous pipe, if any, is closed only
   // here and in the destructor — never in Shutdown — so a user thread's
   // Wake() racing a concurrent Shutdown can at worst write one byte into a
@@ -570,6 +569,28 @@ Status Core::Start() {
     }
     st = data_plane_.Connect(peers);
     if (!st.ok()) return st;
+  }
+
+  if (cfg_.autotune && cfg_.rank == 0) {
+    // After Connect on purpose: the hier switch joins the GP only under
+    // AUTO with a topology where the two-level path exists and can matter —
+    // 2+ hosts AND some host holding 2+ ranks — judged from the REAL peer
+    // table (the launcher-provided local/cross sizes describe only this
+    // rank, which may sit alone on its host while other hosts are
+    // multi-rank).
+    const bool tune_hier = cfg_.allreduce_hier == 2 &&
+                           data_plane_.num_hosts() > 1 &&
+                           data_plane_.num_hosts() < cfg_.size;
+    param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
+                              cfg_.cache_capacity > 0,
+                              data_plane_.crossover_bytes(),
+                              data_plane_.allreduce_algo() ==
+                                  AllreduceAlgo::AUTO,
+                              /*hier_enabled=*/false, tune_hier,
+                              cfg_.autotune_log, cfg_.autotune_warmup_samples,
+                              cfg_.autotune_cycles_per_sample,
+                              cfg_.autotune_max_samples,
+                              cfg_.autotune_gp_noise);
   }
 
   shutdown_ = false;
@@ -885,12 +906,14 @@ void Core::PumpControlPlane() {
         int64_t fusion = r.I64();
         bool cache_on = r.I32() != 0;
         int64_t crossover = r.I64();
+        bool hier_on = r.I32() != 0;
         if (!r.ok()) {
           LogBadFrame(cfg_.rank, "worker PARAMS", frame);
           continue;
         }
         // data_plane_ is driven by this (background) thread only.
         data_plane_.set_crossover_bytes(crossover);
+        data_plane_.set_hier_auto(hier_on);
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = cycle;
         cfg_.fusion_threshold = fusion;
@@ -1334,6 +1357,7 @@ void Core::CoordinatorEmitResponses() {
     if (bytes > 0 && param_manager_.Update(bytes, NowSeconds())) {
       ParameterManager::Params p = param_manager_.Current();
       data_plane_.set_crossover_bytes(p.algo_crossover);
+      data_plane_.set_hier_auto(p.hier_enabled);
       {
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = p.cycle_time_ms;
@@ -1347,6 +1371,7 @@ void Core::CoordinatorEmitResponses() {
         w.I64(p.fusion_threshold);
         w.I32(p.cache_enabled ? 1 : 0);
         w.I64(p.algo_crossover);
+        w.I32(p.hier_enabled ? 1 : 0);
         std::vector<uint8_t> payload = w.Take();
         for (int rank = 1; rank < cfg_.size; ++rank) {
           if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
@@ -1431,13 +1456,21 @@ void Core::ExecuteResponse(const Response& resp) {
     return;
   }
 
+  // Transport tag per op (timeline arg): which lane mix carried it, and
+  // whether the allreduce took the hierarchical two-level path.
+  std::string lane = data_plane_.transport_label();
+  if (resp.op_type == OpType::ALLREDUCE && data_plane_.hier_active()) {
+    lane += "+hier";
+  }
   for (auto* e : entries) {
     timeline_.ActivityStart(
-        e->name, resp.op_type == OpType::ALLREDUCE ? "ALLREDUCE"
-                 : resp.op_type == OpType::ALLGATHER ? "ALLGATHER"
-                 : resp.op_type == OpType::BROADCAST ? "BROADCAST"
-                 : resp.op_type == OpType::ALLTOALL ? "ALLTOALL"
-                                                     : "REDUCESCATTER");
+        e->name,
+        resp.op_type == OpType::ALLREDUCE ? "ALLREDUCE"
+        : resp.op_type == OpType::ALLGATHER ? "ALLGATHER"
+        : resp.op_type == OpType::BROADCAST ? "BROADCAST"
+        : resp.op_type == OpType::ALLTOALL ? "ALLTOALL"
+                                            : "REDUCESCATTER",
+        lane);
   }
 
   Status st = Status::OK();
@@ -1849,6 +1882,20 @@ int hvdtpu_set_allreduce_tuning(void* core, int algo,
   cfg->allreduce_algo = algo;
   cfg->allreduce_crossover = crossover_bytes;
   cfg->allreduce_segment = segment_bytes;
+  return 0;
+}
+
+// Transport subsystem knobs (data_plane.h): shm_enabled toggles the POSIX
+// shared-memory lanes for same-host pairs (on by default), ring_bytes sizes
+// each per-direction ring (<= 0 keeps the default), hier_mode selects the
+// hierarchical two-level allreduce (0 off, 1 on, 2 auto/autotuned).
+int hvdtpu_set_transport(void* core, int shm_enabled,
+                         long long shm_ring_bytes, int hier_mode) {
+  if (hier_mode < 0 || hier_mode > 2) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->shm_enabled = shm_enabled;
+  cfg->shm_ring_bytes = shm_ring_bytes;
+  cfg->allreduce_hier = hier_mode;
   return 0;
 }
 

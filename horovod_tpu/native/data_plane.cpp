@@ -1,12 +1,16 @@
 #include "data_plane.h"
 
 #include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cmath>
+#include <cstdio>
 #include <cstring>
 #include <thread>
 
+#include "shm_transport.h"
 #include "socket_util.h"
 
 #if defined(__x86_64__)
@@ -355,7 +359,12 @@ void ReduceBuffer(void* dst, const void* src, int64_t count, DataType dtype,
 }
 
 DataPlane::DataPlane(int rank, int size)
-    : rank_(rank), size_(size), fds_(size, -1) {}
+    : rank_(rank), size_(size), fds_(size, -1), transports_(size) {
+  world_group_.resize(size);
+  for (int r = 0; r < size; ++r) world_group_[r] = r;
+  local_group_ = {rank};
+  leaders_ = {0};
+}
 
 DataPlane::~DataPlane() { Shutdown(); }
 
@@ -417,10 +426,119 @@ Status DataPlane::Connect(const std::vector<PeerAddr>& peers) {
     }
   }
   inline_max_bytes_ = std::max<int64_t>(lim, 0);
+
+  // Host topology from the peer table: ranks advertising the same host
+  // string form a local group; the lowest rank per host is its leader.
+  // (Two names for one machine — "localhost" vs "127.0.0.1" — read as two
+  // hosts; the launcher advertises one canonical name per host.)
+  local_group_.clear();
+  leaders_.clear();
+  {
+    std::vector<std::string> seen;
+    for (int r = 0; r < size_; ++r) {
+      if (peers[r].host == peers[rank_].host) local_group_.push_back(r);
+      if (std::find(seen.begin(), seen.end(), peers[r].host) == seen.end()) {
+        seen.push_back(peers[r].host);
+        leaders_.push_back(r);
+      }
+    }
+  }
+  return SetupTransports(peers);
+}
+
+Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
+  for (int peer = 0; peer < size_; ++peer) {
+    if (peer == rank_) continue;
+    if (peers[peer].host != peers[rank_].host) {
+      transports_[peer].reset(
+          new TcpTransport(fds_[peer], inline_max_bytes_));
+      continue;
+    }
+    // Same host: negotiate a shared-memory lane over the pair's socket so
+    // both sides agree on the outcome — a one-sided fallback (one rank on
+    // shm, the other on TCP) would wedge the pair. The handshake runs even
+    // with shm disabled locally: the peer may have it on, and its status
+    // byte must be consumed either way. Segment names key on the pair's
+    // data-plane ports (unique per process while the job lives) + uid.
+    const bool creator = rank_ < peer;
+    const std::string name =
+        "/hvdtpu_" + std::to_string(getuid()) + "_" +
+        std::to_string(peers[std::min(rank_, peer)].port) + "_" +
+        std::to_string(peers[std::max(rank_, peer)].port);
+    std::unique_ptr<ShmTransport> shm;
+    uint8_t ok = 0, peer_ok = 0;
+    if (creator) {
+      if (shm_enabled_) {
+        shm = ShmTransport::Create(
+            name, shm_ring_bytes_ > 0 ? static_cast<size_t>(shm_ring_bytes_)
+                                      : 0);
+      }
+      ok = shm != nullptr ? 1 : 0;
+      if (SendAll(fds_[peer], &ok, 1) != 0 ||
+          RecvAll(fds_[peer], &peer_ok, 1) != 0) {
+        return Status::Error(StatusCode::ABORTED,
+                             "data plane: shm handshake with rank " +
+                                 std::to_string(peer) + " failed");
+      }
+    } else {
+      if (RecvAll(fds_[peer], &peer_ok, 1) != 0) {
+        return Status::Error(StatusCode::ABORTED,
+                             "data plane: shm handshake with rank " +
+                                 std::to_string(peer) + " failed");
+      }
+      if (peer_ok != 0 && shm_enabled_) {
+        shm = ShmTransport::Open(name, /*timeout_ms=*/10000);
+      }
+      ok = shm != nullptr ? 1 : 0;
+      if (SendAll(fds_[peer], &ok, 1) != 0) {
+        return Status::Error(StatusCode::ABORTED,
+                             "data plane: shm handshake with rank " +
+                                 std::to_string(peer) + " failed");
+      }
+    }
+    if (ok != 0 && peer_ok != 0) {
+      // The opener mmap'ed before acking, so the name can leave the shm
+      // namespace now: an abnormal death past this point leaks nothing.
+      if (creator) shm->Unlink();
+      // A SIGKILLed peer can't flip the shared abort flag; the lane polls
+      // the pair's (otherwise idle) socket for EOF while waiting instead.
+      shm->set_liveness_fd(fds_[peer]);
+      transports_[peer] = std::move(shm);
+    } else {
+      shm.reset();  // creator side aborts + unlinks in the destructor
+      if (shm_enabled_) {
+        fprintf(stderr,
+                "[hvdtpu %d] WARNING: shm transport to same-host rank %d "
+                "unavailable; falling back to TCP\n",
+                rank_, peer);
+      }
+      transports_[peer].reset(
+          new TcpTransport(fds_[peer], inline_max_bytes_));
+    }
+  }
+  // Cache the lane summary: the mix is invariant from here on, and the
+  // timeline tags every executed op with it (no per-op rescan).
+  const int shm = shm_lane_count();
+  const int tcp = size_ - 1 - shm;
+  transport_label_ = shm > 0 && tcp > 0 ? "shm+tcp"
+                     : shm > 0          ? "shm"
+                     : tcp > 0          ? "tcp"
+                                        : "local";
   return Status::OK();
 }
 
+int DataPlane::shm_lane_count() const {
+  int shm = 0;
+  for (const auto& t : transports_) {
+    if (t != nullptr && std::strcmp(t->kind(), "shm") == 0) ++shm;
+  }
+  return shm;
+}
+
 void DataPlane::Shutdown() {
+  // Transports first: the shm lanes flip their shared abort flag and wake
+  // any same-host peer still blocked in a ring op before the name goes.
+  for (auto& t : transports_) t.reset();
   for (int& fd : fds_) {
     CloseFd(fd);
     fd = -1;
@@ -429,49 +547,86 @@ void DataPlane::Shutdown() {
   listen_fd_ = -1;
 }
 
-Status DataPlane::SendRecv(int send_fd, const void* send_buf,
-                           int64_t send_bytes, int recv_fd, void* recv_buf,
-                           int64_t recv_bytes) {
-  // Inline fast path: payloads the kernel socket buffers are known to absorb
-  // (inline_max_bytes_, measured per connection in Connect) are sent
-  // blocking-then-received on the calling thread — both peers sending first
-  // cannot deadlock, and skipping the per-call sender thread is the bulk of
-  // the small-message latency win. Larger payloads always take the
-  // concurrent path; inline_max_bytes_ is 0 until Connect establishes it.
-  if (send_bytes <= inline_max_bytes_ && recv_bytes <= inline_max_bytes_) {
-    int rc = 0;
-    if (send_bytes > 0) {
-      rc = SendAll(send_fd, send_buf, static_cast<size_t>(send_bytes));
-    }
-    if (rc == 0 && recv_bytes > 0) {
-      rc = RecvAll(recv_fd, recv_buf, static_cast<size_t>(recv_bytes));
-    }
-    if (rc != 0) {
-      return Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
+Status DataPlane::Exchange(int send_peer, const void* send_buf,
+                           int64_t send_bytes, int recv_peer, void* recv_buf,
+                           int64_t recv_bytes, int64_t segment_bytes,
+                           const SegmentFn& on_segment) {
+  const Status fail =
+      Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
+  const size_t seg =
+      segment_bytes > 0 ? static_cast<size_t>(segment_bytes) : 0;
+  if (send_peer == recv_peer) {
+    // Same peer: the transport's own full-duplex exchange (interleaved ring
+    // pump for shm; inline/concurrent/segmented socket path for TCP).
+    if (transports_[send_peer]->SendRecv(
+            send_buf, static_cast<size_t>(send_bytes), recv_buf,
+            static_cast<size_t>(recv_bytes), seg, on_segment) != 0) {
+      return fail;
     }
     return Status::OK();
   }
-  // Concurrent send+recv so large payloads can't deadlock on socket buffers.
-  int send_rc = 0;
-  std::thread sender([&] {
-    if (send_bytes > 0) {
-      send_rc = SendAll(send_fd, send_buf, static_cast<size_t>(send_bytes));
+  Transport* ts = transports_[send_peer].get();
+  Transport* tr = transports_[recv_peer].get();
+  auto recv_side = [&]() -> int {
+    if (recv_bytes <= 0) return 0;
+    if (on_segment) {
+      return tr->RecvSegmented(recv_buf, static_cast<size_t>(recv_bytes), seg,
+                               on_segment);
     }
-  });
-  int recv_rc = 0;
-  if (recv_bytes > 0) {
-    recv_rc = RecvAll(recv_fd, recv_buf, static_cast<size_t>(recv_bytes));
+    return tr->Recv(recv_buf, static_cast<size_t>(recv_bytes));
+  };
+  if (send_bytes <= 0 ||
+      ts->InlineSendSafe(static_cast<size_t>(send_bytes))) {
+    // The send completes without peer progress (fits the lane's buffering):
+    // inline send-then-recv skips the per-call sender thread.
+    if (send_bytes > 0 &&
+        ts->Send(send_buf, static_cast<size_t>(send_bytes)) != 0) {
+      return fail;
+    }
+    if (recv_side() != 0) return fail;
+    return Status::OK();
   }
+  int send_rc = 0;
+  std::thread sender(
+      [&] { send_rc = ts->Send(send_buf, static_cast<size_t>(send_bytes)); });
+  int recv_rc = recv_side();
   sender.join();
-  if (send_rc != 0 || recv_rc != 0) {
-    return Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
-  }
+  if (send_rc != 0 || recv_rc != 0) return fail;
   return Status::OK();
 }
+
+namespace {
+
+// Chunk boundaries for a ring over `n` members (chunk c covers
+// [starts[c], starts[c+1])).
+std::vector<int64_t> ChunkStarts(int64_t count, int n) {
+  std::vector<int64_t> starts(n + 1, 0);
+  int64_t base = count / n, rem = count % n;
+  for (int c = 0; c < n; ++c) {
+    starts[c + 1] = starts[c] + base + (c < rem ? 1 : 0);
+  }
+  return starts;
+}
+
+int GroupIndex(const std::vector<int>& group, int rank) {
+  for (size_t i = 0; i < group.size(); ++i) {
+    if (group[i] == rank) return static_cast<int>(i);
+  }
+  return -1;
+}
+
+}  // namespace
 
 Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
                             ReduceOp op) {
   if (size_ == 1 || count == 0) return Status::OK();
+  if (hier_active()) return HierarchicalAllreduce(data, count, dtype, op);
+  return AllreduceGroup(data, count, dtype, op, world_group_);
+}
+
+Status DataPlane::AllreduceGroup(void* data, int64_t count, DataType dtype,
+                                 ReduceOp op, const std::vector<int>& group) {
+  if (group.size() <= 1 || count == 0) return Status::OK();
   AllreduceAlgo algo = algo_;
   if (algo == AllreduceAlgo::AUTO) {
     const int64_t bytes = count * static_cast<int64_t>(DataTypeSize(dtype));
@@ -480,148 +635,175 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   }
   switch (algo) {
     case AllreduceAlgo::RECURSIVE_DOUBLING:
-      return RecursiveDoublingAllreduce(data, count, dtype, op);
+      return RecursiveDoublingGroup(data, count, dtype, op, group);
     case AllreduceAlgo::TREE:
-      return TreeAllreduce(data, count, dtype, op);
+      return TreeAllreduceGroup(data, count, dtype, op, group);
     case AllreduceAlgo::AUTO:
     case AllreduceAlgo::RING:
       break;
   }
-  return RingAllreduce(data, count, dtype, op);
+  return RingAllreduceGroup(data, count, dtype, op, group);
 }
 
-Status DataPlane::RingAllreduce(void* data, int64_t count, DataType dtype,
-                                ReduceOp op) {
-  const size_t elem = DataTypeSize(dtype);
-  uint8_t* buf = static_cast<uint8_t*>(data);
-  const int right = (rank_ + 1) % size_;
-  const int left = (rank_ - 1 + size_) % size_;
-
-  // Chunk boundaries (chunk c covers [starts[c], starts[c+1])).
-  std::vector<int64_t> starts(size_ + 1, 0);
-  int64_t base = count / size_, rem = count % size_;
-  for (int c = 0; c < size_; ++c) {
-    starts[c + 1] = starts[c] + base + (c < rem ? 1 : 0);
-  }
+Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
+                                         const std::vector<int64_t>& starts,
+                                         size_t elem, DataType dtype,
+                                         ReduceOp op,
+                                         const std::vector<int>& group,
+                                         int gi) {
+  const int gs = static_cast<int>(group.size());
+  const int right = group[(gi + 1) % gs];
+  const int left = group[(gi - 1 + gs) % gs];
   auto chunk_ptr = [&](int c) { return buf + starts[c] * elem; };
   auto chunk_count = [&](int c) { return starts[c + 1] - starts[c]; };
-  int64_t max_chunk = base + (rem > 0 ? 1 : 0);
+  int64_t max_chunk = 0;
+  for (int c = 0; c < gs; ++c) max_chunk = std::max(max_chunk, chunk_count(c));
   std::vector<uint8_t> recv_tmp(static_cast<size_t>(max_chunk) * elem);
 
   // Element-aligned pipeline segment.
   int64_t seg = segment_bytes_ - segment_bytes_ % static_cast<int64_t>(elem);
   if (seg <= 0) seg = static_cast<int64_t>(elem);
 
-  // Phase 1: ring reduce-scatter. After step s, chunk (rank - s - 1) holds
-  // the partial sum of s + 2 ranks; after size-1 steps, chunk (rank + 1)
-  // holds the full reduction on this rank... (standard ring schedule: send
-  // chunk (rank - s), receive + reduce chunk (rank - s - 1)). Chunks of two
-  // or more segments stream through SendRecvSegmented so the reduction of
-  // segment k overlaps the transfer of segment k+1.
-  for (int s = 0; s < size_ - 1; ++s) {
-    int send_c = ((rank_ - s) % size_ + size_) % size_;
-    int recv_c = ((rank_ - s - 1) % size_ + size_) % size_;
+  // Ring reduce-scatter. After step s, chunk (gi - s - 1) holds the partial
+  // sum of s + 2 members; after gs-1 steps, chunk (gi + 1) holds the full
+  // reduction on this member (standard ring schedule: send chunk (gi - s),
+  // receive + reduce chunk (gi - s - 1)). Chunks of two or more segments
+  // stream through the segmented exchange so the reduction of segment k
+  // overlaps the transfer of segment k+1.
+  for (int s = 0; s < gs - 1; ++s) {
+    int send_c = ((gi - s) % gs + gs) % gs;
+    int recv_c = ((gi - s - 1) % gs + gs) % gs;
     int64_t send_bytes = chunk_count(send_c) * static_cast<int64_t>(elem);
     int64_t recv_bytes = chunk_count(recv_c) * static_cast<int64_t>(elem);
     if (recv_bytes >= 2 * seg) {
       uint8_t* dst = chunk_ptr(recv_c);
-      if (SendRecvSegmented(
-              fds_[right], chunk_ptr(send_c), static_cast<size_t>(send_bytes),
-              fds_[left], recv_tmp.data(), static_cast<size_t>(recv_bytes),
-              static_cast<size_t>(seg), [&](size_t off, size_t len) {
-                ReduceBuffer(dst + off, recv_tmp.data() + off,
-                             static_cast<int64_t>(len / elem), dtype, op);
-              }) != 0) {
-        return Status::Error(StatusCode::ABORTED,
-                             "data plane: transfer failed");
-      }
+      Status st = Exchange(
+          right, chunk_ptr(send_c), send_bytes, left, recv_tmp.data(),
+          recv_bytes, seg, [&](size_t off, size_t len) {
+            ReduceBuffer(dst + off, recv_tmp.data() + off,
+                         static_cast<int64_t>(len / elem), dtype, op);
+          });
+      if (!st.ok()) return st;
     } else {
-      Status st = SendRecv(fds_[right], chunk_ptr(send_c), send_bytes,
-                           fds_[left], recv_tmp.data(), recv_bytes);
+      Status st = Exchange(right, chunk_ptr(send_c), send_bytes, left,
+                           recv_tmp.data(), recv_bytes);
       if (!st.ok()) return st;
       ReduceBuffer(chunk_ptr(recv_c), recv_tmp.data(), chunk_count(recv_c),
                    dtype, op);
     }
   }
+  return Status::OK();
+}
 
-  // Phase 2: ring allgather of the reduced chunks (already full-duplex; no
+Status DataPlane::RingAllgatherPhase(uint8_t* buf,
+                                     const std::vector<int64_t>& starts,
+                                     size_t elem,
+                                     const std::vector<int>& group, int gi) {
+  const int gs = static_cast<int>(group.size());
+  const int right = group[(gi + 1) % gs];
+  const int left = group[(gi - 1 + gs) % gs];
+  auto chunk_ptr = [&](int c) { return buf + starts[c] * elem; };
+  auto chunk_count = [&](int c) { return starts[c + 1] - starts[c]; };
+  // Ring allgather of the reduced chunks (already full-duplex; no
   // per-segment work to overlap).
-  for (int s = 0; s < size_ - 1; ++s) {
-    int send_c = ((rank_ + 1 - s) % size_ + size_) % size_;
-    int recv_c = ((rank_ - s) % size_ + size_) % size_;
-    Status st = SendRecv(fds_[right], chunk_ptr(send_c),
+  for (int s = 0; s < gs - 1; ++s) {
+    int send_c = ((gi + 1 - s) % gs + gs) % gs;
+    int recv_c = ((gi - s) % gs + gs) % gs;
+    Status st = Exchange(right, chunk_ptr(send_c),
                          chunk_count(send_c) * static_cast<int64_t>(elem),
-                         fds_[left], chunk_ptr(recv_c),
+                         left, chunk_ptr(recv_c),
                          chunk_count(recv_c) * static_cast<int64_t>(elem));
     if (!st.ok()) return st;
   }
   return Status::OK();
 }
 
-Status DataPlane::RecursiveDoublingAllreduce(void* data, int64_t count,
-                                             DataType dtype, ReduceOp op) {
+Status DataPlane::RingAllreduceGroup(void* data, int64_t count, DataType dtype,
+                                     ReduceOp op,
+                                     const std::vector<int>& group) {
+  const size_t elem = DataTypeSize(dtype);
+  uint8_t* buf = static_cast<uint8_t*>(data);
+  const int gi = GroupIndex(group, rank_);
+  std::vector<int64_t> starts =
+      ChunkStarts(count, static_cast<int>(group.size()));
+  Status st = RingReduceScatterPhase(buf, starts, elem, dtype, op, group, gi);
+  if (!st.ok()) return st;
+  return RingAllgatherPhase(buf, starts, elem, group, gi);
+}
+
+Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
+                                         DataType dtype, ReduceOp op,
+                                         const std::vector<int>& group) {
   const size_t elem = DataTypeSize(dtype);
   const int64_t bytes = count * static_cast<int64_t>(elem);
+  const int gs = static_cast<int>(group.size());
+  const int gi = GroupIndex(group, rank_);
   std::vector<uint8_t> other(static_cast<size_t>(bytes));
 
-  // Largest power-of-two subgroup; the r extra ranks fold into their partner
-  // first and receive the result last (same shape as AdasumAllreduce).
+  // Largest power-of-two subgroup; the r extra members fold into their
+  // partner first and receive the result last (same shape as Adasum).
   int p = 1;
-  while (p * 2 <= size_) p *= 2;
-  const int r = size_ - p;
+  while (p * 2 <= gs) p *= 2;
+  const int r = gs - p;
 
-  if (rank_ >= p) {
-    if (SendAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+  if (gi >= p) {
+    if (transports_[group[gi - p]]->Send(data, static_cast<size_t>(bytes)) !=
+        0) {
       return Status::Error(StatusCode::ABORTED, "rd fold send failed");
     }
-  } else if (rank_ < r) {
-    if (RecvAll(fds_[rank_ + p], other.data(), static_cast<size_t>(bytes)) !=
-        0) {
+  } else if (gi < r) {
+    if (transports_[group[gi + p]]->Recv(other.data(),
+                                         static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "rd fold recv failed");
     }
     ReduceBuffer(data, other.data(), count, dtype, op);
   }
 
-  if (rank_ < p) {
+  if (gi < p) {
     for (int distance = 1; distance < p; distance *= 2) {
-      int peer = rank_ ^ distance;
-      Status st =
-          SendRecv(fds_[peer], data, bytes, fds_[peer], other.data(), bytes);
+      int peer = group[gi ^ distance];
+      Status st = Exchange(peer, data, bytes, peer, other.data(), bytes);
       if (!st.ok()) return st;
       ReduceBuffer(data, other.data(), count, dtype, op);
     }
   }
 
-  if (rank_ < r) {
-    if (SendAll(fds_[rank_ + p], data, static_cast<size_t>(bytes)) != 0) {
+  if (gi < r) {
+    if (transports_[group[gi + p]]->Send(data, static_cast<size_t>(bytes)) !=
+        0) {
       return Status::Error(StatusCode::ABORTED, "rd unfold send failed");
     }
-  } else if (rank_ >= p) {
-    if (RecvAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+  } else if (gi >= p) {
+    if (transports_[group[gi - p]]->Recv(data, static_cast<size_t>(bytes)) !=
+        0) {
       return Status::Error(StatusCode::ABORTED, "rd unfold recv failed");
     }
   }
   return Status::OK();
 }
 
-Status DataPlane::TreeAllreduce(void* data, int64_t count, DataType dtype,
-                                ReduceOp op) {
+Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
+                                     ReduceOp op,
+                                     const std::vector<int>& group) {
   const size_t elem = DataTypeSize(dtype);
   const int64_t bytes = count * static_cast<int64_t>(elem);
+  const int gs = static_cast<int>(group.size());
+  const int gi = GroupIndex(group, rank_);
   std::vector<uint8_t> other(static_cast<size_t>(bytes));
 
-  // Binomial reduce toward rank 0: at distance d, ranks with bit d set send
-  // up and leave; the rest absorb a child (if present) and continue.
-  for (int d = 1; d < size_; d <<= 1) {
-    if (rank_ & d) {
-      if (SendAll(fds_[rank_ - d], data, static_cast<size_t>(bytes)) != 0) {
+  // Binomial reduce toward member 0: at distance d, members with bit d set
+  // send up and leave; the rest absorb a child (if present) and continue.
+  for (int d = 1; d < gs; d <<= 1) {
+    if (gi & d) {
+      if (transports_[group[gi - d]]->Send(data, static_cast<size_t>(bytes)) !=
+          0) {
         return Status::Error(StatusCode::ABORTED, "tree reduce send failed");
       }
       break;
     }
-    if (rank_ + d < size_) {
-      if (RecvAll(fds_[rank_ + d], other.data(), static_cast<size_t>(bytes)) !=
-          0) {
+    if (gi + d < gs) {
+      if (transports_[group[gi + d]]->Recv(other.data(),
+                                           static_cast<size_t>(bytes)) != 0) {
         return Status::Error(StatusCode::ABORTED, "tree reduce recv failed");
       }
       ReduceBuffer(data, other.data(), count, dtype, op);
@@ -632,19 +814,104 @@ Status DataPlane::TreeAllreduce(void* data, int64_t count, DataType dtype,
   // to children in decreasing-distance order — each edge is one-directional,
   // so plain blocking sends cannot deadlock).
   int top = 1;
-  while (top < size_) top <<= 1;
-  int lsb = rank_ == 0 ? top : (rank_ & -rank_);
-  if (rank_ != 0) {
-    if (RecvAll(fds_[rank_ - lsb], data, static_cast<size_t>(bytes)) != 0) {
+  while (top < gs) top <<= 1;
+  int lsb = gi == 0 ? top : (gi & -gi);
+  if (gi != 0) {
+    if (transports_[group[gi - lsb]]->Recv(data, static_cast<size_t>(bytes)) !=
+        0) {
       return Status::Error(StatusCode::ABORTED, "tree bcast recv failed");
     }
   }
   for (int d = lsb >> 1; d >= 1; d >>= 1) {
-    if (rank_ + d < size_) {
-      if (SendAll(fds_[rank_ + d], data, static_cast<size_t>(bytes)) != 0) {
+    if (gi + d < gs) {
+      if (transports_[group[gi + d]]->Send(data, static_cast<size_t>(bytes)) !=
+          0) {
         return Status::Error(StatusCode::ABORTED, "tree bcast send failed");
       }
     }
+  }
+  return Status::OK();
+}
+
+Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
+                                        DataType dtype, ReduceOp op) {
+  // Two-level allreduce (reference analog: Horovod's hierarchical NCCL+MPI
+  // path, with the fork's SHM lanes carrying the intra-node stages):
+  //   1. intra-host ring reduce-scatter over the (shm) local lanes — the
+  //      reduction compute parallelizes across the host's ranks;
+  //   2. reduced chunks gather to the host leader (lowest local rank);
+  //   3. leaders run the flat ring/recursive-doubling over TCP;
+  //   4. chunks scatter back from the leader;
+  //   5. intra-host ring allgather completes every member's vector.
+  // With a single host, stages 2-4 vanish and this is the all-shm ring.
+  const std::vector<int>& local = local_group_;
+  const int L = static_cast<int>(local.size());
+  const int li = GroupIndex(local, rank_);
+  const size_t elem = DataTypeSize(dtype);
+  uint8_t* buf = static_cast<uint8_t*>(data);
+  const bool cross = leaders_.size() > 1;
+  const Status fail =
+      Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
+
+  std::vector<int64_t> starts = ChunkStarts(count, L);
+  auto chunk_ptr = [&](int c) { return buf + starts[c] * elem; };
+  auto chunk_bytes = [&](int c) {
+    return (starts[c + 1] - starts[c]) * static_cast<int64_t>(elem);
+  };
+  // Chunk owned by local member j after the reduce-scatter phase.
+  auto owned = [&](int j) { return (j + 1) % L; };
+
+  if (L > 1) {
+    Status st = RingReduceScatterPhase(buf, starts, elem, dtype, op, local, li);
+    if (!st.ok()) return st;
+  }
+  if (cross) {
+    if (L > 1) {
+      if (li == 0) {
+        for (int j = 1; j < L; ++j) {
+          int c = owned(j);
+          if (chunk_bytes(c) > 0 &&
+              transports_[local[j]]->Recv(
+                  chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
+            return fail;
+          }
+        }
+      } else {
+        int c = owned(li);
+        if (chunk_bytes(c) > 0 &&
+            transports_[local[0]]->Send(
+                chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
+          return fail;
+        }
+      }
+    }
+    if (li == 0) {
+      Status st = AllreduceGroup(data, count, dtype, op, leaders_);
+      if (!st.ok()) return st;
+    }
+    if (L > 1) {
+      if (li == 0) {
+        for (int j = 1; j < L; ++j) {
+          int c = owned(j);
+          if (chunk_bytes(c) > 0 &&
+              transports_[local[j]]->Send(
+                  chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
+            return fail;
+          }
+        }
+      } else {
+        int c = owned(li);
+        if (chunk_bytes(c) > 0 &&
+            transports_[local[0]]->Recv(
+                chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
+          return fail;
+        }
+      }
+    }
+  }
+  if (L > 1) {
+    Status st = RingAllgatherPhase(buf, starts, elem, local, li);
+    if (!st.ok()) return st;
   }
   return Status::OK();
 }
@@ -662,7 +929,7 @@ Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
   for (int k = 1; k < size_; ++k) {
     int to = (rank_ + k) % size_;
     int from = (rank_ - k + size_) % size_;
-    Status st = SendRecv(fds_[to], in, in_bytes, fds_[from],
+    Status st = Exchange(to, in, in_bytes, from,
                          out->data() + offsets[from], block_bytes[from]);
     if (!st.ok()) return st;
   }
@@ -674,12 +941,12 @@ Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
   if (rank_ == root) {
     for (int r = 0; r < size_; ++r) {
       if (r == rank_) continue;
-      if (SendAll(fds_[r], data, static_cast<size_t>(bytes)) != 0) {
+      if (transports_[r]->Send(data, static_cast<size_t>(bytes)) != 0) {
         return Status::Error(StatusCode::ABORTED, "broadcast send failed");
       }
     }
   } else {
-    if (RecvAll(fds_[root], data, static_cast<size_t>(bytes)) != 0) {
+    if (transports_[root]->Recv(data, static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "broadcast recv failed");
     }
   }
@@ -702,9 +969,8 @@ Status DataPlane::Alltoallv(const void* in,
   for (int k = 1; k < size_; ++k) {
     int to = (rank_ + k) % size_;
     int from = (rank_ - k + size_) % size_;
-    Status st = SendRecv(fds_[to], src + send_off[to], send_bytes[to],
-                         fds_[from], out->data() + recv_off[from],
-                         recv_bytes[from]);
+    Status st = Exchange(to, src + send_off[to], send_bytes[to], from,
+                         out->data() + recv_off[from], recv_bytes[from]);
     if (!st.ok()) return st;
   }
   return Status::OK();
@@ -755,7 +1021,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   const int r = size_ - p;
 
   auto exchange = [&](int peer) -> Status {
-    return SendRecv(fds_[peer], data, bytes, fds_[peer], other.data(), bytes);
+    return Exchange(peer, data, bytes, peer, other.data(), bytes);
   };
   auto combine = [&](bool lower) {
     if (dtype == DataType::FLOAT32) {
@@ -770,12 +1036,12 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
 
   // Fold extra ranks (>= p) into their partner by plain addition.
   if (rank_ >= p) {
-    if (SendAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+    if (transports_[rank_ - p]->Send(data, static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "adasum fold send failed");
     }
   } else if (rank_ < r) {
-    if (RecvAll(fds_[rank_ + p], other.data(), static_cast<size_t>(bytes)) !=
-        0) {
+    if (transports_[rank_ + p]->Recv(other.data(),
+                                     static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "adasum fold recv failed");
     }
     if (dtype == DataType::FLOAT32) {
@@ -798,11 +1064,11 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
 
   // Broadcast the result to the folded ranks.
   if (rank_ < r) {
-    if (SendAll(fds_[rank_ + p], data, static_cast<size_t>(bytes)) != 0) {
+    if (transports_[rank_ + p]->Send(data, static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "adasum unfold send failed");
     }
   } else if (rank_ >= p) {
-    if (RecvAll(fds_[rank_ - p], data, static_cast<size_t>(bytes)) != 0) {
+    if (transports_[rank_ - p]->Recv(data, static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "adasum unfold recv failed");
     }
   }

@@ -1,17 +1,22 @@
-// Host data plane: TCP mesh between ranks + collective algorithms.
+// Host data plane: per-pair transport lanes (TCP mesh / shared memory) +
+// collective algorithms.
 //
 // Fills the role of the reference's Gloo/MPI CPU data plane
 // (horovod/common/ops/gloo_operations.cc, mpi_operations.cc): ring allreduce
 // (reduce-scatter + allgather, like MPI/NCCL ring), rotation-based allgatherv,
-// direct-send broadcast, and pairwise alltoallv — over plain TCP, no MPI.
-// fp16/bf16 are accumulated in float (reference: half.{h,cc}).
+// direct-send broadcast, and pairwise alltoallv — over pluggable transports
+// (transport.h): plain TCP between hosts, POSIX shared-memory rings
+// (shm_transport.h) between ranks sharing one. fp16/bf16 are accumulated in
+// float (reference: half.{h,cc}).
 #pragma once
 
 #include <cstdint>
+#include <memory>
 #include <string>
 #include <vector>
 
 #include "common.h"
+#include "transport.h"
 
 namespace hvdtpu {
 
@@ -41,6 +46,16 @@ constexpr int64_t kDefaultAlgoCrossoverBytes = 32 * 1024;
 // Default ring pipeline segment: each ring chunk is streamed in segments of
 // this size so reduction of segment k overlaps the transfer of segment k+1.
 constexpr int64_t kDefaultSegmentBytes = 1 << 20;
+
+// Hierarchical two-level allreduce (HVDTPU_ALLREDUCE_HIER / hvdrun --hier):
+// intra-host ring reduce-scatter/allgather over the (shm) local lanes, one
+// leader per host running the flat ring/recursive-doubling over TCP.
+// AUTO leaves the on/off choice to the Bayesian autotuner.
+enum class HierMode : int32_t {
+  OFF = 0,
+  ON = 1,
+  AUTO = 2,
+};
 
 class DataPlane {
  public:
@@ -73,6 +88,33 @@ class DataPlane {
   int64_t crossover_bytes() const { return crossover_bytes_; }
   int64_t segment_bytes() const { return segment_bytes_; }
 
+  // Transport / topology knobs. set_shm_enabled and set_shm_ring_bytes must
+  // be called before Connect (the lanes are negotiated there); hier mode may
+  // change any time from the collective-driving thread, and set_hier_auto is
+  // the autotuner's choice under HierMode::AUTO.
+  void set_shm_enabled(bool on) { shm_enabled_ = on; }
+  void set_shm_ring_bytes(int64_t b) { if (b > 0) shm_ring_bytes_ = b; }
+  void set_hier_mode(HierMode m) { hier_mode_ = m; }
+  void set_hier_auto(bool on) { hier_auto_ = on; }
+  HierMode hier_mode() const { return hier_mode_; }
+  // True when Allreduce will take the two-level path: hier requested (or
+  // autotuned on) and at least one host holds 2+ ranks. The predicate must
+  // be identical on EVERY rank (it's a world-level property — leaders_ and
+  // size_ agree everywhere), or ranks would split between the flat and
+  // hierarchical schedules and deadlock.
+  bool hier_active() const {
+    if (size_ <= 1 || leaders_.size() >= static_cast<size_t>(size_)) {
+      return false;  // every host single-rank: hier degenerates to flat
+    }
+    return hier_mode_ == HierMode::ON ||
+           (hier_mode_ == HierMode::AUTO && hier_auto_);
+  }
+  // Lane summary for the timeline / introspection: "tcp", "shm", "shm+tcp"
+  // ("local" before Connect / at size 1). Cached by SetupTransports.
+  const std::string& transport_label() const { return transport_label_; }
+  int shm_lane_count() const;  // peers reached over shared memory
+  int num_hosts() const { return static_cast<int>(leaders_.size()); }
+
   // Gather variable-length byte blocks from every rank; out = concatenated in
   // rank order. block_bytes[r] gives each rank's contribution size.
   Status Allgatherv(const void* in, int64_t in_bytes,
@@ -99,34 +141,74 @@ class DataPlane {
   Status AdasumAllreduce(void* data, int64_t count, DataType dtype);
 
  private:
-  Status SendRecv(int send_fd, const void* send_buf, int64_t send_bytes,
-                  int recv_fd, void* recv_buf, int64_t recv_bytes);
+  // Send to one peer while receiving from another (possibly the same), with
+  // optional segment callbacks on the receive side. The building block every
+  // algorithm rides; routes through the per-peer transports.
+  Status Exchange(int send_peer, const void* send_buf, int64_t send_bytes,
+                  int recv_peer, void* recv_buf, int64_t recv_bytes,
+                  int64_t segment_bytes = 0,
+                  const SegmentFn& on_segment = nullptr);
 
+  // Negotiate the per-pair lane (shm for same-host peers when both sides
+  // set it up, TCP otherwise) over the freshly established socket mesh.
+  Status SetupTransports(const std::vector<PeerAddr>& peers);
+
+  // All algorithms run over an arbitrary ordered rank group so the flat
+  // path (group = the whole world) and the hierarchical leader/local phases
+  // share one implementation.
+  Status AllreduceGroup(void* data, int64_t count, DataType dtype,
+                        ReduceOp op, const std::vector<int>& group);
   // Bandwidth path: ring reduce-scatter + allgather; each reduce-scatter
   // step streams the incoming chunk in segments so ReduceBuffer of segment
-  // k overlaps the socket transfer of segment k+1 (socket_util
-  // SendRecvSegmented).
-  Status RingAllreduce(void* data, int64_t count, DataType dtype,
-                       ReduceOp op);
+  // k overlaps the transfer of segment k+1.
+  Status RingAllreduceGroup(void* data, int64_t count, DataType dtype,
+                            ReduceOp op, const std::vector<int>& group);
   // Latency path: log2(p) full-message pairwise exchanges; non-power-of-two
-  // worlds fold the extra ranks in by reduction first (like Adasum).
-  Status RecursiveDoublingAllreduce(void* data, int64_t count, DataType dtype,
-                                    ReduceOp op);
+  // groups fold the extra ranks in by reduction first (like Adasum).
+  Status RecursiveDoublingGroup(void* data, int64_t count, DataType dtype,
+                                ReduceOp op, const std::vector<int>& group);
   // Binomial reduce-to-0 + binomial broadcast (reference fork's tree menu
   // entry; half the exchange volume of recursive doubling, twice the depth).
-  Status TreeAllreduce(void* data, int64_t count, DataType dtype,
-                       ReduceOp op);
+  Status TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
+                            ReduceOp op, const std::vector<int>& group);
+
+  // Ring phases over a group (shared by RingAllreduceGroup and the
+  // hierarchical intra-host stages). After the reduce-scatter, group member
+  // gi owns chunk (gi+1) % group_size fully reduced.
+  Status RingReduceScatterPhase(uint8_t* buf, const std::vector<int64_t>& starts,
+                                size_t elem, DataType dtype, ReduceOp op,
+                                const std::vector<int>& group, int gi);
+  Status RingAllgatherPhase(uint8_t* buf, const std::vector<int64_t>& starts,
+                            size_t elem, const std::vector<int>& group,
+                            int gi);
+
+  // Two-level path: intra-host ring reduce-scatter -> chunks gathered to the
+  // host leader -> leaders run the flat algorithm over TCP -> chunks
+  // scattered back -> intra-host ring allgather.
+  Status HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
+                               ReduceOp op);
 
   int rank_;
   int size_;
   int listen_fd_ = -1;
   int port_ = 0;
-  std::vector<int> fds_;  // per-peer connection; -1 for self
+  std::vector<int> fds_;  // per-peer socket; -1 for self (owned here)
+  std::vector<std::unique_ptr<Transport>> transports_;  // per-peer lane
+
+  // Host topology, derived from the peer table in Connect().
+  std::vector<int> world_group_;  // 0..size-1
+  std::vector<int> local_group_;  // ranks sharing my host (sorted)
+  std::vector<int> leaders_;      // lowest rank per host (sorted)
 
   AllreduceAlgo algo_ = AllreduceAlgo::AUTO;
   int64_t crossover_bytes_ = kDefaultAlgoCrossoverBytes;
   int64_t segment_bytes_ = kDefaultSegmentBytes;
-  // Largest payload SendRecv may exchange inline (blocking send, then recv)
+  bool shm_enabled_ = true;
+  int64_t shm_ring_bytes_ = 0;  // 0 = shm_transport.h kDefaultShmRingBytes
+  std::string transport_label_ = "local";
+  HierMode hier_mode_ = HierMode::AUTO;
+  bool hier_auto_ = false;
+  // Largest payload a TCP lane may send inline (blocking send, then recv)
   // without a deadlock risk; measured against the mesh's socket buffer
   // sizes in Connect(). 0 (pre-Connect) = always use the concurrent path.
   int64_t inline_max_bytes_ = 0;

@@ -1,0 +1,375 @@
+#include "shm_transport.h"
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <poll.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <climits>
+#include <cstring>
+#include <new>
+#include <thread>
+
+namespace hvdtpu {
+
+namespace {
+
+// Shared (cross-process) futex wait/wake. The protocol never RELIES on wake
+// delivery — every wait carries a timeout and re-checks the ring cursors —
+// so futex here is purely a power/latency optimization over spinning.
+int FutexWait(std::atomic<uint32_t>* addr, uint32_t expected, int timeout_ms) {
+  timespec ts{timeout_ms / 1000, (timeout_ms % 1000) * 1000000L};
+  return static_cast<int>(
+      syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAIT,
+              expected, &ts, nullptr, 0));
+}
+
+void FutexWake(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE, INT_MAX,
+          nullptr, nullptr, 0);
+}
+
+constexpr uint32_t kMagic = 0x48565453u;  // "HVTS"
+constexpr int kSpinIters = 4096;
+constexpr int kWaitSliceMs = 100;
+
+}  // namespace
+
+// Single-producer/single-consumer byte ring. head/tail are free-running
+// byte cursors (never wrapped); the data offset is cursor % ring_bytes.
+// The producer's release-store of head (and the consumer's acquire-load)
+// carries the happens-before for the bytes it covers; symmetrically tail
+// hands regions back to the producer for reuse.
+struct alignas(64) ShmRing {
+  std::atomic<uint64_t> head;       // producer cursor
+  char pad0[64 - sizeof(std::atomic<uint64_t>)];
+  std::atomic<uint64_t> tail;       // consumer cursor
+  char pad1[64 - sizeof(std::atomic<uint64_t>)];
+  std::atomic<uint32_t> head_seq;   // futex word: bumped on head advance
+  std::atomic<uint32_t> head_waiters;
+  char pad2[64 - 2 * sizeof(std::atomic<uint32_t>)];
+  std::atomic<uint32_t> tail_seq;   // futex word: bumped on tail advance
+  std::atomic<uint32_t> tail_waiters;
+  char pad3[64 - 2 * sizeof(std::atomic<uint32_t>)];
+};
+
+struct ShmTransport::Segment {
+  uint32_t magic;
+  std::atomic<uint32_t> ready;    // creator sets once initialized
+  std::atomic<uint32_t> aborted;  // either side sets on shutdown/error
+  uint32_t reserved;
+  uint64_t ring_bytes;
+  ShmRing rings[2];  // [0]: creator -> opener, [1]: opener -> creator
+  // Data areas follow: ring 0 bytes, then ring 1 bytes.
+  uint8_t* data(int ring) {
+    return reinterpret_cast<uint8_t*>(this + 1) +
+           static_cast<size_t>(ring) * ring_bytes;
+  }
+};
+
+ShmTransport::ShmTransport(std::string name, Segment* seg, size_t map_bytes,
+                           bool creator)
+    : name_(std::move(name)),
+      seg_(seg),
+      map_bytes_(map_bytes),
+      ring_bytes_(seg->ring_bytes),
+      creator_(creator),
+      out_ring_(creator ? 0 : 1) {
+  out_data_ = seg_->data(out_ring_);
+  in_data_ = seg_->data(1 - out_ring_);
+}
+
+std::unique_ptr<ShmTransport> ShmTransport::Create(const std::string& name,
+                                                   size_t ring_bytes) {
+  if (ring_bytes == 0) ring_bytes = kDefaultShmRingBytes;
+  int fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0 && errno == EEXIST) {
+    // Stale segment from a crashed prior job that happened to reuse our
+    // ports: reclaim the name.
+    shm_unlink(name.c_str());
+    fd = shm_open(name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  }
+  if (fd < 0) return nullptr;
+  size_t map_bytes = sizeof(Segment) + 2 * ring_bytes;
+  if (ftruncate(fd, static_cast<off_t>(map_bytes)) != 0) {
+    close(fd);
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name.c_str());
+    return nullptr;
+  }
+  auto* seg = new (mem) Segment();
+  for (ShmRing& r : seg->rings) {
+    r.head.store(0, std::memory_order_relaxed);
+    r.tail.store(0, std::memory_order_relaxed);
+    r.head_seq.store(0, std::memory_order_relaxed);
+    r.head_waiters.store(0, std::memory_order_relaxed);
+    r.tail_seq.store(0, std::memory_order_relaxed);
+    r.tail_waiters.store(0, std::memory_order_relaxed);
+  }
+  seg->aborted.store(0, std::memory_order_relaxed);
+  seg->ring_bytes = ring_bytes;
+  seg->magic = kMagic;
+  seg->ready.store(1, std::memory_order_release);
+  return std::unique_ptr<ShmTransport>(
+      new ShmTransport(name, seg, map_bytes, /*creator=*/true));
+}
+
+std::unique_ptr<ShmTransport> ShmTransport::Open(const std::string& name,
+                                                 int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    int fd = shm_open(name.c_str(), O_RDWR, 0600);
+    if (fd >= 0) {
+      struct stat st;
+      if (fstat(fd, &st) == 0 &&
+          st.st_size >= static_cast<off_t>(sizeof(Segment))) {
+        size_t map_bytes = static_cast<size_t>(st.st_size);
+        void* mem = mmap(nullptr, map_bytes, PROT_READ | PROT_WRITE,
+                         MAP_SHARED, fd, 0);
+        close(fd);
+        if (mem == MAP_FAILED) return nullptr;
+        auto* seg = static_cast<Segment*>(mem);
+        while (!(seg->magic == kMagic &&
+                 seg->ready.load(std::memory_order_acquire) == 1)) {
+          if (std::chrono::steady_clock::now() >= deadline) {
+            munmap(mem, map_bytes);
+            return nullptr;
+          }
+          std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        }
+        if (sizeof(Segment) + 2 * seg->ring_bytes > map_bytes) {
+          munmap(mem, map_bytes);
+          return nullptr;
+        }
+        return std::unique_ptr<ShmTransport>(
+            new ShmTransport(name, seg, map_bytes, /*creator=*/false));
+      }
+      close(fd);
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+ShmTransport::~ShmTransport() {
+  if (seg_ != nullptr) {
+    Abort();  // release any peer still blocked on our rings
+    if (creator_) Unlink();
+    munmap(seg_, map_bytes_);
+    seg_ = nullptr;
+  }
+}
+
+void ShmTransport::Abort() {
+  if (seg_ == nullptr) return;
+  seg_->aborted.store(1, std::memory_order_release);
+  for (ShmRing& r : seg_->rings) {
+    r.head_seq.fetch_add(1, std::memory_order_release);
+    r.tail_seq.fetch_add(1, std::memory_order_release);
+    FutexWake(&r.head_seq);
+    FutexWake(&r.tail_seq);
+  }
+}
+
+void ShmTransport::Unlink() {
+  if (!unlinked_) {
+    unlinked_ = true;
+    shm_unlink(name_.c_str());  // ENOENT is fine (already gone)
+  }
+}
+
+size_t ShmTransport::TrySend(const uint8_t* buf, size_t len) {
+  ShmRing& r = seg_->rings[out_ring_];
+  uint64_t head = r.head.load(std::memory_order_relaxed);  // sole producer
+  uint64_t tail = r.tail.load(std::memory_order_acquire);
+  size_t free_space = ring_bytes_ - static_cast<size_t>(head - tail);
+  if (free_space == 0) return 0;
+  size_t off = static_cast<size_t>(head % ring_bytes_);
+  size_t chunk = std::min({free_space, len, ring_bytes_ - off});
+  memcpy(out_data_ + off, buf, chunk);
+  r.head.store(head + chunk, std::memory_order_release);
+  r.head_seq.fetch_add(1, std::memory_order_seq_cst);
+  if (r.head_waiters.load(std::memory_order_seq_cst) != 0) {
+    FutexWake(&r.head_seq);
+  }
+  return chunk;
+}
+
+size_t ShmTransport::TryRecv(uint8_t* buf, size_t len) {
+  ShmRing& r = seg_->rings[1 - out_ring_];
+  uint64_t tail = r.tail.load(std::memory_order_relaxed);  // sole consumer
+  uint64_t head = r.head.load(std::memory_order_acquire);
+  size_t avail = static_cast<size_t>(head - tail);
+  if (avail == 0) return 0;
+  size_t off = static_cast<size_t>(tail % ring_bytes_);
+  size_t chunk = std::min({avail, len, ring_bytes_ - off});
+  memcpy(buf, in_data_ + off, chunk);
+  r.tail.store(tail + chunk, std::memory_order_release);
+  r.tail_seq.fetch_add(1, std::memory_order_seq_cst);
+  if (r.tail_waiters.load(std::memory_order_seq_cst) != 0) {
+    FutexWake(&r.tail_seq);
+  }
+  return chunk;
+}
+
+bool ShmTransport::PeerDead() {
+  if (liveness_fd_ < 0) return false;
+  pollfd pfd{liveness_fd_, POLLIN, 0};
+  if (poll(&pfd, 1, 0) <= 0) return false;
+  if ((pfd.revents & (POLLERR | POLLHUP | POLLNVAL)) == 0) {
+    // POLLIN on an idle pair socket: EOF or stray bytes — peek to decide.
+    char b;
+    ssize_t n = recv(liveness_fd_, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+    if (n > 0 || (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))) {
+      return false;
+    }
+  }
+  Abort();  // wake our own other-direction waiters too
+  return true;
+}
+
+void ShmTransport::WaitOutboundSpace() {
+  ShmRing& r = seg_->rings[out_ring_];
+  uint64_t head = r.head.load(std::memory_order_relaxed);
+  for (int i = 0; i < kSpinIters; ++i) {
+    if (r.tail.load(std::memory_order_acquire) + ring_bytes_ != head ||
+        seg_->aborted.load(std::memory_order_acquire) != 0) {
+      return;
+    }
+  }
+  if (PeerDead()) return;
+  uint32_t seq = r.tail_seq.load(std::memory_order_seq_cst);
+  r.tail_waiters.fetch_add(1, std::memory_order_seq_cst);
+  if (r.tail.load(std::memory_order_seq_cst) + ring_bytes_ == head &&
+      seg_->aborted.load(std::memory_order_acquire) == 0) {
+    FutexWait(&r.tail_seq, seq, kWaitSliceMs);
+  }
+  r.tail_waiters.fetch_sub(1, std::memory_order_seq_cst);
+}
+
+void ShmTransport::WaitInboundData() {
+  ShmRing& r = seg_->rings[1 - out_ring_];
+  uint64_t tail = r.tail.load(std::memory_order_relaxed);
+  for (int i = 0; i < kSpinIters; ++i) {
+    if (r.head.load(std::memory_order_acquire) != tail ||
+        seg_->aborted.load(std::memory_order_acquire) != 0) {
+      return;
+    }
+  }
+  if (PeerDead()) return;
+  uint32_t seq = r.head_seq.load(std::memory_order_seq_cst);
+  r.head_waiters.fetch_add(1, std::memory_order_seq_cst);
+  if (r.head.load(std::memory_order_seq_cst) == tail &&
+      seg_->aborted.load(std::memory_order_acquire) == 0) {
+    FutexWait(&r.head_seq, seq, kWaitSliceMs);
+  }
+  r.head_waiters.fetch_sub(1, std::memory_order_seq_cst);
+}
+
+int ShmTransport::Send(const void* buf, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  size_t done = 0;
+  while (done < len) {
+    if (seg_->aborted.load(std::memory_order_acquire) != 0) return -1;
+    size_t n = TrySend(p + done, len - done);
+    if (n == 0) {
+      WaitOutboundSpace();
+    } else {
+      done += n;
+    }
+  }
+  return 0;
+}
+
+int ShmTransport::Recv(void* buf, size_t len) {
+  return RecvSegmented(buf, len, 0, nullptr);
+}
+
+int ShmTransport::RecvSegmented(void* buf, size_t len, size_t segment_bytes,
+                                const SegmentFn& on_segment) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  if (segment_bytes == 0 || segment_bytes > len) segment_bytes = len;
+  size_t done = 0, cb_done = 0;
+  while (done < len) {
+    if (seg_->aborted.load(std::memory_order_acquire) != 0) return -1;
+    size_t n = TryRecv(p + done, len - done);
+    if (n == 0) {
+      WaitInboundData();
+      continue;
+    }
+    done += n;
+    // Fire full segments as they complete; the producer keeps filling the
+    // ring while the callback (reduction) runs — the overlap is inherent.
+    while (on_segment && done - cb_done >= segment_bytes && cb_done < len) {
+      size_t seg_len = std::min(segment_bytes, len - cb_done);
+      on_segment(cb_done, seg_len);
+      cb_done += seg_len;
+    }
+  }
+  if (on_segment && cb_done < len) on_segment(cb_done, len - cb_done);
+  return 0;
+}
+
+int ShmTransport::SendRecv(const void* send_buf, size_t send_bytes,
+                           void* recv_buf, size_t recv_bytes,
+                           size_t segment_bytes, const SegmentFn& on_segment) {
+  const uint8_t* sp = static_cast<const uint8_t*>(send_buf);
+  uint8_t* rp = static_cast<uint8_t*>(recv_buf);
+  if (segment_bytes == 0 || segment_bytes > recv_bytes) {
+    segment_bytes = recv_bytes;
+  }
+  size_t sent = 0, rcvd = 0, cb_done = 0;
+  while (sent < send_bytes || rcvd < recv_bytes) {
+    if (seg_->aborted.load(std::memory_order_acquire) != 0) return -1;
+    bool progress = false;
+    if (sent < send_bytes) {
+      size_t n = TrySend(sp + sent, send_bytes - sent);
+      sent += n;
+      progress |= n != 0;
+    }
+    if (rcvd < recv_bytes) {
+      size_t n = TryRecv(rp + rcvd, recv_bytes - rcvd);
+      rcvd += n;
+      progress |= n != 0;
+    }
+    while (on_segment && rcvd - cb_done >= segment_bytes &&
+           cb_done < recv_bytes) {
+      size_t seg_len = std::min(segment_bytes, recv_bytes - cb_done);
+      on_segment(cb_done, seg_len);
+      cb_done += seg_len;
+      progress = true;
+    }
+    if (!progress) {
+      // Both directions stuck: park on whichever cursor unblocks us
+      // (inbound data if we still expect bytes, else outbound space). The
+      // peer's pump advances the other direction independently.
+      if (rcvd < recv_bytes) {
+        WaitInboundData();
+      } else {
+        WaitOutboundSpace();
+      }
+    }
+  }
+  if (on_segment && cb_done < recv_bytes) {
+    on_segment(cb_done, recv_bytes - cb_done);
+  }
+  return 0;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,106 @@
+// POSIX shared-memory transport: per-pair shm_open+mmap ring buffers with
+// futex doorbells, used automatically for ranks sharing a host.
+//
+// One segment per rank pair, created by the lower rank, holding two
+// single-producer/single-consumer byte rings (lower->higher and
+// higher->lower). Each ring is lock-free within the segment: the producer
+// owns the head cursor, the consumer the tail, and cross-process wakeups
+// ride shared (non-private) futex words with a timeout fallback so a lost
+// wake can only cost milliseconds, never a hang. Abort (worker shutdown or
+// world break) flips a shared flag and wakes both sides; every blocked ring
+// op observes it and fails over instead of spinning on a dead peer.
+//
+// Reference analog: the fork's CUDA-IPC shared-memory communicator
+// (horovod/common/ops/compressed/ SHM path) — here host memory instead of
+// device memory, POSIX shm instead of cudaIpc handles.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+
+#include "transport.h"
+
+namespace hvdtpu {
+
+// Per-direction ring capacity. Must absorb one full in-flight collective
+// chunk for the inline (no sender thread) fast path to engage; tunable via
+// HVDTPU_SHM_RING_BYTES.
+constexpr int64_t kDefaultShmRingBytes = 1 << 20;
+
+class ShmTransport : public Transport {
+ public:
+  // Creator (lower rank) allocates and initializes the segment; the opener
+  // maps it. `name` must match on both sides and be unique per pair per job
+  // (DataPlane derives it from the pair's data-plane ports). Both return
+  // null on failure — the caller falls back to TCP after the socket
+  // handshake confirms the peer agrees.
+  static std::unique_ptr<ShmTransport> Create(const std::string& name,
+                                              size_t ring_bytes);
+  static std::unique_ptr<ShmTransport> Open(const std::string& name,
+                                            int timeout_ms);
+  ~ShmTransport() override;
+
+  const char* kind() const override { return "shm"; }
+  int Send(const void* buf, size_t len) override;
+  int Recv(void* buf, size_t len) override;
+  int RecvSegmented(void* buf, size_t len, size_t segment_bytes,
+                    const SegmentFn& on_segment) override;
+  // Interleaved full-duplex pump on the calling thread: no extra thread —
+  // writes whatever fits the outbound ring, drains the inbound ring, and
+  // fires segment callbacks as contiguous prefixes complete. The peer's
+  // concurrent pump guarantees both directions advance.
+  int SendRecv(const void* send_buf, size_t send_bytes, void* recv_buf,
+               size_t recv_bytes, size_t segment_bytes,
+               const SegmentFn& on_segment) override;
+  // The data-plane algorithms exchange matched messages (every byte sent in
+  // a step is consumed in the same step), so the ring is drained at each
+  // step boundary and a payload no larger than the ring can never block.
+  bool InlineSendSafe(size_t bytes) const override {
+    return bytes <= ring_bytes_;
+  }
+
+  // Mark the segment aborted and wake both sides; any blocked ring op
+  // (either process) returns -1. Called from DataPlane::Shutdown so a dying
+  // rank releases its same-host peers.
+  void Abort();
+  // Peer-liveness probe: a SIGKILLed peer can never flip the abort flag, so
+  // the wait loops also watch this (otherwise idle) socket to the peer and
+  // abort on EOF. Optional; without it a dead peer blocks until the caller
+  // tears the plane down.
+  void set_liveness_fd(int fd) { liveness_fd_ = fd; }
+  // Drop the name from the shm namespace (creator side, once the opener
+  // confirmed attach over the socket handshake): an abnormal death after
+  // this point leaks nothing. Idempotent.
+  void Unlink();
+
+  size_t ring_bytes() const { return ring_bytes_; }
+
+ private:
+  struct Segment;  // shared-memory layout (shm_transport.cpp)
+
+  ShmTransport(std::string name, Segment* seg, size_t map_bytes,
+               bool creator);
+
+  // One bounded copy attempt (never blocks); returns bytes moved.
+  size_t TrySend(const uint8_t* buf, size_t len);
+  size_t TryRecv(uint8_t* buf, size_t len);
+  // Park until the peer moves the given cursor or the deadline/abort hits.
+  void WaitOutboundSpace();
+  void WaitInboundData();
+  // True (and segment aborted) when the liveness socket reports EOF.
+  bool PeerDead();
+
+  std::string name_;
+  Segment* seg_ = nullptr;
+  size_t map_bytes_ = 0;
+  size_t ring_bytes_ = 0;
+  bool creator_ = false;
+  bool unlinked_ = false;
+  int liveness_fd_ = -1;
+  int out_ring_ = 0;  // rings[out_ring_] is my producer side
+  uint8_t* out_data_ = nullptr;
+  uint8_t* in_data_ = nullptr;
+};
+
+}  // namespace hvdtpu

@@ -131,8 +131,13 @@ void Timeline::QueueStart(const std::string& name) {
 }
 
 void Timeline::ActivityStart(const std::string& name,
-                             const std::string& activity) {
-  Emit(name, 'B', "", activity);
+                             const std::string& activity,
+                             const std::string& transport) {
+  Emit(name, 'B',
+       transport.empty()
+           ? std::string()
+           : "{\"transport\": \"" + JsonEscape(transport) + "\"}",
+       activity);
 }
 
 void Timeline::ActivityEnd(const std::string& name) { Emit(name, 'E', ""); }

@@ -32,7 +32,11 @@ class Timeline {
   void NegotiateStart(const std::string& name);
   void NegotiateEnd(const std::string& name);
   void QueueStart(const std::string& name);
-  void ActivityStart(const std::string& name, const std::string& activity);
+  // `transport` (optional) tags the op with the data-plane lane summary
+  // ("shm", "tcp", "shm+tcp", with "+hier" under the two-level allreduce) as
+  // a Chrome-trace arg — visible in the Perfetto slice details.
+  void ActivityStart(const std::string& name, const std::string& activity,
+                     const std::string& transport = "");
   void ActivityEnd(const std::string& name);
   void OpDone(const std::string& name, const std::string& result);
   void MarkCycle();  // HVDTPU_TIMELINE_MARK_CYCLES

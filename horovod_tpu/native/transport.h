@@ -1,0 +1,77 @@
+// Transport abstraction for the host data plane.
+//
+// The collective algorithms in data_plane.cpp speak to every peer through
+// this interface; the concrete lane is chosen per pair at Connect() time:
+// TcpTransport (the PR-1 socket path, loopback or cross-host) or
+// ShmTransport (shm_transport.h — POSIX shared-memory rings for ranks that
+// share a host). This is the seam later transports (TPU ICI-aware, RDMA)
+// plug into: implement the five methods and register a lane in
+// DataPlane::Connect. Fills the role of the reference fork's communicator
+// menu (horovod/common/ops/compressed/: MPI / NCCL / CUDA-IPC SHM / P2P).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+
+namespace hvdtpu {
+
+// In-order, disjoint completion callback for segmented receives:
+// (offset, length) with offsets at multiples of the segment size and only
+// the final segment short. Runs on the caller's thread.
+using SegmentFn = std::function<void(size_t, size_t)>;
+
+class Transport {
+ public:
+  virtual ~Transport() = default;
+
+  // Lane tag for the timeline / introspection ("tcp", "shm", ...).
+  virtual const char* kind() const = 0;
+
+  // Exact-length transfers; 0 on success, -1 on error or abort.
+  virtual int Send(const void* buf, size_t len) = 0;
+  virtual int Recv(void* buf, size_t len) = 0;
+
+  // Receive with segment callbacks so per-segment work (reduction) overlaps
+  // the transfer. A null on_segment degrades to Recv.
+  virtual int RecvSegmented(void* buf, size_t len, size_t segment_bytes,
+                            const SegmentFn& on_segment) = 0;
+
+  // Full-duplex exchange with the SAME peer (both sides may send first
+  // without deadlock) plus optional segment callbacks on the receive side.
+  virtual int SendRecv(const void* send_buf, size_t send_bytes,
+                       void* recv_buf, size_t recv_bytes,
+                       size_t segment_bytes, const SegmentFn& on_segment) = 0;
+
+  // True when Send(bytes) completes without any peer progress (the payload
+  // fits the transport's own buffering): callers may send inline before a
+  // blocking receive with no deadlock risk, skipping the sender thread that
+  // dominates small-message latency.
+  virtual bool InlineSendSafe(size_t bytes) const = 0;
+};
+
+// The PR-1 socket path behind the interface. Does NOT own the fd (the
+// DataPlane's mesh teardown closes it).
+class TcpTransport : public Transport {
+ public:
+  TcpTransport(int fd, int64_t inline_max_bytes)
+      : fd_(fd), inline_max_(inline_max_bytes) {}
+
+  const char* kind() const override { return "tcp"; }
+  int Send(const void* buf, size_t len) override;
+  int Recv(void* buf, size_t len) override;
+  int RecvSegmented(void* buf, size_t len, size_t segment_bytes,
+                    const SegmentFn& on_segment) override;
+  int SendRecv(const void* send_buf, size_t send_bytes, void* recv_buf,
+               size_t recv_bytes, size_t segment_bytes,
+               const SegmentFn& on_segment) override;
+  bool InlineSendSafe(size_t bytes) const override {
+    return static_cast<int64_t>(bytes) <= inline_max_;
+  }
+
+ private:
+  int fd_;
+  int64_t inline_max_;
+};
+
+}  // namespace hvdtpu

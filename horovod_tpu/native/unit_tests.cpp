@@ -13,6 +13,7 @@
 
 #include <atomic>
 #include <cassert>
+#include <cerrno>
 #include <cmath>
 #include <condition_variable>
 #include <cstdio>
@@ -24,9 +25,13 @@
 #include <thread>
 #include <vector>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+
 #include "autotune.h"
 #include "data_plane.h"
 #include "message.h"
+#include "shm_transport.h"
 #include "socket_util.h"
 
 namespace hvdtpu {
@@ -332,87 +337,352 @@ void TestSendRecvSegmented() {
   close(sv[1]);
 }
 
-// In-process world: one DataPlane per thread over localhost TCP, exercising
-// every allreduce algorithm (incl. the pipelined ring with a tiny segment
-// size) on even/odd world sizes and several dtypes.
-void TestDataPlaneAllreduceAlgos() {
-  for (int world : {2, 3, 4}) {
-    for (AllreduceAlgo algo :
-         {AllreduceAlgo::AUTO, AllreduceAlgo::RING,
-          AllreduceAlgo::RECURSIVE_DOUBLING, AllreduceAlgo::TREE}) {
-      std::vector<std::unique_ptr<DataPlane>> planes;
-      std::vector<PeerAddr> peers(world);
-      for (int r = 0; r < world; ++r) {
-        planes.emplace_back(new DataPlane(r, world));
-        CHECK_TRUE(planes[r]->Listen().ok());
-        peers[r] = {"127.0.0.1", planes[r]->port()};
-        planes[r]->set_allreduce_algo(algo);
-        planes[r]->set_segment_bytes(512);  // force pipelining on the ring
-        planes[r]->set_crossover_bytes(4096);
-      }
-      std::atomic<int> bad{0};
-      std::vector<std::thread> threads;
-      for (int r = 0; r < world; ++r) {
-        threads.emplace_back([&, r] {
-          if (!planes[r]->Connect(peers).ok()) {
-            ++bad;
-            return;
-          }
-          // float32 SUM, count straddling several 512 B segments per chunk
-          // (and an odd count so ring chunks are uneven).
-          {
-            const int64_t n = 4099;
-            std::vector<float> v(n);
-            for (int64_t i = 0; i < n; ++i) {
-              v[i] = static_cast<float>(r + 1) * (i % 11);
-            }
-            if (!planes[r]->Allreduce(v.data(), n, DataType::FLOAT32,
-                                      ReduceOp::SUM).ok()) {
-              ++bad;
-              return;
-            }
-            float scale = world * (world + 1) / 2.0f;
-            for (int64_t i = 0; i < n; ++i) {
-              if (v[i] != scale * (i % 11)) {
-                ++bad;
-                return;
-              }
-            }
-          }
-          // int32 MAX, small (latency path under AUTO).
-          {
-            std::vector<int32_t> v = {r, 100 - r, 7};
-            if (!planes[r]->Allreduce(v.data(), 3, DataType::INT32,
-                                      ReduceOp::MAX).ok()) {
-              ++bad;
-              return;
-            }
-            if (v[0] != world - 1 || v[1] != 100 || v[2] != 7) ++bad;
-          }
-          // fp16 SUM through the fused kernel.
-          {
-            const int64_t n = 1024;
-            std::vector<uint16_t> v(n, FloatToHalfPublic(0.25f));
-            if (!planes[r]->Allreduce(v.data(), n, DataType::FLOAT16,
-                                      ReduceOp::SUM).ok()) {
-              ++bad;
-              return;
-            }
-            for (int64_t i = 0; i < n; ++i) {
-              if (HalfToFloatPublic(v[i]) != 0.25f * world) ++bad;
-            }
-          }
-        });
-      }
-      for (auto& t : threads) t.join();
-      if (bad != 0) {
-        std::fprintf(stderr,
-                     "FAIL DataPlane allreduce world=%d algo=%d (%d bad)\n",
-                     world, static_cast<int>(algo), bad.load());
-        ++failures;
-      }
-      for (auto& p : planes) p->Shutdown();
+// --- shm transport unit tests ----------------------------------------------
+// The rings are plain MAP_SHARED memory, so two transports in one process
+// (threads) exercise exactly the cross-process protocol — and TSan/ASan see
+// every access (make check-tsan / check-asan).
+
+void TestShmRingWraparound() {
+  // Push far more than the ring capacity through in odd-sized pieces so the
+  // cursors wrap the ring many times mid-message; verify every byte.
+  const std::string name = "/hvdtpu_test_wrap_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, /*ring_bytes=*/4096);
+  CHECK_TRUE(a != nullptr);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  CHECK_TRUE(a->ring_bytes() == 4096 && b->ring_bytes() == 4096);
+  const size_t kBytes = 1 << 20;  // 256 ring-fulls
+  std::vector<uint8_t> sent(kBytes), got(kBytes, 0);
+  for (size_t i = 0; i < kBytes; ++i) {
+    sent[i] = static_cast<uint8_t>(i * 31 + 7);
+  }
+  std::atomic<int> send_rc{-1};
+  std::thread producer([&] {
+    // Odd chunk size: pieces straddle the ring boundary continually.
+    size_t off = 0;
+    int rc = 0;
+    while (off < kBytes && rc == 0) {
+      size_t n = std::min<size_t>(4097, kBytes - off);
+      rc = a->Send(sent.data() + off, n);
+      off += n;
     }
+    send_rc = rc;
+  });
+  size_t calls = 0, cb_bytes = 0;
+  int rc = b->RecvSegmented(got.data(), kBytes, 100000,
+                            [&](size_t off, size_t len) {
+                              CHECK_TRUE(off == cb_bytes);
+                              cb_bytes += len;
+                              ++calls;
+                            });
+  producer.join();
+  CHECK_TRUE(rc == 0 && send_rc == 0);
+  CHECK_TRUE(cb_bytes == kBytes && calls >= 11);
+  CHECK_TRUE(got == sent);
+  // Full-duplex interleaved pump: both sides exchange > ring capacity.
+  std::vector<uint8_t> b2a(64 * 1024), a_got(64 * 1024);
+  for (size_t i = 0; i < b2a.size(); ++i) b2a[i] = static_cast<uint8_t>(i * 13);
+  std::atomic<int> b_rc{-1};
+  std::thread side_b([&] {
+    b_rc = b->SendRecv(b2a.data(), b2a.size(), got.data(), kBytes, 0, nullptr);
+  });
+  rc = a->SendRecv(sent.data(), kBytes, a_got.data(), a_got.size(), 0,
+                   nullptr);
+  side_b.join();
+  CHECK_TRUE(rc == 0 && b_rc == 0);
+  CHECK_TRUE(got == sent && a_got == b2a);
+}
+
+void TestShmDoorbellWakeup() {
+  // Consumer blocks on an empty ring (past the spin phase, into the futex
+  // wait); a producer that shows up much later must still get through.
+  const std::string name = "/hvdtpu_test_bell_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, 4096);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(a != nullptr && b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  uint32_t got = 0;
+  std::atomic<int> recv_rc{-1};
+  std::thread consumer([&] { recv_rc = b->Recv(&got, sizeof(got)); });
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  uint32_t val = 0xdeadbeef;
+  CHECK_TRUE(a->Send(&val, sizeof(val)) == 0);
+  consumer.join();
+  CHECK_TRUE(recv_rc == 0 && got == 0xdeadbeef);
+  // And the reverse doorbell: a producer blocked on a FULL ring wakes when
+  // the consumer drains.
+  std::vector<uint8_t> big(8192, 0x5a), sink(8192, 0);
+  std::atomic<int> send_rc{-1};
+  std::thread producer([&] { send_rc = a->Send(big.data(), big.size()); });
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  CHECK_TRUE(b->Recv(sink.data(), sink.size()) == 0);
+  producer.join();
+  CHECK_TRUE(send_rc == 0);
+  CHECK_TRUE(sink == big);
+}
+
+void TestShmAbortCleanup() {
+  // Abort wakes a blocked peer with an error instead of hanging it, and
+  // teardown removes the name from the shm namespace (nothing leaks).
+  const std::string name = "/hvdtpu_test_abort_" + std::to_string(getpid());
+  {
+    auto a = ShmTransport::Create(name, 4096);
+    auto b = ShmTransport::Open(name, 2000);
+    CHECK_TRUE(a != nullptr && b != nullptr);
+    if (a == nullptr || b == nullptr) return;
+    uint8_t byte;
+    std::atomic<int> recv_rc{0};
+    std::thread consumer([&] { recv_rc = b->Recv(&byte, 1); });
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    a->Abort();
+    consumer.join();
+    CHECK_TRUE(recv_rc == -1);          // blocked op fails over
+    CHECK_TRUE(a->Send(&byte, 1) == -1);  // post-abort ops fail fast
+    // Destructors: opener unmaps, creator unlinks.
+  }
+  int fd = shm_open(name.c_str(), O_RDWR, 0600);
+  CHECK_TRUE(fd < 0 && errno == ENOENT);
+  if (fd >= 0) {
+    close(fd);
+    shm_unlink(name.c_str());
+  }
+}
+
+// --- data-plane worlds ------------------------------------------------------
+
+// One DataPlane per thread; host strings decide the lanes (same string ->
+// shm negotiation; the sockets stay as fallback + liveness probes).
+struct TestWorld {
+  std::vector<std::unique_ptr<DataPlane>> planes;
+  std::vector<PeerAddr> peers;
+};
+
+TestWorld MakeWorld(const std::vector<std::string>& hosts) {
+  TestWorld w;
+  const int n = static_cast<int>(hosts.size());
+  w.peers.resize(n);
+  for (int r = 0; r < n; ++r) {
+    w.planes.emplace_back(new DataPlane(r, n));
+    CHECK_TRUE(w.planes[r]->Listen().ok());
+    w.peers[r] = {hosts[r], w.planes[r]->port()};
+  }
+  return w;
+}
+
+// Exhaustive dtype/op sweep on one rank's plane; returns false on any
+// mismatch. Covers every wire dtype and every reduce op, flat or
+// hierarchical depending on the plane's configuration.
+bool RunDtypeOpSweep(DataPlane* plane, int r, int world) {
+  // float32 SUM, count straddling several 512 B segments per chunk
+  // (and an odd count so ring chunks are uneven).
+  {
+    const int64_t n = 4099;
+    std::vector<float> v(n);
+    for (int64_t i = 0; i < n; ++i) {
+      v[i] = static_cast<float>(r + 1) * (i % 11);
+    }
+    if (!plane->Allreduce(v.data(), n, DataType::FLOAT32, ReduceOp::SUM)
+             .ok()) {
+      return false;
+    }
+    float scale = world * (world + 1) / 2.0f;
+    for (int64_t i = 0; i < n; ++i) {
+      if (v[i] != scale * (i % 11)) return false;
+    }
+  }
+  // float64 PRODUCT (exact for small integers).
+  {
+    std::vector<double> v = {2.0, 1.0, static_cast<double>(r + 1)};
+    if (!plane->Allreduce(v.data(), 3, DataType::FLOAT64, ReduceOp::PRODUCT)
+             .ok()) {
+      return false;
+    }
+    double fact = 1.0;
+    for (int q = 1; q <= world; ++q) fact *= q;
+    if (v[0] != std::pow(2.0, world) || v[1] != 1.0 || v[2] != fact) {
+      return false;
+    }
+  }
+  // int32 MAX, small (latency path under AUTO).
+  {
+    std::vector<int32_t> v = {r, 100 - r, 7};
+    if (!plane->Allreduce(v.data(), 3, DataType::INT32, ReduceOp::MAX).ok()) {
+      return false;
+    }
+    if (v[0] != world - 1 || v[1] != 100 || v[2] != 7) return false;
+  }
+  // int64 MIN.
+  {
+    std::vector<int64_t> v = {static_cast<int64_t>(r) - 5, 1000 + r};
+    if (!plane->Allreduce(v.data(), 2, DataType::INT64, ReduceOp::MIN).ok()) {
+      return false;
+    }
+    if (v[0] != -5 || v[1] != 1000) return false;
+  }
+  // uint8 / int8 SUM.
+  {
+    std::vector<uint8_t> u(5, 3);
+    if (!plane->Allreduce(u.data(), 5, DataType::UINT8, ReduceOp::SUM).ok()) {
+      return false;
+    }
+    for (uint8_t x : u) {
+      if (x != 3 * world) return false;
+    }
+    std::vector<int8_t> s(5, -2);
+    if (!plane->Allreduce(s.data(), 5, DataType::INT8, ReduceOp::SUM).ok()) {
+      return false;
+    }
+    for (int8_t x : s) {
+      if (x != -2 * world) return false;
+    }
+  }
+  // bool: SUM == OR, PRODUCT == AND.
+  {
+    std::vector<uint8_t> v = {static_cast<uint8_t>(r == 0 ? 1 : 0), 1, 0};
+    if (!plane->Allreduce(v.data(), 3, DataType::BOOL, ReduceOp::SUM).ok()) {
+      return false;
+    }
+    if (v[0] != 1 || v[1] != 1 || v[2] != 0) return false;
+    std::vector<uint8_t> w = {static_cast<uint8_t>(r == 0 ? 0 : 1), 1, 1};
+    if (!plane->Allreduce(w.data(), 3, DataType::BOOL, ReduceOp::PRODUCT)
+             .ok()) {
+      return false;
+    }
+    if (w[0] != 0 || w[1] != 1 || w[2] != 1) return false;
+  }
+  // fp16 SUM through the fused kernel.
+  {
+    const int64_t n = 1024;
+    std::vector<uint16_t> v(n, FloatToHalfPublic(0.25f));
+    if (!plane->Allreduce(v.data(), n, DataType::FLOAT16, ReduceOp::SUM)
+             .ok()) {
+      return false;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      if (HalfToFloatPublic(v[i]) != 0.25f * world) return false;
+    }
+  }
+  // bf16 MAX.
+  {
+    std::vector<uint16_t> v = {FloatToBf16Public(static_cast<float>(r)),
+                               FloatToBf16Public(-1.0f)};
+    if (!plane->Allreduce(v.data(), 2, DataType::BFLOAT16, ReduceOp::MAX)
+             .ok()) {
+      return false;
+    }
+    if (Bf16ToFloatPublic(v[0]) != static_cast<float>(world - 1) ||
+        Bf16ToFloatPublic(v[1]) != -1.0f) {
+      return false;
+    }
+  }
+  return true;
+}
+
+// In-process world: one DataPlane per thread over localhost TCP (all ranks
+// "share a host", so the lanes come up as shm unless disabled), exercising
+// every allreduce algorithm (incl. the pipelined ring with a tiny segment
+// size) on even/odd world sizes across the exhaustive dtype/op sweep.
+void TestDataPlaneAllreduceAlgos() {
+  for (bool shm : {false, true}) {
+    for (int world : {2, 3, 4}) {
+      for (AllreduceAlgo algo :
+           {AllreduceAlgo::AUTO, AllreduceAlgo::RING,
+            AllreduceAlgo::RECURSIVE_DOUBLING, AllreduceAlgo::TREE}) {
+        TestWorld w = MakeWorld(
+            std::vector<std::string>(world, "127.0.0.1"));
+        for (int r = 0; r < world; ++r) {
+          w.planes[r]->set_allreduce_algo(algo);
+          w.planes[r]->set_segment_bytes(512);  // force ring pipelining
+          w.planes[r]->set_crossover_bytes(4096);
+          w.planes[r]->set_shm_enabled(shm);
+          w.planes[r]->set_shm_ring_bytes(8192);  // force ring wraparound
+          w.planes[r]->set_hier_mode(HierMode::OFF);
+        }
+        std::atomic<int> bad{0};
+        std::vector<std::thread> threads;
+        for (int r = 0; r < world; ++r) {
+          threads.emplace_back([&, r] {
+            if (!w.planes[r]->Connect(w.peers).ok()) {
+              ++bad;
+              return;
+            }
+            if (w.planes[r]->shm_lane_count() != (shm ? world - 1 : 0)) {
+              ++bad;
+              return;
+            }
+            if (!RunDtypeOpSweep(w.planes[r].get(), r, world)) ++bad;
+          });
+        }
+        for (auto& t : threads) t.join();
+        if (bad != 0) {
+          std::fprintf(
+              stderr,
+              "FAIL DataPlane allreduce world=%d algo=%d shm=%d (%d bad)\n",
+              world, static_cast<int>(algo), shm ? 1 : 0, bad.load());
+          ++failures;
+        }
+        for (auto& p : w.planes) p->Shutdown();
+      }
+    }
+  }
+}
+
+// Hierarchical two-level allreduce across synthetic host topologies: two
+// host strings split the world into local (shm) groups with one TCP leader
+// pair; uneven local sizes exercise the leader gather/scatter with ragged
+// chunks. The flat path on the identical world double-checks the oracle.
+void TestDataPlaneHierarchicalAllreduce() {
+  struct Topo {
+    std::vector<std::string> hosts;
+  };
+  const Topo topos[] = {
+      {{"127.0.0.1", "127.0.0.1", "localhost", "localhost"}},  // 2x2
+      {{"127.0.0.1", "127.0.0.1", "127.0.0.1", "localhost"}},  // 3+1
+      {{"127.0.0.1", "127.0.0.1", "localhost"}},               // 2+1
+      {{"127.0.0.1", "127.0.0.1", "127.0.0.1"}},               // single host
+  };
+  for (const Topo& topo : topos) {
+    const int world = static_cast<int>(topo.hosts.size());
+    TestWorld w = MakeWorld(topo.hosts);
+    for (int r = 0; r < world; ++r) {
+      w.planes[r]->set_segment_bytes(512);
+      w.planes[r]->set_shm_ring_bytes(8192);
+      w.planes[r]->set_hier_mode(HierMode::ON);
+    }
+    std::atomic<int> bad{0};
+    std::vector<std::thread> threads;
+    for (int r = 0; r < world; ++r) {
+      threads.emplace_back([&, r] {
+        if (!w.planes[r]->Connect(w.peers).ok()) {
+          ++bad;
+          return;
+        }
+        if (!w.planes[r]->hier_active()) {
+          ++bad;
+          return;
+        }
+        if (!RunDtypeOpSweep(w.planes[r].get(), r, world)) ++bad;
+        // Tiny tensor: count < local group size leaves empty chunks on the
+        // gather/scatter path.
+        std::vector<float> tiny = {static_cast<float>(r + 1)};
+        if (!w.planes[r]
+                 ->Allreduce(tiny.data(), 1, DataType::FLOAT32, ReduceOp::SUM)
+                 .ok() ||
+            tiny[0] != world * (world + 1) / 2.0f) {
+          ++bad;
+        }
+      });
+    }
+    for (auto& t : threads) t.join();
+    if (bad != 0) {
+      std::fprintf(stderr, "FAIL hierarchical allreduce world=%d (%d bad)\n",
+                   world, bad.load());
+      ++failures;
+    }
+    for (auto& p : w.planes) p->Shutdown();
   }
 }
 
@@ -468,6 +738,7 @@ void TestParameterManagerFreezesAtBest() {
   ParameterManager pm;
   pm.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
                 /*algo_crossover=*/256 << 10, /*tune_crossover=*/true,
+                /*hier_enabled=*/false, /*tune_hier=*/true,
                 /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
                 /*max_samples=*/4, /*gp_noise=*/0.1);
   CHECK_TRUE(pm.active());
@@ -485,11 +756,12 @@ void TestParameterManagerFreezesAtBest() {
   CHECK_TRUE(p.fusion_threshold >= (1 << 20));
   CHECK_TRUE(p.algo_crossover >= (4 << 10) && p.algo_crossover <= (4 << 20));
 
-  // Pinned algorithm (tune_crossover=false): the crossover coordinate is
-  // excluded from the GP and held at its initial value.
+  // Pinned algorithm (tune_crossover=false) and pinned hier (tune_hier=
+  // false): the excluded coordinates are held at their initial values.
   ParameterManager pinned;
   pinned.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
                     /*algo_crossover=*/123456, /*tune_crossover=*/false,
+                    /*hier_enabled=*/true, /*tune_hier=*/false,
                     /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
                     /*max_samples=*/4, /*gp_noise=*/0.1);
   t = 0.0;
@@ -498,6 +770,7 @@ void TestParameterManagerFreezesAtBest() {
     pinned.Update(/*bytes=*/1 << 20, t);
   }
   CHECK_TRUE(pinned.Current().algo_crossover == 123456);
+  CHECK_TRUE(pinned.Current().hier_enabled);
 }
 
 }  // namespace
@@ -514,7 +787,11 @@ int main() {
   TestHalfRoundToNearestEven();
   TestReduceBufferHalfMatchesScalar();
   TestSendRecvSegmented();
+  TestShmRingWraparound();
+  TestShmDoorbellWakeup();
+  TestShmAbortCleanup();
   TestDataPlaneAllreduceAlgos();
+  TestDataPlaneHierarchicalAllreduce();
   TestReduceBufferOps();
   TestGaussianProcessInterpolates();
   TestBayesianOptimizerPicksBestSample();

@@ -56,6 +56,20 @@ def parse_hostfile(path: str) -> List[Tuple[str, int]]:
     return out
 
 
+def host_groups(slots: List["SlotInfo"]) -> "OrderedDict[str, List[int]]":
+    """Host topology of an assignment: hostname -> global ranks on it, in
+    rank order. This is what the native transport layer derives from the
+    peer table at rendezvous (data_plane.cpp Connect) — same-host groups get
+    shared-memory lanes, and the first rank of each group is the host leader
+    for the hierarchical allreduce. Exposed here so the launcher (and tests)
+    can report/verify the topology the job will run with."""
+    from collections import OrderedDict
+    groups: "OrderedDict[str, List[int]]" = OrderedDict()
+    for s in slots:
+        groups.setdefault(s.hostname, []).append(s.rank)
+    return groups
+
+
 def get_host_assignments(hosts: List[Tuple[str, int]],
                          np_: int) -> List[SlotInfo]:
     """Assign global/local/cross ranks to ``np_`` slots across hosts

@@ -64,6 +64,21 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                         "doubling below the (autotuned) crossover size and "
                         "the pipelined ring above it "
                         "(HVDTPU_ALLREDUCE_ALGO)")
+    p.add_argument("--hier", action="store_true",
+                   help="force the hierarchical two-level allreduce: "
+                        "intra-host reduce-scatter/allgather over "
+                        "shared-memory lanes, one leader per host on the "
+                        "flat TCP algorithm (HVDTPU_ALLREDUCE_HIER=1; "
+                        "default auto = autotuner-owned)")
+    p.add_argument("--no-hier", action="store_true",
+                   help="disable the hierarchical allreduce entirely "
+                        "(HVDTPU_ALLREDUCE_HIER=0)")
+    p.add_argument("--no-shm", action="store_true",
+                   help="disable the POSIX shared-memory transport between "
+                        "same-host ranks; every pair uses TCP (HVDTPU_SHM=0)")
+    p.add_argument("--shm-ring-bytes", type=int, default=None,
+                   help="per-direction shm ring capacity in bytes "
+                        "(HVDTPU_SHM_RING_BYTES; default 1 MB)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
@@ -198,6 +213,25 @@ def _apply_tuning_env(env: dict, args) -> dict:
     env[ev.HVDTPU_FUSION_THRESHOLD] = str(
         int(args.fusion_threshold_mb * 1024 * 1024))
     env[ev.HVDTPU_ALLREDUCE_ALGO] = args.allreduce_algo
+    # Transport subsystem: shm lanes + hierarchical allreduce (the native
+    # side groups ranks by their advertised HVDTPU_HOSTNAME, so the env only
+    # carries the on/off knobs — topology detection is hosts.py's slot
+    # assignment plus the peer table exchanged at rendezvous).
+    if args.hier and args.no_hier:
+        raise SystemExit("hvdrun: --hier and --no-hier are mutually exclusive")
+    if args.hier:
+        env[ev.HVDTPU_ALLREDUCE_HIER] = "1"
+    elif args.no_hier:
+        env[ev.HVDTPU_ALLREDUCE_HIER] = "0"
+    else:
+        # No flag: a user-exported HVDTPU_ALLREDUCE_HIER wins (same
+        # precedence as HVDTPU_SHM above — flags own the knob only when
+        # passed).
+        env.setdefault(ev.HVDTPU_ALLREDUCE_HIER, "auto")
+    if args.no_shm:
+        env[ev.HVDTPU_SHM] = "0"
+    if args.shm_ring_bytes is not None:
+        env[ev.HVDTPU_SHM_RING_BYTES] = str(args.shm_ring_bytes)
     if args.timeline:
         # Base path; per-worker suffixing happens where the worker identity
         # is known (static: per rank here in _build_env; elastic: the driver).
@@ -303,6 +337,14 @@ def run_launcher(args: argparse.Namespace) -> int:
     slots = hosts_mod.get_host_assignments(host_list, args.num_proc)
     controller_host = args.controller_advertise_address or slots[0].hostname
     controller_port = args.start_port or _free_port()
+    if args.verbose:
+        groups = hosts_mod.host_groups(slots)
+        lanes = "tcp-only" if args.no_shm else "shm intra-host"
+        hier = "on" if args.hier else "off" if args.no_hier else "auto"
+        print("hvdrun: host topology: " +
+              ", ".join(f"{h}(ranks {r[0]}-{r[-1]})" if len(r) > 1 else
+                        f"{h}(rank {r[0]})" for h, r in groups.items()) +
+              f"; transports: {lanes}; hier={hier}", file=sys.stderr)
 
     # Multi-host job: probe reachability BEFORE spawning workers so a
     # wrong-NIC / firewalled setup fails fast with a named host instead of

@@ -5,6 +5,16 @@ dynamically subclasses the wrapped optimizer's class, registers per-parameter
 gradient-accumulation hooks (:104-150) that launch async allreduces, supports
 ``backward_passes_per_step`` local accumulation, and ``synchronize()`` (:152)
 waits for the reduced gradients before ``step()`` (:190).
+
+**Host-only scope.** This binding reduces gradients through the native
+process-mode core, which reads tensors as host (CPU) numpy buffers — there
+is no CUDA/XLA device path here (the reference's GPU path rides NCCL; the
+TPU-native hot path is the compiled JAX/SPMD mode, ``docs/torch.md``). A
+parameter living on a CUDA, XLA, or other non-CPU device would silently
+force a device→host→device round trip per step at best — and at worst read
+stale device memory — so ``_allreduce_grad_async`` rejects non-CPU
+gradients with a ``ValueError`` up front. Keep models on CPU (or call
+``.cpu()`` before wrapping), or use the JAX binding for accelerators.
 """
 
 from __future__ import annotations
@@ -146,6 +156,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _allreduce_grad_async(self, p):
         name = self._param_names[id(p)]
+        if p.grad.device.type != "cpu":
+            # Host-only scope (module docstring): the native core reads host
+            # buffers; a CUDA/XLA tensor here means the model was left on an
+            # accelerator this binding cannot serve.
+            raise ValueError(
+                "horovod_tpu.torch.DistributedOptimizer is host-only: "
+                f"gradient for parameter '{name}' lives on device "
+                f"'{p.grad.device}', but the native process-mode core "
+                "reduces CPU tensors only. Move the model to CPU "
+                "(model.cpu()) before wrapping, or use the JAX/SPMD binding "
+                "for accelerator training (docs/torch.md).")
         # Out-of-place: the compressed tensor may have a different dtype than
         # the parameter, and torch >= 2.x refuses a grad whose dtype diverges
         # from the param's — decompression back into p.grad happens in

@@ -57,6 +57,24 @@ HVDTPU_ALLREDUCE_SEGMENT_BYTES = "HVDTPU_ALLREDUCE_SEGMENT_BYTES"
 # Valid HVDTPU_ALLREDUCE_ALGO values, mapped to hvdtpu::AllreduceAlgo.
 ALLREDUCE_ALGOS = ("auto", "ring", "recursive_doubling", "tree")
 
+# Transport subsystem (native/transport.h + shm_transport.h; reference
+# analog: the fork's MPI / NCCL / CUDA-IPC SHM / P2P communicator menu).
+# SHM: "1" (default) lets same-host rank pairs negotiate POSIX
+# shared-memory ring lanes at rendezvous, "0" forces TCP everywhere.
+# SHM_RING_BYTES: per-direction ring capacity (default 1 MB).
+HVDTPU_SHM = "HVDTPU_SHM"
+HVDTPU_SHM_RING_BYTES = "HVDTPU_SHM_RING_BYTES"
+# Hierarchical two-level allreduce (native/data_plane.h HierMode): intra-host
+# reduce-scatter/allgather over shm lanes + one leader per host on the flat
+# TCP algorithm. "auto" (default) leaves the switch to the Bayesian
+# autotuner; "1"/"0" force it.
+HVDTPU_ALLREDUCE_HIER = "HVDTPU_ALLREDUCE_HIER"
+
+# Valid HVDTPU_ALLREDUCE_HIER values, mapped to hvdtpu::HierMode.
+ALLREDUCE_HIER_MODES = {"0": 0, "off": 0, "false": 0,
+                        "1": 1, "on": 1, "true": 1,
+                        "auto": 2, "": 2}
+
 # Response cache (reference: HOROVOD_CACHE_CAPACITY)
 HVDTPU_CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
 

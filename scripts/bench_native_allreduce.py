@@ -33,6 +33,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvdtpu_core.so")
 
 ALGOS = {"auto": 0, "ring": 1, "recursive_doubling": 2, "tree": 3}
+HIER_MODES = {"off": 0, "on": 1, "auto": 2}
 DTYPES = {"float32": (7, 4), "float16": (6, 2), "bfloat16": (10, 2)}
 OP_ALLREDUCE = 0
 REDUCE_SUM = 1
@@ -74,6 +75,12 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_longlong]
     except AttributeError:
         pass  # seed build: no algorithm selection
+    try:
+        lib.hvdtpu_set_transport.restype = ctypes.c_int
+        lib.hvdtpu_set_transport.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
+    except AttributeError:
+        pass  # pre-transport-subsystem build: TCP only
     return lib
 
 
@@ -113,6 +120,16 @@ def run_worker(args) -> int:
                                         args.crossover, args.segment)
     elif args.algo not in ("auto", "ring"):
         print(f"SKIP algo {args.algo}: library has no algorithm selection",
+              file=sys.stderr)
+        return 0
+    if hasattr(lib, "hvdtpu_set_transport"):
+        # All bench ranks share this host, so --transport shm vs tcp is the
+        # same-host shm-lane vs loopback-TCP A/B; --hier on runs the
+        # two-level path (degenerate single-host form: all-shm ring).
+        lib.hvdtpu_set_transport(core, int(args.transport != "tcp"),
+                                 args.shm_ring_bytes, HIER_MODES[args.hier])
+    elif args.transport == "shm" or args.hier == "on":
+        print("SKIP shm/hier config: library has no transport subsystem",
               file=sys.stderr)
         return 0
     err = ctypes.create_string_buffer(1024)
@@ -198,6 +215,8 @@ def run_config(args, world: int, algo: str, sizes: list) -> tuple:
                "--lib", args.lib, "--dtype", args.dtype,
                "--crossover", str(args.crossover),
                "--segment", str(args.segment),
+               "--transport", args.transport, "--hier", args.hier,
+               "--shm-ring-bytes", str(args.shm_ring_bytes),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -223,7 +242,8 @@ def run_config(args, world: int, algo: str, sizes: list) -> tuple:
                 p.kill()
                 p.communicate()
     for row in rows:
-        row.update({"world": world, "algo": algo, "dtype": args.dtype})
+        row.update({"world": world, "algo": algo, "dtype": args.dtype,
+                    "transport": args.transport, "hier": args.hier})
     return rows, failed
 
 
@@ -254,6 +274,14 @@ def main(argv=None) -> int:
                    help="ring/latency-algorithm crossover bytes (-1: default)")
     p.add_argument("--segment", type=int, default=-1,
                    help="ring pipeline segment bytes (-1: default)")
+    p.add_argument("--transport", default="shm", choices=["shm", "tcp"],
+                   help="same-host lane: shm rings (default) vs loopback "
+                        "TCP — all bench ranks share this host, so this is "
+                        "the headline shm-vs-TCP A/B")
+    p.add_argument("--hier", default="off", choices=sorted(HIER_MODES),
+                   help="hierarchical two-level allreduce mode")
+    p.add_argument("--shm-ring-bytes", type=int, default=0,
+                   help="shm ring capacity per direction (0: default 1 MB)")
     p.add_argument("--cycle-time-ms", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--quick", action="store_true",

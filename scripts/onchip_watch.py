@@ -35,10 +35,21 @@ STAGE_B_TIMEOUT_S = 3600
 SLEEP_BETWEEN_PROBES_S = 120
 
 STAGE_A = r"""
-import json, sys, time
+import json, os, sys, time
 import jax, jax.numpy as jnp
 sys.path.insert(0, %(repo)r)
 import bench
+# Persistent compile cache FIRST (VERDICT weak #2): STAGE_A never calls
+# hvd.init() (runtime.py wires HVDTPU_COMPILATION_CACHE_DIR there), so point
+# jax at the watcher-provided dir directly — a tunnel window that dies after
+# the flash compile still leaves the 20-40 s Mosaic artifact warm for the
+# next attempt instead of discarding it.
+_cache = os.environ.get("HVDTPU_COMPILATION_CACHE_DIR")
+if _cache:
+    os.makedirs(_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    print("MARK compile_cache " + _cache, flush=True)
 print("MARK devices " + str(jax.devices()), flush=True)
 # One flash compile FIRST and streamed immediately: a tunnel window too
 # short for the full check still answers the round's #1 question (does
@@ -77,9 +88,17 @@ def log(entry: dict) -> None:
 
 def run_sub(args, timeout_s, tag):
     t0 = time.time()
+    # Warm XLA compile cache shared across attempts: the STAGE_A payload and
+    # hvd.init() (runtime.py) both honor HVDTPU_COMPILATION_CACHE_DIR, so a
+    # partially-successful chip window pays each kernel compile once.
+    # (bench.py's stage B additionally keeps its own state-dir cache.)
+    env = dict(os.environ)
+    env.setdefault("HVDTPU_COMPILATION_CACHE_DIR",
+                   os.path.join(LIVE, "compile_cache"))
     try:
         proc = subprocess.run(
-            args, cwd=REPO, capture_output=True, text=True, timeout=timeout_s)
+            args, cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout_s)
         return {
             "tag": tag, "rc": proc.returncode,
             "elapsed_s": round(time.time() - t0, 1),

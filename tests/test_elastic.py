@@ -135,6 +135,54 @@ class TestElasticIntegration:
         # Survivors must have re-homed onto the non-blacklisted host.
         assert all(ln.startswith("localhost:") for ln in finishers), lines
 
+    def test_scale_down(self, tmp_path):
+        """Graceful host removal mid-run: discovery shrinks 3 -> 2 slots, the
+        survivors take a HostsUpdatedInterrupt at the next commit and
+        re-rendezvous at the smaller size; the removed worker exits cleanly
+        when the new epoch carries no assignment for it (reference:
+        elastic_common.py:118 hosts-removed leg — the scale-UP test above
+        covers only the growth direction)."""
+        script, hosts_file = _write_discovery(tmp_path, "localhost:3\n")
+        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="40",
+                        ELASTIC_BATCH_SLEEP="0.2")
+        settings = ElasticSettings(min_np=2, max_np=3,
+                                   discovery_interval_s=0.3,
+                                   elastic_timeout_s=60)
+        import threading
+
+        def shrink():
+            time.sleep(4)
+            hosts_file.write_text("localhost:2\n")
+
+        t = threading.Thread(target=shrink)
+        t.start()
+        rc = run_elastic(HostDiscoveryScript(str(script)), settings,
+                         [sys.executable, WORKER], env)
+        t.join()
+        assert rc == 0
+        lines = open(tmp_path / "results.txt").read().splitlines()
+        finishers = [ln for ln in lines if "final_size=" in ln]
+        # Exactly the two surviving slots finish, and they finish at size 2.
+        assert len(finishers) == 2, lines
+        assert all("final_size=2" in ln for ln in finishers), lines
+
+    def test_rendezvous_timeout_when_min_np_unreachable(self, tmp_path):
+        """min_np can never be met: the driver must abort with a clear
+        TimeoutError naming the shortfall after elastic_timeout_s instead of
+        waiting forever (reference: elastic_common.py:230 discovery-timeout
+        leg / HOROVOD_ELASTIC_TIMEOUT)."""
+        script, _ = _write_discovery(tmp_path, "localhost:1\n")
+        env = _base_env(tmp_path, ELASTIC_TARGET_BATCHES="4")
+        settings = ElasticSettings(min_np=3, max_np=3,
+                                   discovery_interval_s=0.2,
+                                   elastic_timeout_s=3)
+        t0 = time.time()
+        with pytest.raises(TimeoutError, match="at least 3 slots"):
+            run_elastic(HostDiscoveryScript(str(script)), settings,
+                        [sys.executable, WORKER], env)
+        # Bounded by the timeout (plus slack), not hanging to the test's own.
+        assert time.time() - t0 < 30
+
     def test_reset_limit_aborts(self, tmp_path):
         """reset_limit bounds rendezvous rounds (reference:
         elastic_common.py:246)."""

@@ -62,6 +62,74 @@ def test_allreduce_algorithms(algo):
         assert "ALL OK" in out
 
 
+@pytest.mark.parametrize("shm", ["1", "0"])
+def test_shm_transport_toggle(shm):
+    """The whole collective menu stays correct over the shared-memory lanes
+    (HVDTPU_SHM default) AND with them disabled (TCP everywhere) — both
+    sides of every same-host pair must agree on the lane, so the toggle
+    exercises the socket handshake's negative path too."""
+    results = _launch_world(2, WORKER, extra_env={"HVDTPU_SHM": shm})
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
+def test_hierarchical_allreduce_two_hosts():
+    """Hierarchical two-level allreduce across a synthetic two-host world:
+    ranks 0-1 advertise 127.0.0.1, ranks 2-3 advertise localhost (both
+    resolve locally, so the leader TCP hop is real while the native layer
+    sees two hosts). Every rank must produce the exact flat result."""
+    import subprocess
+
+    from conftest import free_port, subprocess_env
+
+    worker = os.path.join(REPO, "tests", "data", "algo_worker.py")
+    port = free_port()
+    hosts = ["127.0.0.1", "127.0.0.1", "localhost", "localhost"]
+    procs = []
+    for r in range(4):
+        env = subprocess_env()
+        env.update({
+            "HVDTPU_RANK": str(r), "HVDTPU_SIZE": "4",
+            "HVDTPU_LOCAL_RANK": str(r % 2), "HVDTPU_LOCAL_SIZE": "2",
+            "HVDTPU_CROSS_RANK": str(r // 2), "HVDTPU_CROSS_SIZE": "2",
+            "HVDTPU_HOSTNAME": hosts[r],
+            "HVDTPU_CONTROLLER_PORT": str(port),
+            "HVDTPU_ALLREDUCE_HIER": "1",
+            "HVDTPU_ALLREDUCE_SEGMENT_BYTES": "8192",
+            "TEST_ALGO_ITERS": "2",
+        })
+        procs.append(subprocess.Popen([sys.executable, worker], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            results.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                out, err = p.communicate()
+                results.append((-9, out, f"[killed after timeout]\n{err}"))
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
+def test_invalid_allreduce_hier_rejected():
+    """A bad HVDTPU_ALLREDUCE_HIER fails fast at init with the valid menu in
+    the message (same contract as HVDTPU_ALLREDUCE_ALGO)."""
+    results = _launch_world(2, os.path.join(REPO, "tests", "data",
+                                            "algo_worker.py"),
+                            extra_env={"HVDTPU_ALLREDUCE_HIER": "sideways"},
+                            timeout=60)
+    for _rc, _out, err in results:
+        assert _rc != 0
+        assert "HVDTPU_ALLREDUCE_HIER" in err and "sideways" in err
+
+
 def test_invalid_allreduce_algo_rejected():
     """A bad HVDTPU_ALLREDUCE_ALGO fails fast at init with the valid menu in
     the message, instead of silently falling back."""
@@ -100,6 +168,12 @@ def test_hvdrun_cli(tmp_path):
     events = json.load(open(f"{timeline}.0.json"))
     names = {e["name"] for e in events}
     assert "ALLREDUCE" in names and "NEGOTIATE" in names
+    # Transport tag per op (ISSUE 2): every data-plane op records its lane
+    # mix in the trace args — localhost world => shm (or tcp if the shm
+    # setup fell back; never absent).
+    lanes = {e.get("args", {}).get("transport")
+             for e in events if e["name"] == "ALLREDUCE"}
+    assert lanes & {"shm", "tcp", "shm+tcp"}, lanes
 
 
 def test_programmatic_run():

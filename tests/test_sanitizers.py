@@ -58,6 +58,12 @@ def test_tsan_process_mode():
         "LD_PRELOAD": rt,
         # exitcode=66 turns any data-race report into a worker failure.
         "TSAN_OPTIONS": "exitcode=66 report_thread_leaks=0",
+        # TCP lanes: cross-PROCESS shm gives TSan nothing (it cannot see the
+        # peer's accesses to the shared rings) while the ring spin-waits
+        # burn CPU that two TSan'd python workers on a small host need —
+        # the rings' real TSan coverage is `make check-tsan`'s in-process
+        # worlds, where both sides are instrumented.
+        "HVDTPU_SHM": "0",
     }, timeout=240)
     _scan(results, "ThreadSanitizer")
 
@@ -66,13 +72,31 @@ def test_tsan_native_unit_tests():
     """TSan-instrumented native unit tests: the pipelined data plane
     (SendRecvSegmented sender/receiver/reducer handoff, every allreduce
     algorithm across threaded in-process worlds) with no Python host in the
-    way — seconds even on tiny machines (ISSUE 1 satellite)."""
+    way — seconds even on tiny machines (ISSUE 1 satellite). Since ISSUE 2
+    this binary also covers the shm transport (ring wraparound, futex
+    doorbell wakeup, abort-path shm_unlink cleanup) and the hierarchical
+    allreduce worlds — the rings are MAP_SHARED atomics, so TSan checks the
+    exact cross-process protocol."""
     r = subprocess.run(["make", "-C", NATIVE, "check-tsan"],
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
     assert "ALL OK" in r.stdout
     for line in (r.stdout + r.stderr).splitlines():
         assert "ThreadSanitizer" not in line, line
+
+
+def test_asan_ubsan_native_unit_tests():
+    """ASan+UBSan build of the same native unit-test binary (ISSUE 2
+    satellite): the shm rings' mmap'ed cursor arithmetic and the segment
+    teardown paths are where an off-by-one corrupts silently; any report
+    exits 66 via the Makefile's ASAN_OPTIONS."""
+    r = subprocess.run(["make", "-C", NATIVE, "check-asan"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    assert "ALL OK" in r.stdout
+    for line in (r.stdout + r.stderr).splitlines():
+        assert "AddressSanitizer" not in line and "runtime error" not in line, \
+            line
 
 
 def test_tsan_pipelined_allreduce():

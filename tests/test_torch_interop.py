@@ -139,6 +139,31 @@ class TestSingleProcess:
                 torch.optim.SGD(model.parameters(), lr=0.1),
                 named_parameters=list(model.named_parameters())[:1])
 
+    def test_non_cpu_grad_rejected(self, spmd8):
+        """Host-only scope (optimizer.py module docstring): a gradient on
+        any non-CPU device reaching _allreduce_grad_async must raise a clear
+        ValueError naming the device and the fix, not silently round-trip
+        (or corrupt) device memory. The meta device stands in for CUDA/XLA —
+        the guard is on device.type != 'cpu', so any accelerator device
+        takes the same path."""
+        import torch
+        import horovod_tpu.torch as hvd
+        model = torch.nn.Linear(4, 2)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        p = model.weight
+        meta_p = torch.nn.Parameter(torch.empty(2, 4, device="meta"))
+        meta_p.grad = torch.empty(2, 4, device="meta")
+        opt._param_names[id(meta_p)] = "meta.weight"
+        with pytest.raises(ValueError, match="host-only.*meta"):
+            opt._allreduce_grad_async(meta_p)
+        # CPU grads still pass the guard (full path covered by the training
+        # tests above).
+        p.grad = torch.zeros_like(p)
+        handle, _ctx = opt._allreduce_grad_async(p)
+        assert handle is not None
+
     def test_predivide_requires_average(self, spmd8):
         import torch
         import horovod_tpu.torch as hvd
