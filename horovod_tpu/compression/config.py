@@ -46,6 +46,13 @@ def make_compressor(name: str, bits: int = 4,
         return BF16Compressor
     if name == "maxmin":
         return MaxMinQuantizer(bits=bits, bucket_size=bucket_size)
+    # Wire-mode aliases (native/compressed.h WireCompression): the same
+    # HVDTPU_COMPRESSION value drives the process-mode wire and the JAX
+    # path — int8/int4 are max-min quantizers at a pinned bit width.
+    if name == "int8":
+        return MaxMinQuantizer(bits=8, bucket_size=bucket_size)
+    if name == "int4":
+        return MaxMinQuantizer(bits=4, bucket_size=bucket_size)
     if name == "uni":
         return NormalizedQuantizer(bits=bits, bucket_size=bucket_size,
                                    levels="uni", norm=norm)
@@ -133,7 +140,9 @@ def from_env() -> Optional[CompressionConfig]:
         return CompressionConfig.load(cfg_file, reduction=reduction,
                                       error_feedback=error_feedback,
                                       norm=norm)
-    if not name or name.lower() == "none":
+    if not name or name.lower() in ("none", "auto"):
+        # "auto" is wire-only: the native data plane's Bayesian autotuner
+        # owns the choice there; the JAX path has no autotuned equivalent.
         return None
     comp = make_compressor(
         name,

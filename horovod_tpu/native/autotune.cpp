@@ -181,24 +181,29 @@ void ParameterManager::Initialize(double cycle_time_ms,
                                   int64_t fusion_threshold, bool cache_enabled,
                                   int64_t algo_crossover, bool tune_crossover,
                                   bool hier_enabled, bool tune_hier,
+                                  int32_t wire_compression,
+                                  bool tune_compression,
                                   const std::string& log_path,
                                   int warmup_samples, int cycles_per_sample,
                                   int max_samples, double gp_noise) {
   current_ = {cycle_time_ms, fusion_threshold, cache_enabled, algo_crossover,
-              hier_enabled};
+              hier_enabled, wire_compression};
   tune_crossover_ = tune_crossover;
   tune_hier_ = tune_hier;
+  tune_compression_ = tune_compression;
   warmup_samples_ = warmup_samples;
   warmup_left_ = warmup_samples;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
-  opt_ = BayesianOptimizer(3 + (tune_crossover ? 1 : 0) + (tune_hier ? 1 : 0),
+  opt_ = BayesianOptimizer(3 + (tune_crossover ? 1 : 0) + (tune_hier ? 1 : 0) +
+                               (tune_compression ? 1 : 0),
                            gp_noise);
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_ != nullptr) {
       fputs("cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
-            "algo_crossover_bytes,hier_enabled,score_bytes_per_sec\n",
+            "algo_crossover_bytes,hier_enabled,wire_compression,"
+            "score_bytes_per_sec\n",
             log_);
     }
   }
@@ -226,6 +231,11 @@ std::vector<double> ParameterManager::ToVector(const Params& p) const {
         ToUnit(static_cast<double>(p.algo_crossover), kCrossMin, kCrossMax));
   }
   if (tune_hier_) x.push_back(p.hier_enabled ? 1.0 : 0.0);
+  if (tune_compression_) {
+    // 3-way categorical {none, fp16, int8} mapped onto [0, 1] at
+    // {0, 0.5, 1}; the sweep explores continuously, SetFromVector rounds.
+    x.push_back(static_cast<double>(p.wire_compression) / 2.0);
+  }
   return x;
 }
 
@@ -248,16 +258,24 @@ void ParameterManager::SetFromVector(const std::vector<double>& x) {
     // Categorical like the cache switch: explored continuously, thresholded
     // here (reference: CategoricalParameter, parameter_manager.h:225).
     current_.hier_enabled = x[next] >= 0.5;
+    ++next;
+  }
+  if (tune_compression_ && x.size() > next) {
+    int32_t comp = static_cast<int32_t>(std::llround(x[next] * 2.0));
+    if (comp < 0) comp = 0;
+    if (comp > 2) comp = 2;
+    current_.wire_compression = comp;  // 0 none, 1 fp16, 2 int8
   }
 }
 
 void ParameterManager::LogSample(double score) {
   if (log_ == nullptr) return;
-  fprintf(log_, "%.3f,%lld,%d,%lld,%d,%.1f\n", current_.cycle_time_ms,
+  fprintf(log_, "%.3f,%lld,%d,%lld,%d,%d,%.1f\n", current_.cycle_time_ms,
           static_cast<long long>(current_.fusion_threshold),
           current_.cache_enabled ? 1 : 0,
           static_cast<long long>(current_.algo_crossover),
-          current_.hier_enabled ? 1 : 0, score);
+          current_.hier_enabled ? 1 : 0,
+          static_cast<int>(current_.wire_compression), score);
   fflush(log_);
 }
 

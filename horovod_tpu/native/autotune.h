@@ -92,6 +92,11 @@ class ParameterManager {
     // categorical on/off dimension like the cache switch (reference analog:
     // hierarchical_allreduce in BayesianParameter, parameter_manager.h:186).
     bool hier_enabled;
+    // Wire compression under HVDTPU_COMPRESSION=auto (compressed.h
+    // WireCompression): a 3-way categorical dimension over {none, fp16,
+    // int8} — int4 is excluded from the automatic menu (its accuracy cost
+    // is a modelling decision, not a throughput knob; force it explicitly).
+    int32_t wire_compression;
   };
 
   // tune_crossover: include the algo crossover as an extra GP dimension
@@ -100,9 +105,13 @@ class ParameterManager {
   // budget; the value is then held constant at algo_crossover. tune_hier:
   // include the hierarchical switch only when HVDTPU_ALLREDUCE_HIER=auto
   // AND the topology is non-trivial (multiple hosts, multi-rank hosts).
+  // tune_compression: include the wire-compression categorical only when
+  // HVDTPU_COMPRESSION=auto — with a pinned mode the coordinate is inert
+  // and would dilute the sample budget, like the crossover/hier gates.
   void Initialize(double cycle_time_ms, int64_t fusion_threshold,
                   bool cache_enabled, int64_t algo_crossover,
                   bool tune_crossover, bool hier_enabled, bool tune_hier,
+                  int32_t wire_compression, bool tune_compression,
                   const std::string& log_path,
                   int warmup_samples, int cycles_per_sample, int max_samples,
                   double gp_noise);
@@ -128,7 +137,8 @@ class ParameterManager {
   bool frozen_ = false;
   bool tune_crossover_ = true;
   bool tune_hier_ = false;
-  Params current_{1.0, 64 << 20, true, 32 << 10, false};
+  bool tune_compression_ = false;
+  Params current_{1.0, 64 << 20, true, 32 << 10, false, 0};
   BayesianOptimizer opt_{4};
   int warmup_samples_ = 3;
   int cycles_per_sample_ = 50;

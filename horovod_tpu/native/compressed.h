@@ -1,0 +1,103 @@
+// Wire compression for the process-mode data plane.
+//
+// Reference: the IST-DASLab fork's horovod/common/ops/compressed/ subsystem —
+// CPUMaxMinQuantizer (compressor.h:168): bucket-wise uniform max-min
+// quantization of fp32 payloads to b bits with per-bucket (min, unit)
+// headers, plus error-feedback residuals accumulated at the compressing rank
+// (compressor.cc ApplyErrorFeedback). This rebuild keeps the reference wire
+// semantics byte-compatible with the JAX-level quantizer
+// (horovod_tpu/compression/quantize.py MaxMinQuantizer, bucket 512): same
+// bucket size, same (min, unit) encoding, same round-to-nearest-even codes —
+// tests/test_wire_compression.py pins the two implementations to each other.
+//
+// The data plane (data_plane.cpp) uses these kernels for "compressed hops":
+// each ring / recursive-doubling exchange ships the quantized form, the
+// receiver dequantizes and reduces in fp32, and the next hop re-quantizes
+// (with the residual applied wherever data is quantized).
+//
+// Wire layout for a block of `count` fp32 elements:
+//   FP16: count x uint16 IEEE half codes (round-to-nearest-even, the PR-1
+//         F16C/RNE kernels).
+//   INT8 / INT4: ceil(count/512) per-bucket headers (fp32 min, fp32 unit,
+//         8 bytes each), then the codes — 1 byte per element (int8) or two
+//         elements per byte, low nibble first (int4, matching
+//         quantize.py pack_bits bit order). A short tail bucket is treated
+//         as zero-padded to 512 for the min/max scan (quantize.py
+//         _bucketize parity), but padding codes are never stored.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+// Matches the Python surface (envvars.WIRE_COMPRESSION_MODES) and the
+// hvdtpu_set_compression C API. AUTO is a config-only value: the Bayesian
+// autotuner owns the effective choice (none/fp16/int8) and broadcasts it via
+// PARAMS; the data plane only ever sees a concrete mode.
+enum class WireCompression : int32_t {
+  NONE = 0,
+  FP16 = 1,
+  INT8 = 2,
+  INT4 = 3,
+  AUTO = 4,
+};
+
+// Matches compression/quantize.py DEFAULT_BUCKET_SIZE (reference:
+// compressor.h:11).
+constexpr int64_t kWireBucketSize = 512;
+
+const char* WireCompressionName(WireCompression c);
+
+// Bytes on the wire for `count` fp32 elements under mode `c` (NONE/AUTO:
+// the raw 4 * count).
+int64_t WireBytes(WireCompression c, int64_t count);
+
+// Compress `count` fp32 elements into `dst` (WireBytes(c, count) bytes).
+//
+// residual (optional, `count` floats): error feedback — each element is
+// quantized as x = src[i] + residual[i] and the new residual is x minus its
+// dequantized value (reference: error feedback accumulated at the
+// compressing rank).
+//
+// self_decode (optional, `count` floats, MAY alias src): receives the
+// dequantized values, so a rank can replace its own copy with exactly what
+// peers will decode — cross-rank bitwise consistency for the compressed
+// collectives.
+//
+// c must be a concrete mode (not NONE/AUTO).
+void WireCompress(WireCompression c, const float* src, int64_t count,
+                  uint8_t* dst, float* residual, float* self_decode);
+
+// dst[i] = decoded[i].
+void WireDecompress(WireCompression c, const uint8_t* src, int64_t count,
+                    float* dst);
+
+// dst[i] += decoded[i] (the fused decompress-and-reduce used by the
+// compressed reduce-scatter hops; fp32 accumulation).
+void WireDecompressAdd(WireCompression c, const uint8_t* src, int64_t count,
+                       float* dst);
+
+// Per-tensor error-feedback residual buffers, keyed by the (fused) op's
+// name signature. Local to the compressing rank; nothing is negotiated.
+class ResidualStore {
+ public:
+  // The residual buffer for `key`, zero-initialized when new or when the
+  // element count changed (a refused fusion or reshape drops the residual —
+  // best-effort, like the reference's per-entry feedback buffers).
+  // Bounded: fusion composition is timing-dependent (and the autotuner
+  // varies the threshold), so distinct keys can proliferate — past
+  // kMaxEntries the store resets rather than leak a full-size fp32 buffer
+  // per stale signature (EF restarts from zero; it is best-effort state).
+  float* Get(const std::string& key, int64_t count);
+  size_t size() const { return buf_.size(); }
+
+  static constexpr size_t kMaxEntries = 256;
+
+ private:
+  std::unordered_map<std::string, std::vector<float>> buf_;
+};
+
+}  // namespace hvdtpu

@@ -28,6 +28,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <regex>
 #include <set>
 #include <thread>
 #include <unordered_map>
@@ -36,6 +37,7 @@
 #include "autotune.h"
 #include "sha256.h"
 #include "common.h"
+#include "compressed.h"
 #include "data_plane.h"
 #include "message.h"
 #include "socket_util.h"
@@ -263,6 +265,14 @@ struct CoreConfig {
   int32_t shm_enabled = 1;
   int64_t shm_ring_bytes = 0;
   int32_t allreduce_hier = 2;
+  // Wire compression (HVDTPU_COMPRESSION; compressed.h WireCompression:
+  // 0 none, 1 fp16, 2 int8, 3 int4, 4 auto/autotuned). Applies to fp32
+  // SUM/AVERAGE allreduces at or above min_bytes whose tensor names all
+  // miss the skip regex (biases/norms stay dense, reference: the fork's
+  // per-layer ignore rules).
+  int32_t wire_compression = 0;
+  int64_t compression_min_bytes = 1024;
+  std::string compression_skip_regex;
 };
 
 class Core {
@@ -296,6 +306,12 @@ class Core {
   // Current (possibly autotuned) loop parameters, for tests/introspection.
   double CurrentCycleTimeMs();
   int64_t CurrentFusionThreshold();
+  // Cumulative data-plane payload accounting (atomics in the data plane —
+  // safe to read from user threads while ops run).
+  void WireStats(int64_t* raw_bytes, int64_t* wire_bytes) {
+    *raw_bytes = data_plane_.total_raw_bytes();
+    *wire_bytes = data_plane_.total_wire_bytes();
+  }
   CoreConfig* mutable_config() { return &cfg_; }  // pre-Start() only
 
  private:
@@ -312,13 +328,34 @@ class Core {
   void ExecuteResponseList(const std::vector<Response>& list);
   void ExecuteResponse(const Response& resp);
   void ExecuteFusedAllreduce(const Response& resp,
-                             std::vector<TensorEntry*>& entries);
+                             std::vector<TensorEntry*>& entries,
+                             WireCompression comp);
   void CompleteEntry(TensorEntry* e, const Status& st);
   void CheckStalls();
+  // Effective wire compression for one negotiated allreduce: the configured
+  // (or autotuned) mode, gated on dtype fp32, op SUM/AVERAGE, total payload
+  // >= compression_min_bytes, and no tensor name matching the skip regex.
+  // Every input is identical on every rank (the mode arrives in config or a
+  // PARAMS frame, the rest comes from the broadcast Response), so all ranks
+  // resolve the same answer — a split would desynchronize the wire format.
+  WireCompression EffectiveCompression(const Response& resp,
+                                       int64_t total_bytes);
 
   CoreConfig cfg_;
   DataPlane data_plane_;
   Timeline timeline_;
+
+  // Wire-compression state: error-feedback residuals per (fused) tensor,
+  // the compiled skip regex (with a per-name verdict memo — regex_search
+  // is microseconds per call and the same tensor names recur every cycle
+  // on the serialized collective thread), and the autotuner's current
+  // choice under HVDTPU_COMPRESSION=auto (background thread only — both
+  // the worker PARAMS handler and the coordinator adoption run there).
+  ResidualStore residual_store_;
+  std::regex comp_skip_re_;
+  bool comp_skip_set_ = false;
+  std::unordered_map<std::string, bool> comp_skip_memo_;
+  int32_t comp_auto_ = 0;
 
   // Control plane.
   int coord_listen_fd_ = -1;           // rank 0
@@ -425,6 +462,21 @@ Status Core::Start() {
   data_plane_.set_shm_enabled(cfg_.shm_enabled != 0);
   data_plane_.set_shm_ring_bytes(cfg_.shm_ring_bytes);
   data_plane_.set_hier_mode(static_cast<HierMode>(cfg_.allreduce_hier));
+  // Wire-compression skip list (Python validates the pattern too; a bad
+  // regex smuggled past it must fail loudly, not silently compress biases).
+  comp_skip_set_ = false;
+  if (!cfg_.compression_skip_regex.empty()) {
+    try {
+      comp_skip_re_ = std::regex(cfg_.compression_skip_regex,
+                                 std::regex::icase | std::regex::nosubs);
+      comp_skip_set_ = true;
+    } catch (const std::regex_error& e) {
+      return Status::Error(StatusCode::INVALID_ARGUMENT,
+                           std::string("bad HVDTPU_COMPRESSION_SKIP_REGEX: ") +
+                               e.what());
+    }
+  }
+  comp_auto_ = 0;  // HVDTPU_COMPRESSION=auto starts dense until tuned
   // (Re)create the wake pipe. The previous pipe, if any, is closed only
   // here and in the destructor — never in Shutdown — so a user thread's
   // Wake() racing a concurrent Shutdown can at worst write one byte into a
@@ -581,12 +633,19 @@ Status Core::Start() {
     const bool tune_hier = cfg_.allreduce_hier == 2 &&
                            data_plane_.num_hosts() > 1 &&
                            data_plane_.num_hosts() < cfg_.size;
+    // The compression categorical joins the GP only under
+    // HVDTPU_COMPRESSION=auto (a pinned mode makes the coordinate inert).
+    const bool tune_comp =
+        cfg_.wire_compression ==
+            static_cast<int32_t>(WireCompression::AUTO) &&
+        cfg_.size > 1;
     param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
                               cfg_.cache_capacity > 0,
                               data_plane_.crossover_bytes(),
                               data_plane_.allreduce_algo() ==
                                   AllreduceAlgo::AUTO,
                               /*hier_enabled=*/false, tune_hier,
+                              /*wire_compression=*/0, tune_comp,
                               cfg_.autotune_log, cfg_.autotune_warmup_samples,
                               cfg_.autotune_cycles_per_sample,
                               cfg_.autotune_max_samples,
@@ -907,6 +966,7 @@ void Core::PumpControlPlane() {
         bool cache_on = r.I32() != 0;
         int64_t crossover = r.I64();
         bool hier_on = r.I32() != 0;
+        int32_t comp = r.I32();
         if (!r.ok()) {
           LogBadFrame(cfg_.rank, "worker PARAMS", frame);
           continue;
@@ -914,6 +974,7 @@ void Core::PumpControlPlane() {
         // data_plane_ is driven by this (background) thread only.
         data_plane_.set_crossover_bytes(crossover);
         data_plane_.set_hier_auto(hier_on);
+        comp_auto_ = comp;
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = cycle;
         cfg_.fusion_threshold = fusion;
@@ -1358,6 +1419,7 @@ void Core::CoordinatorEmitResponses() {
       ParameterManager::Params p = param_manager_.Current();
       data_plane_.set_crossover_bytes(p.algo_crossover);
       data_plane_.set_hier_auto(p.hier_enabled);
+      comp_auto_ = p.wire_compression;
       {
         std::lock_guard<std::mutex> lk(mu_);
         cfg_.cycle_time_ms = p.cycle_time_ms;
@@ -1372,6 +1434,7 @@ void Core::CoordinatorEmitResponses() {
         w.I32(p.cache_enabled ? 1 : 0);
         w.I64(p.algo_crossover);
         w.I32(p.hier_enabled ? 1 : 0);
+        w.I32(p.wire_compression);
         std::vector<uint8_t> payload = w.Take();
         for (int rank = 1; rank < cfg_.size; ++rank) {
           if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
@@ -1457,10 +1520,20 @@ void Core::ExecuteResponse(const Response& resp) {
   }
 
   // Transport tag per op (timeline arg): which lane mix carried it, and
-  // whether the allreduce took the hierarchical two-level path.
+  // whether the allreduce took the hierarchical two-level path. The
+  // compression tag sits next to it: the effective wire mode resolved for
+  // this (fused) allreduce — identical on every rank (see
+  // EffectiveCompression).
   std::string lane = data_plane_.transport_label();
-  if (resp.op_type == OpType::ALLREDUCE && data_plane_.hier_active()) {
-    lane += "+hier";
+  WireCompression comp = WireCompression::NONE;
+  if (resp.op_type == OpType::ALLREDUCE) {
+    if (data_plane_.hier_active()) lane += "+hier";
+    int64_t total_bytes = 0;
+    for (const auto& s : resp.shapes) {
+      total_bytes +=
+          NumElements(s) * static_cast<int64_t>(DataTypeSize(resp.dtype));
+    }
+    comp = EffectiveCompression(resp, total_bytes);
   }
   for (auto* e : entries) {
     timeline_.ActivityStart(
@@ -1470,7 +1543,8 @@ void Core::ExecuteResponse(const Response& resp) {
         : resp.op_type == OpType::BROADCAST ? "BROADCAST"
         : resp.op_type == OpType::ALLTOALL ? "ALLTOALL"
                                             : "REDUCESCATTER",
-        lane);
+        lane,
+        resp.op_type == OpType::ALLREDUCE ? WireCompressionName(comp) : "");
   }
 
   Status st = Status::OK();
@@ -1479,7 +1553,7 @@ void Core::ExecuteResponse(const Response& resp) {
       // Completion AND timeline finalization happen inside: once
       // CompleteEntry runs, the user thread may CopyResult and free the
       // entry, so nothing here may touch `entries` afterwards.
-      ExecuteFusedAllreduce(resp, entries);
+      ExecuteFusedAllreduce(resp, entries, comp);
       return;
     }
     case OpType::ALLGATHER: {
@@ -1624,13 +1698,65 @@ void ScaleBuffer(void* data, int64_t count, DataType dtype, double factor) {
 
 }  // namespace
 
+WireCompression Core::EffectiveCompression(const Response& resp,
+                                           int64_t total_bytes) {
+  int32_t mode = cfg_.wire_compression;
+  if (mode == static_cast<int32_t>(WireCompression::AUTO)) mode = comp_auto_;
+  if (mode == static_cast<int32_t>(WireCompression::NONE)) {
+    return WireCompression::NONE;
+  }
+  if (resp.dtype != DataType::FLOAT32) return WireCompression::NONE;
+  if (resp.op_type != OpType::ALLREDUCE) return WireCompression::NONE;
+  // Adasum's adaptive combine needs the exact partials; MIN/MAX/PRODUCT
+  // have no meaningful quantized-sum form. reduce_op is per-response (all
+  // fused entries share it).
+  if (resp.reduce_op != ReduceOp::SUM &&
+      resp.reduce_op != ReduceOp::AVERAGE) {
+    return WireCompression::NONE;
+  }
+  // Small-tensor bypass: below this size the quantization headers and the
+  // extra passes cost more than the bytes they save.
+  if (total_bytes < cfg_.compression_min_bytes) return WireCompression::NONE;
+  // Sensitive-layer skip list (biases/norms): one match anywhere in the
+  // fused batch keeps the whole op dense — the batch shares a wire format.
+  if (comp_skip_set_) {
+    for (const auto& name : resp.names) {
+      auto it = comp_skip_memo_.find(name);
+      if (it == comp_skip_memo_.end()) {
+        if (comp_skip_memo_.size() >= 4096) comp_skip_memo_.clear();
+        it = comp_skip_memo_
+                 .emplace(name, std::regex_search(name, comp_skip_re_))
+                 .first;
+      }
+      if (it->second) return WireCompression::NONE;
+    }
+  }
+  return static_cast<WireCompression>(mode);
+}
+
 void Core::ExecuteFusedAllreduce(const Response& resp,
-                                 std::vector<TensorEntry*>& entries) {
+                                 std::vector<TensorEntry*>& entries,
+                                 WireCompression comp) {
   // Reference: fused MemcpyInFusionBuffer -> collective -> MemcpyOut
   // (collective_operations.cc + mpi_operations.cc).
   size_t elem = DataTypeSize(resp.dtype);
   int64_t total_elems = 0;
   for (const auto& s : resp.shapes) total_elems += NumElements(s);
+
+  // Error-feedback residuals live at the compressing rank, keyed by the
+  // fused batch's name signature (steady-state fusions reuse the buffer;
+  // a changed composition starts fresh — best-effort, like the reference's
+  // per-entry feedback buffers).
+  float* residual = nullptr;
+  if (comp != WireCompression::NONE) {
+    std::string key = resp.names.empty() ? std::string() : resp.names[0];
+    for (size_t i = 1; i < resp.names.size(); ++i) {
+      key += ';';
+      key += resp.names[i];
+    }
+    residual = residual_store_.Get(key, total_elems);
+  }
+  data_plane_.BeginCompressedOp(comp, residual);
 
   if (entries.size() == 1) {
     // Unfused: the entry's output buffer IS the working buffer — one big
@@ -1655,11 +1781,14 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
       st = data_plane_.Allreduce(e->output.data(), total_elems, resp.dtype,
                                  resp.reduce_op);
     }
+    data_plane_.EndCompressedOp();
     if (st.ok()) {
       ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->postscale);
     }
     timeline_.ActivityEnd(e->name);
-    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason);
+    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason,
+                     data_plane_.op_raw_bytes(),
+                     data_plane_.op_wire_bytes());
     if (e->handle >= 0) CompleteEntry(e, st);
     return;
   }
@@ -1685,6 +1814,9 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     st = data_plane_.Allreduce(fusion.data(), total_elems, resp.dtype,
                                resp.reduce_op);
   }
+  data_plane_.EndCompressedOp();
+  const int64_t op_raw = data_plane_.op_raw_bytes();
+  const int64_t op_wire = data_plane_.op_wire_bytes();
 
   off = 0;
   for (size_t i = 0; i < entries.size(); ++i) {
@@ -1699,7 +1831,7 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     // Timeline events BEFORE CompleteEntry: completion hands ownership to
     // the user thread, which may free the entry immediately.
     timeline_.ActivityEnd(e->name);
-    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason);
+    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason, op_raw, op_wire);
     if (e->handle >= 0) CompleteEntry(e, st);
   }
 }
@@ -1901,6 +2033,59 @@ int hvdtpu_set_transport(void* core, int shm_enabled,
 
 int hvdtpu_set_stall_shutdown(void* core, double secs) {
   static_cast<Core*>(core)->mutable_config()->stall_shutdown_secs = secs;
+  return 0;
+}
+
+// Wire compression for the native data plane (compressed.h): mode 0 none,
+// 1 fp16, 2 int8, 3 int4, 4 auto (autotuner-owned categorical). min_bytes
+// is the small-tensor bypass (< 0 keeps the default); skip_regex a
+// case-insensitive regex over tensor names that keeps matching ops dense
+// (empty/null = no skip list). Pre-Start() only.
+int hvdtpu_set_compression(void* core, int mode, long long min_bytes,
+                           const char* skip_regex) {
+  if (mode < 0 || mode > 4) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->wire_compression = mode;
+  if (min_bytes >= 0) cfg->compression_min_bytes = min_bytes;
+  cfg->compression_skip_regex = skip_regex ? skip_regex : "";
+  return 0;
+}
+
+// Cumulative bytes-on-wire accounting for this rank's allreduce payloads:
+// raw = what the data plane would have sent uncompressed, wire = what it
+// actually sent (equal when compression is off). The per-op values ride the
+// timeline (docs/timeline.md raw_bytes/wire_bytes).
+void hvdtpu_wire_stats(void* core, long long* raw_bytes,
+                       long long* wire_bytes) {
+  int64_t raw = 0, wire = 0;
+  static_cast<Core*>(core)->WireStats(&raw, &wire);
+  if (raw_bytes != nullptr) *raw_bytes = raw;
+  if (wire_bytes != nullptr) *wire_bytes = wire;
+}
+
+// Standalone quantizer entry points (no core instance needed): the
+// cross-implementation parity tests pin these against the JAX-level
+// MaxMinQuantizer (compression/quantize.py) — same bucket-512 (min, unit)
+// encoding, same codes. `residual` (nullable, count floats) applies and
+// updates error feedback exactly like the data plane's compressed hops.
+long long hvdtpu_wire_compressed_bytes(int mode, long long count) {
+  if (mode < 0 || mode > 4 || count < 0) return -1;
+  return hvdtpu::WireBytes(static_cast<hvdtpu::WireCompression>(mode), count);
+}
+
+int hvdtpu_wire_compress(int mode, const float* src, long long count,
+                         unsigned char* dst, float* residual) {
+  if (mode <= 0 || mode > 3 || count < 0) return -1;
+  hvdtpu::WireCompress(static_cast<hvdtpu::WireCompression>(mode), src, count,
+                       dst, residual, nullptr);
+  return 0;
+}
+
+int hvdtpu_wire_decompress(int mode, const unsigned char* src,
+                           long long count, float* dst) {
+  if (mode <= 0 || mode > 3 || count < 0) return -1;
+  hvdtpu::WireDecompress(static_cast<hvdtpu::WireCompression>(mode), src,
+                         count, dst);
   return 0;
 }
 

@@ -619,9 +619,15 @@ int GroupIndex(const std::vector<int>& group, int rank) {
 
 Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
                             ReduceOp op) {
+  op_raw_bytes_ = 0;
+  op_wire_bytes_ = 0;
   if (size_ == 1 || count == 0) return Status::OK();
-  if (hier_active()) return HierarchicalAllreduce(data, count, dtype, op);
-  return AllreduceGroup(data, count, dtype, op, world_group_);
+  Status st = hier_active()
+                  ? HierarchicalAllreduce(data, count, dtype, op)
+                  : AllreduceGroup(data, count, dtype, op, world_group_);
+  total_raw_bytes_ += op_raw_bytes_;
+  total_wire_bytes_ += op_wire_bytes_;
+  return st;
 }
 
 Status DataPlane::AllreduceGroup(void* data, int64_t count, DataType dtype,
@@ -635,14 +641,175 @@ Status DataPlane::AllreduceGroup(void* data, int64_t count, DataType dtype,
   }
   switch (algo) {
     case AllreduceAlgo::RECURSIVE_DOUBLING:
+      if (CompressionActive(dtype, op)) {
+        return CompressedRecursiveDoubling(static_cast<float*>(data), count,
+                                          group);
+      }
       return RecursiveDoublingGroup(data, count, dtype, op, group);
     case AllreduceAlgo::TREE:
+      // The tree path stays raw (all ranks resolve the same algo, so the
+      // schedule cannot split): its reduce/broadcast edges are one-way and
+      // would re-quantize log2(p) times with no bandwidth structure to
+      // exploit — compression covers ring + recursive doubling.
       return TreeAllreduceGroup(data, count, dtype, op, group);
     case AllreduceAlgo::AUTO:
     case AllreduceAlgo::RING:
       break;
   }
+  if (CompressionActive(dtype, op)) {
+    const int gi = GroupIndex(group, rank_);
+    std::vector<int64_t> starts =
+        ChunkStarts(count, static_cast<int>(group.size()));
+    float* buf = static_cast<float*>(data);
+    Status st = CompressedRingReduceScatter(buf, starts, group, gi);
+    if (!st.ok()) return st;
+    return CompressedRingAllgather(buf, starts, group, gi);
+  }
   return RingAllreduceGroup(data, count, dtype, op, group);
+}
+
+Status DataPlane::CompressedRingReduceScatter(
+    float* buf, const std::vector<int64_t>& starts,
+    const std::vector<int>& group, int gi) {
+  const WireCompression c = op_comp_;
+  const int gs = static_cast<int>(group.size());
+  const int right = group[(gi + 1) % gs];
+  const int left = group[(gi - 1 + gs) % gs];
+  auto chunk_count = [&](int ch) { return starts[ch + 1] - starts[ch]; };
+  int64_t max_chunk = 0;
+  for (int ch = 0; ch < gs; ++ch) {
+    max_chunk = std::max(max_chunk, chunk_count(ch));
+  }
+  std::vector<uint8_t> send_wire(static_cast<size_t>(WireBytes(c, max_chunk)));
+  std::vector<uint8_t> recv_wire(send_wire.size());
+
+  // Same schedule as the raw reduce-scatter: at step s send chunk (gi - s),
+  // receive chunk (gi - s - 1) — but each hop ships the quantized form and
+  // the receiver dequantizes + accumulates in fp32. Every chunk is
+  // compressed exactly once per rank per op, so the error-feedback residual
+  // region [starts[c], starts[c+1]) is consumed and rewritten once.
+  for (int s = 0; s < gs - 1; ++s) {
+    const int send_c = ((gi - s) % gs + gs) % gs;
+    const int recv_c = ((gi - s - 1) % gs + gs) % gs;
+    const int64_t sc = chunk_count(send_c);
+    const int64_t rc = chunk_count(recv_c);
+    const int64_t sw = WireBytes(c, sc);
+    const int64_t rw = WireBytes(c, rc);
+    WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
+                 op_residual_ != nullptr ? op_residual_ + starts[send_c]
+                                         : nullptr,
+                 nullptr);
+    AddOpBytes(sc * 4, sw);
+    Status st = Exchange(right, send_wire.data(), sw, left, recv_wire.data(),
+                         rw);
+    if (!st.ok()) return st;
+    WireDecompressAdd(c, recv_wire.data(), rc, buf + starts[recv_c]);
+  }
+  return Status::OK();
+}
+
+Status DataPlane::CompressedRingAllgather(float* buf,
+                                          const std::vector<int64_t>& starts,
+                                          const std::vector<int>& group,
+                                          int gi) {
+  const WireCompression c = op_comp_;
+  const int gs = static_cast<int>(group.size());
+  const int right = group[(gi + 1) % gs];
+  const int left = group[(gi - 1 + gs) % gs];
+  auto chunk_count = [&](int ch) { return starts[ch + 1] - starts[ch]; };
+  int64_t max_chunk = 0;
+  for (int ch = 0; ch < gs; ++ch) {
+    max_chunk = std::max(max_chunk, chunk_count(ch));
+  }
+  std::vector<uint8_t> cur(static_cast<size_t>(WireBytes(c, max_chunk)));
+  std::vector<uint8_t> next(cur.size());
+
+  // The owner quantizes its fully reduced chunk once (residual applied,
+  // own copy replaced by the dequantized values); every later hop forwards
+  // those wire bytes verbatim, so the whole group decodes identical codes
+  // and the final vectors agree bitwise.
+  const int own_c = (gi + 1) % gs;
+  WireCompress(c, buf + starts[own_c], chunk_count(own_c), cur.data(),
+               op_residual_ != nullptr ? op_residual_ + starts[own_c]
+                                       : nullptr,
+               buf + starts[own_c]);
+  for (int s = 0; s < gs - 1; ++s) {
+    const int send_c = ((gi + 1 - s) % gs + gs) % gs;
+    const int recv_c = ((gi - s) % gs + gs) % gs;
+    const int64_t sw = WireBytes(c, chunk_count(send_c));
+    const int64_t rw = WireBytes(c, chunk_count(recv_c));
+    AddOpBytes(chunk_count(send_c) * 4, sw);
+    Status st = Exchange(right, cur.data(), sw, left, next.data(), rw);
+    if (!st.ok()) return st;
+    WireDecompress(c, next.data(), chunk_count(recv_c),
+                   buf + starts[recv_c]);
+    cur.swap(next);
+  }
+  return Status::OK();
+}
+
+Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
+                                              const std::vector<int>& group) {
+  const WireCompression c = op_comp_;
+  const int gs = static_cast<int>(group.size());
+  const int gi = GroupIndex(group, rank_);
+  const int64_t raw_bytes = count * 4;
+  const int64_t wb = WireBytes(c, count);
+  std::vector<uint8_t> send_wire(static_cast<size_t>(wb));
+  std::vector<uint8_t> recv_wire(static_cast<size_t>(wb));
+
+  int p = 1;
+  while (p * 2 <= gs) p *= 2;
+  const int r = gs - p;
+
+  // Fold: extra members ship their contribution quantized (uplink), the
+  // partner dequantizes + accumulates.
+  if (gi >= p) {
+    WireCompress(c, data, count, send_wire.data(), op_residual_, nullptr);
+    AddOpBytes(raw_bytes, wb);
+    if (transports_[group[gi - p]]->Send(send_wire.data(),
+                                         static_cast<size_t>(wb)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "rd fold send failed");
+    }
+  } else if (gi < r) {
+    if (transports_[group[gi + p]]->Recv(recv_wire.data(),
+                                         static_cast<size_t>(wb)) != 0) {
+      return Status::Error(StatusCode::ABORTED, "rd fold recv failed");
+    }
+    WireDecompressAdd(c, recv_wire.data(), count, data);
+  }
+
+  if (gi < p) {
+    for (int distance = 1; distance < p; distance *= 2) {
+      const int peer = group[gi ^ distance];
+      // Self-decode into `data`: both sides of the pair end up with
+      // deQ(mine) + deQ(theirs) — bitwise identical by commutativity.
+      WireCompress(c, data, count, send_wire.data(), op_residual_, data);
+      AddOpBytes(raw_bytes, wb);
+      Status st = Exchange(peer, send_wire.data(), wb, peer,
+                           recv_wire.data(), wb);
+      if (!st.ok()) return st;
+      WireDecompressAdd(c, recv_wire.data(), count, data);
+    }
+  }
+
+  // Unfold: the final vector travels RAW so folded ranks hold exactly the
+  // main group's bytes (one uncompressed hop, non-power-of-two worlds only).
+  if (gi < r) {
+    AddOpBytes(raw_bytes, raw_bytes);
+    if (transports_[group[gi + p]]->Send(data,
+                                         static_cast<size_t>(raw_bytes)) !=
+        0) {
+      return Status::Error(StatusCode::ABORTED, "rd unfold send failed");
+    }
+  } else if (gi >= p) {
+    if (transports_[group[gi - p]]->Recv(data,
+                                         static_cast<size_t>(raw_bytes)) !=
+        0) {
+      return Status::Error(StatusCode::ABORTED, "rd unfold recv failed");
+    }
+  }
+  return Status::OK();
 }
 
 Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
@@ -675,6 +842,7 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
     int recv_c = ((gi - s - 1) % gs + gs) % gs;
     int64_t send_bytes = chunk_count(send_c) * static_cast<int64_t>(elem);
     int64_t recv_bytes = chunk_count(recv_c) * static_cast<int64_t>(elem);
+    AddOpBytes(send_bytes, send_bytes);
     if (recv_bytes >= 2 * seg) {
       uint8_t* dst = chunk_ptr(recv_c);
       Status st = Exchange(
@@ -709,8 +877,10 @@ Status DataPlane::RingAllgatherPhase(uint8_t* buf,
   for (int s = 0; s < gs - 1; ++s) {
     int send_c = ((gi + 1 - s) % gs + gs) % gs;
     int recv_c = ((gi - s) % gs + gs) % gs;
-    Status st = Exchange(right, chunk_ptr(send_c),
-                         chunk_count(send_c) * static_cast<int64_t>(elem),
+    const int64_t send_bytes =
+        chunk_count(send_c) * static_cast<int64_t>(elem);
+    AddOpBytes(send_bytes, send_bytes);
+    Status st = Exchange(right, chunk_ptr(send_c), send_bytes,
                          left, chunk_ptr(recv_c),
                          chunk_count(recv_c) * static_cast<int64_t>(elem));
     if (!st.ok()) return st;
@@ -747,6 +917,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
   const int r = gs - p;
 
   if (gi >= p) {
+    AddOpBytes(bytes, bytes);
     if (transports_[group[gi - p]]->Send(data, static_cast<size_t>(bytes)) !=
         0) {
       return Status::Error(StatusCode::ABORTED, "rd fold send failed");
@@ -762,6 +933,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
   if (gi < p) {
     for (int distance = 1; distance < p; distance *= 2) {
       int peer = group[gi ^ distance];
+      AddOpBytes(bytes, bytes);
       Status st = Exchange(peer, data, bytes, peer, other.data(), bytes);
       if (!st.ok()) return st;
       ReduceBuffer(data, other.data(), count, dtype, op);
@@ -769,6 +941,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
   }
 
   if (gi < r) {
+    AddOpBytes(bytes, bytes);
     if (transports_[group[gi + p]]->Send(data, static_cast<size_t>(bytes)) !=
         0) {
       return Status::Error(StatusCode::ABORTED, "rd unfold send failed");
@@ -795,6 +968,7 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
   // send up and leave; the rest absorb a child (if present) and continue.
   for (int d = 1; d < gs; d <<= 1) {
     if (gi & d) {
+      AddOpBytes(bytes, bytes);
       if (transports_[group[gi - d]]->Send(data, static_cast<size_t>(bytes)) !=
           0) {
         return Status::Error(StatusCode::ABORTED, "tree reduce send failed");
@@ -824,6 +998,7 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
   }
   for (int d = lsb >> 1; d >= 1; d >>= 1) {
     if (gi + d < gs) {
+      AddOpBytes(bytes, bytes);
       if (transports_[group[gi + d]]->Send(data, static_cast<size_t>(bytes)) !=
           0) {
         return Status::Error(StatusCode::ABORTED, "tree bcast send failed");
@@ -878,6 +1053,7 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
         }
       } else {
         int c = owned(li);
+        AddOpBytes(chunk_bytes(c), chunk_bytes(c));
         if (chunk_bytes(c) > 0 &&
             transports_[local[0]]->Send(
                 chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
@@ -886,6 +1062,9 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
       }
     }
     if (li == 0) {
+      // The leader phase inherits the op's compression: the cross-host hop
+      // is the slow link the reference fork quantizes (intra-host shm
+      // stages stay dense).
       Status st = AllreduceGroup(data, count, dtype, op, leaders_);
       if (!st.ok()) return st;
     }
@@ -893,6 +1072,7 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
       if (li == 0) {
         for (int j = 1; j < L; ++j) {
           int c = owned(j);
+          AddOpBytes(chunk_bytes(c), chunk_bytes(c));
           if (chunk_bytes(c) > 0 &&
               transports_[local[j]]->Send(
                   chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
@@ -1006,6 +1186,8 @@ void AddInto(T* dst, const T* src, int64_t count) {
 }  // namespace
 
 Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
+  op_raw_bytes_ = 0;
+  op_wire_bytes_ = 0;
   if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64) {
     return Status::Error(StatusCode::INVALID_ARGUMENT,
                          "Adasum supports float32/float64 only, got " +
@@ -1021,6 +1203,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   const int r = size_ - p;
 
   auto exchange = [&](int peer) -> Status {
+    AddOpBytes(bytes, bytes);
     return Exchange(peer, data, bytes, peer, other.data(), bytes);
   };
   auto combine = [&](bool lower) {
@@ -1036,6 +1219,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
 
   // Fold extra ranks (>= p) into their partner by plain addition.
   if (rank_ >= p) {
+    AddOpBytes(bytes, bytes);
     if (transports_[rank_ - p]->Send(data, static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "adasum fold send failed");
     }
@@ -1064,6 +1248,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
 
   // Broadcast the result to the folded ranks.
   if (rank_ < r) {
+    AddOpBytes(bytes, bytes);
     if (transports_[rank_ + p]->Send(data, static_cast<size_t>(bytes)) != 0) {
       return Status::Error(StatusCode::ABORTED, "adasum unfold send failed");
     }
@@ -1072,6 +1257,8 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
       return Status::Error(StatusCode::ABORTED, "adasum unfold recv failed");
     }
   }
+  total_raw_bytes_ += op_raw_bytes_;
+  total_wire_bytes_ += op_wire_bytes_;
   return Status::OK();
 }
 

@@ -10,12 +10,14 @@
 // float (reference: half.{h,cc}).
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <memory>
 #include <string>
 #include <vector>
 
 #include "common.h"
+#include "compressed.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -115,6 +117,34 @@ class DataPlane {
   int shm_lane_count() const;  // peers reached over shared memory
   int num_hosts() const { return static_cast<int>(leaders_.size()); }
 
+  // Per-op wire compression (compressed.h). The core calls
+  // BeginCompressedOp before each allreduce with the effective mode for
+  // that (fused) tensor — resolved from HVDTPU_COMPRESSION, the min-bytes
+  // bypass and the skip-regex, identically on every rank — and the
+  // tensor's error-feedback residual buffer (nullable). Collective-driving
+  // (background) thread only. Compression applies to fp32 SUM/AVERAGE on
+  // the ring and recursive-doubling paths (tree and the hierarchical
+  // intra-host/gather stages stay raw; hier compresses the leader phase —
+  // the slow cross-host link, the reference fork's premise).
+  void BeginCompressedOp(WireCompression c, float* residual) {
+    op_comp_ = c == WireCompression::AUTO ? WireCompression::NONE : c;
+    op_residual_ = residual;
+  }
+  void EndCompressedOp() {
+    op_comp_ = WireCompression::NONE;
+    op_residual_ = nullptr;
+  }
+
+  // Payload accounting for the timeline's per-op raw_bytes/wire_bytes args
+  // and the cumulative hvdtpu_wire_stats counters: raw = bytes this rank
+  // would have sent uncompressed, wire = bytes actually sent. Reset by
+  // Allreduce/AdasumAllreduce at entry; totals are atomics (user threads
+  // read them through the C API while the background thread runs ops).
+  int64_t op_raw_bytes() const { return op_raw_bytes_; }
+  int64_t op_wire_bytes() const { return op_wire_bytes_; }
+  int64_t total_raw_bytes() const { return total_raw_bytes_; }
+  int64_t total_wire_bytes() const { return total_wire_bytes_; }
+
   // Gather variable-length byte blocks from every rank; out = concatenated in
   // rank order. block_bytes[r] gives each rank's contribution size.
   Status Allgatherv(const void* in, int64_t in_bytes,
@@ -172,6 +202,38 @@ class DataPlane {
   Status TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
                             ReduceOp op, const std::vector<int>& group);
 
+  // Compressed-hop variants of the ring phases (fp32 SUM only; gated by
+  // CompressionActive). Reduce-scatter: each hop quantizes the outgoing
+  // chunk (error-feedback residual applied at the compressing rank),
+  // ships the wire form, and the receiver dequantizes + reduces in fp32.
+  // Allgather: the chunk owner quantizes its fully reduced chunk ONCE,
+  // replaces its own copy with the dequantized values, and every hop
+  // forwards the owner's wire bytes verbatim — all ranks decode identical
+  // codes, so the final vectors are bitwise identical everywhere.
+  Status CompressedRingReduceScatter(float* buf,
+                                     const std::vector<int64_t>& starts,
+                                     const std::vector<int>& group, int gi);
+  Status CompressedRingAllgather(float* buf,
+                                 const std::vector<int64_t>& starts,
+                                 const std::vector<int>& group, int gi);
+  // Recursive doubling with compressed exchanges: each round both peers
+  // quantize their partial sum (self-decoding their own copy), so both
+  // compute deQ(a) + deQ(b) and stay bitwise identical. Non-power-of-two
+  // folds compress the uplink; the unfold ships the final vector raw so
+  // folded ranks match the main group exactly.
+  Status CompressedRecursiveDoubling(float* data, int64_t count,
+                                     const std::vector<int>& group);
+
+  bool CompressionActive(DataType dtype, ReduceOp op) const {
+    return op_comp_ != WireCompression::NONE &&
+           dtype == DataType::FLOAT32 &&
+           (op == ReduceOp::SUM || op == ReduceOp::AVERAGE);
+  }
+  void AddOpBytes(int64_t raw, int64_t wire) {
+    op_raw_bytes_ += raw;
+    op_wire_bytes_ += wire;
+  }
+
   // Ring phases over a group (shared by RingAllreduceGroup and the
   // hierarchical intra-host stages). After the reduce-scatter, group member
   // gi owns chunk (gi+1) % group_size fully reduced.
@@ -212,6 +274,15 @@ class DataPlane {
   // without a deadlock risk; measured against the mesh's socket buffer
   // sizes in Connect(). 0 (pre-Connect) = always use the concurrent path.
   int64_t inline_max_bytes_ = 0;
+
+  // Per-op wire compression state (background thread only) + payload
+  // accounting (totals readable cross-thread).
+  WireCompression op_comp_ = WireCompression::NONE;
+  float* op_residual_ = nullptr;
+  int64_t op_raw_bytes_ = 0;
+  int64_t op_wire_bytes_ = 0;
+  std::atomic<int64_t> total_raw_bytes_{0};
+  std::atomic<int64_t> total_wire_bytes_{0};
 };
 
 // dst[i] = dst[i] OP src[i], accumulating fp16/bf16 in float.

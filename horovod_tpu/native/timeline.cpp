@@ -132,18 +132,31 @@ void Timeline::QueueStart(const std::string& name) {
 
 void Timeline::ActivityStart(const std::string& name,
                              const std::string& activity,
-                             const std::string& transport) {
-  Emit(name, 'B',
-       transport.empty()
-           ? std::string()
-           : "{\"transport\": \"" + JsonEscape(transport) + "\"}",
-       activity);
+                             const std::string& transport,
+                             const std::string& compression) {
+  std::string args;
+  if (!transport.empty()) {
+    args = "\"transport\": \"" + JsonEscape(transport) + "\"";
+  }
+  if (!compression.empty()) {
+    if (!args.empty()) args += ", ";
+    args += "\"compression\": \"" + JsonEscape(compression) + "\"";
+  }
+  Emit(name, 'B', args.empty() ? std::string() : "{" + args + "}", activity);
 }
 
 void Timeline::ActivityEnd(const std::string& name) { Emit(name, 'E', ""); }
 
-void Timeline::OpDone(const std::string& name, const std::string& result) {
-  Emit(name, 'E', "{\"result\": \"" + result + "\"}");
+void Timeline::OpDone(const std::string& name, const std::string& result,
+                      int64_t raw_bytes, int64_t wire_bytes) {
+  // Escape like every other arg: failure reasons embed tensor names, and a
+  // quote/backslash there would corrupt the whole trace file.
+  std::string args = "{\"result\": \"" + JsonEscape(result) + "\"";
+  if (raw_bytes >= 0 && wire_bytes >= 0) {
+    args += ", \"raw_bytes\": " + std::to_string(raw_bytes) +
+            ", \"wire_bytes\": " + std::to_string(wire_bytes);
+  }
+  Emit(name, 'E', args + "}");
 }
 
 void Timeline::MarkCycle() {

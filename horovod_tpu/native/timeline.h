@@ -35,10 +35,18 @@ class Timeline {
   // `transport` (optional) tags the op with the data-plane lane summary
   // ("shm", "tcp", "shm+tcp", with "+hier" under the two-level allreduce) as
   // a Chrome-trace arg — visible in the Perfetto slice details.
+  // `compression` (optional) sits next to it: the op's effective wire
+  // compression ("none", "fp16", "int8", "int4").
   void ActivityStart(const std::string& name, const std::string& activity,
-                     const std::string& transport = "");
+                     const std::string& transport = "",
+                     const std::string& compression = "");
   void ActivityEnd(const std::string& name);
-  void OpDone(const std::string& name, const std::string& result);
+  // raw_bytes/wire_bytes (optional, -1 = omit): payload this rank would
+  // have sent uncompressed vs bytes actually sent, from the data plane's
+  // per-op counters — the compression-ratio measurement surface
+  // (docs/timeline.md).
+  void OpDone(const std::string& name, const std::string& result,
+              int64_t raw_bytes = -1, int64_t wire_bytes = -1);
   void MarkCycle();  // HVDTPU_TIMELINE_MARK_CYCLES
 
  private:

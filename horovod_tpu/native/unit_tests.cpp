@@ -29,6 +29,7 @@
 #include <sys/mman.h>
 
 #include "autotune.h"
+#include "compressed.h"
 #include "data_plane.h"
 #include "message.h"
 #include "shm_transport.h"
@@ -686,6 +687,331 @@ void TestDataPlaneHierarchicalAllreduce() {
   }
 }
 
+// --- wire compression (compressed.{h,cpp}) ----------------------------------
+
+// Per-bucket quantization range for the error bound, replicating the
+// quantizer's zero-padded-tail semantics.
+float BucketRange(const float* x, int64_t count, int64_t bucket) {
+  const int64_t lo = bucket * kWireBucketSize;
+  const int64_t n = std::min<int64_t>(kWireBucketSize, count - lo);
+  float mn = x[lo], mx = x[lo];
+  for (int64_t i = 0; i < n; ++i) {
+    mn = std::min(mn, x[lo + i]);
+    mx = std::max(mx, x[lo + i]);
+  }
+  if (n < kWireBucketSize) {
+    mn = std::min(mn, 0.0f);
+    mx = std::max(mx, 0.0f);
+  }
+  return mx - mn;
+}
+
+void TestWireQuantizerRoundTrip() {
+  // Counts exercise sub-bucket tensors, exact buckets, padded tails, and
+  // odd int4 nibble counts.
+  const int64_t counts[] = {1, 2, 511, 512, 513, 1000, 1025};
+  const WireCompression modes[] = {WireCompression::FP16,
+                                   WireCompression::INT8,
+                                   WireCompression::INT4};
+  for (WireCompression c : modes) {
+    for (int64_t n : counts) {
+      std::vector<float> x(n), back(n, -1e9f);
+      for (int64_t i = 0; i < n; ++i) {
+        x[i] = 0.25f * static_cast<float>((i * 7 + 3) % 23 - 11) +
+               0.001f * static_cast<float>(i % 5);
+      }
+      std::vector<uint8_t> wire(static_cast<size_t>(WireBytes(c, n)), 0xa5);
+      WireCompress(c, x.data(), n, wire.data(), nullptr, nullptr);
+      WireDecompress(c, wire.data(), n, back.data());
+      const float levels = c == WireCompression::INT8 ? 255.0f : 15.0f;
+      for (int64_t i = 0; i < n; ++i) {
+        float bound;
+        if (c == WireCompression::FP16) {
+          bound = std::fabs(x[i]) * 1e-3f + 1e-6f;
+        } else {
+          // Max-min quantization error is at most half a unit.
+          bound = BucketRange(x.data(), n, i / kWireBucketSize) / levels *
+                      0.5f + 1e-5f;
+        }
+        if (std::fabs(back[i] - x[i]) > bound) {
+          std::fprintf(stderr,
+                       "FAIL wire roundtrip %s n=%lld i=%lld: %g vs %g\n",
+                       WireCompressionName(c), static_cast<long long>(n),
+                       static_cast<long long>(i), back[i], x[i]);
+          ++failures;
+          return;
+        }
+      }
+      // The fused decompress-add matches decompress + add exactly.
+      std::vector<float> acc(n, 1.5f);
+      WireDecompressAdd(c, wire.data(), n, acc.data());
+      for (int64_t i = 0; i < n; ++i) {
+        CHECK_TRUE(acc[i] == 1.5f + back[i]);
+      }
+      // Self-decode returns exactly what a peer would decode (and may
+      // alias the source buffer).
+      std::vector<float> self(x);
+      WireCompress(c, self.data(), n, wire.data(), nullptr, self.data());
+      for (int64_t i = 0; i < n; ++i) {
+        CHECK_TRUE(self[i] == back[i]);
+      }
+    }
+  }
+}
+
+void TestWireInt4PackingAndTail() {
+  // Hand-checked 3-element int4 block: the tail bucket is zero-padded for
+  // the min/max scan (mn 0, mx 2, unit 2/15), codes ride low-nibble-first
+  // (quantize.py pack_bits order), and the scaled tie 7.5 rounds to EVEN 8.
+  const float x[3] = {0.0f, 1.0f, 2.0f};
+  std::vector<uint8_t> wire(
+      static_cast<size_t>(WireBytes(WireCompression::INT4, 3)), 0xff);
+  CHECK_TRUE(wire.size() == 8 + 2);  // one bucket header + 2 code bytes
+  WireCompress(WireCompression::INT4, x, 3, wire.data(), nullptr, nullptr);
+  float mn, unit;
+  memcpy(&mn, wire.data(), 4);
+  memcpy(&unit, wire.data() + 4, 4);
+  CHECK_TRUE(mn == 0.0f);
+  CHECK_TRUE(std::fabs(unit - 2.0f / 15.0f) < 1e-7f);
+  // codes low-nibble-first: element 1 scales to 1.0/unit = 7.49999952 in
+  // fp32 (not an exact tie — unit rounds up), so RNE gives 7.
+  CHECK_TRUE(wire[8] == 0x70);
+  CHECK_TRUE(wire[9] == 0x0f);  // code 15; odd tail's high nibble is zeroed
+  float back[3];
+  WireDecompress(WireCompression::INT4, wire.data(), 3, back);
+  CHECK_TRUE(back[0] == 0.0f);
+  CHECK_TRUE(std::fabs(back[1] - 7.0f * 2.0f / 15.0f) < 1e-6f);
+  CHECK_TRUE(back[2] == 2.0f);
+}
+
+void TestWireErrorFeedbackConvergence() {
+  // The EF telescoping identity: sum_t decode_t = T*x + r_0 - r_T, so the
+  // running mean of the quantized outputs converges to the exact input at
+  // rate |r_T| / T — repeated int4 quantization of a FIXED gradient
+  // recovers it to far below one quantization unit.
+  const int64_t n = 700;  // padded tail bucket included
+  std::vector<float> x(n);
+  for (int64_t i = 0; i < n; ++i) {
+    x[i] = 0.125f * static_cast<float>((i * 11 + 5) % 31 - 15);
+  }
+  std::vector<float> residual(n, 0.0f), decode(n, 0.0f);
+  std::vector<double> acc(n, 0.0);
+  std::vector<uint8_t> wire(
+      static_cast<size_t>(WireBytes(WireCompression::INT4, n)));
+  const int kIters = 200;
+  for (int t = 0; t < kIters; ++t) {
+    WireCompress(WireCompression::INT4, x.data(), n, wire.data(),
+                 residual.data(), decode.data());
+    for (int64_t i = 0; i < n; ++i) acc[i] += decode[i];
+  }
+  float max_range = 0.0f;
+  for (int64_t b = 0; b * kWireBucketSize < n; ++b) {
+    max_range = std::max(max_range, BucketRange(x.data(), n, b));
+  }
+  // One-shot quantization error bound vs the EF mean bound (T x smaller).
+  const double one_shot = max_range / 15.0 * 0.5;
+  const double ef_bound = 2.0 * one_shot / kIters + 1e-5;
+  for (int64_t i = 0; i < n; ++i) {
+    double err = std::fabs(acc[i] / kIters - x[i]);
+    if (err > ef_bound) {
+      std::fprintf(stderr,
+                   "FAIL wire EF convergence at %lld: err %g (bound %g, "
+                   "one-shot %g)\n",
+                   static_cast<long long>(i), err, ef_bound, one_shot);
+      ++failures;
+      return;
+    }
+  }
+}
+
+// Compressed allreduce worlds: fp16/int8/int4 x ring/recursive-doubling x
+// TCP/shm lanes. Verifies the quantized sum against the exact fp32 oracle
+// within the mode's error budget, bitwise cross-rank agreement (the
+// self-decode/forwarding design), raw-vs-wire byte accounting, and that
+// non-eligible ops (MIN) pass through the compressed op untouched.
+void TestDataPlaneCompressedAllreduce() {
+  const int64_t n = 3000;
+  for (bool shm : {false, true}) {
+    for (AllreduceAlgo algo :
+         {AllreduceAlgo::RING, AllreduceAlgo::RECURSIVE_DOUBLING}) {
+      for (WireCompression comp :
+           {WireCompression::FP16, WireCompression::INT8,
+            WireCompression::INT4}) {
+        for (int world : {2, 3}) {  // 3: ragged ring chunks + the RD fold
+          TestWorld w = MakeWorld(
+              std::vector<std::string>(world, "127.0.0.1"));
+          for (int r = 0; r < world; ++r) {
+            w.planes[r]->set_allreduce_algo(algo);
+            w.planes[r]->set_segment_bytes(512);
+            w.planes[r]->set_shm_enabled(shm);
+            w.planes[r]->set_shm_ring_bytes(8192);
+            w.planes[r]->set_hier_mode(HierMode::OFF);
+          }
+          std::vector<std::vector<float>> outs(
+              world, std::vector<float>(n));
+          std::vector<double> expect(n, 0.0);
+          for (int r = 0; r < world; ++r) {
+            for (int64_t i = 0; i < n; ++i) {
+              outs[r][i] = 0.25f *
+                  static_cast<float>((i * 7 + r * 13) % 23 - 11);
+              expect[i] += outs[r][i];
+            }
+          }
+          double max_abs = 0.0;
+          for (double v : expect) max_abs = std::max(max_abs, std::fabs(v));
+          const double tol =
+              (comp == WireCompression::FP16   ? 2e-3
+               : comp == WireCompression::INT8 ? 0.03
+                                               : 0.4) *
+              std::max(max_abs, 1.0);
+          std::atomic<int> bad{0};
+          std::vector<std::thread> threads;
+          for (int r = 0; r < world; ++r) {
+            threads.emplace_back([&, r] {
+              if (!w.planes[r]->Connect(w.peers).ok()) {
+                ++bad;
+                return;
+              }
+              std::vector<float> residual(n, 0.0f);
+              w.planes[r]->BeginCompressedOp(comp, residual.data());
+              Status st = w.planes[r]->Allreduce(
+                  outs[r].data(), n, DataType::FLOAT32, ReduceOp::SUM);
+              w.planes[r]->EndCompressedOp();
+              if (!st.ok()) {
+                ++bad;
+                return;
+              }
+              // int8 on the pure-compressed ring must beat 3.5x on the
+              // wire (headers cost 8/512 per bucket; fp32 -> int8 is 4x).
+              if (comp == WireCompression::INT8 &&
+                  algo == AllreduceAlgo::RING &&
+                  w.planes[r]->op_wire_bytes() * 7 >
+                      w.planes[r]->op_raw_bytes() * 2) {
+                ++bad;
+                return;
+              }
+              // MIN is not eligible: the compressed op leaves it exact.
+              std::vector<int32_t> m = {r, 100 - r};
+              w.planes[r]->BeginCompressedOp(comp, nullptr);
+              st = w.planes[r]->Allreduce(m.data(), 2, DataType::INT32,
+                                          ReduceOp::MIN);
+              w.planes[r]->EndCompressedOp();
+              if (!st.ok() || m[0] != 0 || m[1] != 100 - (world - 1)) ++bad;
+            });
+          }
+          for (auto& t : threads) t.join();
+          for (int r = 0; r < world && bad == 0; ++r) {
+            for (int64_t i = 0; i < n; ++i) {
+              if (std::fabs(outs[r][i] - expect[i]) > tol) {
+                ++bad;
+                break;
+              }
+            }
+            // Bitwise cross-rank agreement.
+            if (memcmp(outs[r].data(), outs[0].data(), n * 4) != 0) ++bad;
+          }
+          if (bad != 0) {
+            std::fprintf(stderr,
+                         "FAIL compressed allreduce world=%d algo=%d "
+                         "comp=%s shm=%d (%d bad)\n",
+                         world, static_cast<int>(algo),
+                         WireCompressionName(comp), shm ? 1 : 0,
+                         bad.load());
+            ++failures;
+          }
+          for (auto& p : w.planes) p->Shutdown();
+        }
+      }
+    }
+  }
+}
+
+// Compressed hierarchical worlds: the leader (cross-host) phase carries the
+// quantized hops, intra-host stages stay dense; result must still agree
+// with the oracle and bitwise across every rank.
+void TestDataPlaneCompressedHierarchical() {
+  const int64_t n = 3000;
+  const std::vector<std::vector<std::string>> topos = {
+      {"127.0.0.1", "127.0.0.1", "localhost", "localhost"},  // 2x2
+      {"127.0.0.1", "127.0.0.1", "localhost"},               // 2+1
+  };
+  for (const auto& hosts : topos) {
+    for (WireCompression comp :
+         {WireCompression::FP16, WireCompression::INT8,
+          WireCompression::INT4}) {
+      const int world = static_cast<int>(hosts.size());
+      TestWorld w = MakeWorld(hosts);
+      for (int r = 0; r < world; ++r) {
+        w.planes[r]->set_segment_bytes(512);
+        w.planes[r]->set_shm_ring_bytes(8192);
+        w.planes[r]->set_hier_mode(HierMode::ON);
+      }
+      std::vector<std::vector<float>> outs(world, std::vector<float>(n));
+      std::vector<double> expect(n, 0.0);
+      for (int r = 0; r < world; ++r) {
+        for (int64_t i = 0; i < n; ++i) {
+          outs[r][i] =
+              0.25f * static_cast<float>((i * 7 + r * 13) % 23 - 11);
+          expect[i] += outs[r][i];
+        }
+      }
+      double max_abs = 0.0;
+      for (double v : expect) max_abs = std::max(max_abs, std::fabs(v));
+      const double tol = (comp == WireCompression::FP16   ? 2e-3
+                          : comp == WireCompression::INT8 ? 0.03
+                                                          : 0.4) *
+                         std::max(max_abs, 1.0);
+      std::atomic<int> bad{0};
+      std::vector<std::thread> threads;
+      for (int r = 0; r < world; ++r) {
+        threads.emplace_back([&, r] {
+          if (!w.planes[r]->Connect(w.peers).ok() ||
+              !w.planes[r]->hier_active()) {
+            ++bad;
+            return;
+          }
+          std::vector<float> residual(n, 0.0f);
+          w.planes[r]->BeginCompressedOp(comp, residual.data());
+          Status st = w.planes[r]->Allreduce(outs[r].data(), n,
+                                             DataType::FLOAT32,
+                                             ReduceOp::SUM);
+          w.planes[r]->EndCompressedOp();
+          if (!st.ok()) ++bad;
+          // Tiny tensor through the compressed op: empty chunks and the
+          // min-count edge must not wedge the two-level schedule.
+          std::vector<float> tiny = {static_cast<float>(r + 1)};
+          w.planes[r]->BeginCompressedOp(comp, nullptr);
+          st = w.planes[r]->Allreduce(tiny.data(), 1, DataType::FLOAT32,
+                                      ReduceOp::SUM);
+          w.planes[r]->EndCompressedOp();
+          if (!st.ok() ||
+              std::fabs(tiny[0] - world * (world + 1) / 2.0f) > 0.5f) {
+            ++bad;
+          }
+        });
+      }
+      for (auto& t : threads) t.join();
+      for (int r = 0; r < world && bad == 0; ++r) {
+        for (int64_t i = 0; i < n; ++i) {
+          if (std::fabs(outs[r][i] - expect[i]) > tol) {
+            ++bad;
+            break;
+          }
+        }
+        if (memcmp(outs[r].data(), outs[0].data(), n * 4) != 0) ++bad;
+      }
+      if (bad != 0) {
+        std::fprintf(stderr,
+                     "FAIL compressed hier allreduce world=%d comp=%s "
+                     "(%d bad)\n",
+                     world, WireCompressionName(comp), bad.load());
+        ++failures;
+      }
+      for (auto& p : w.planes) p->Shutdown();
+    }
+  }
+}
+
 void TestReduceBufferOps() {
   float dst[4] = {1, 2, 3, 4};
   float src[4] = {4, 3, 2, 1};
@@ -739,6 +1065,7 @@ void TestParameterManagerFreezesAtBest() {
   pm.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
                 /*algo_crossover=*/256 << 10, /*tune_crossover=*/true,
                 /*hier_enabled=*/false, /*tune_hier=*/true,
+                /*wire_compression=*/0, /*tune_compression=*/true,
                 /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
                 /*max_samples=*/4, /*gp_noise=*/0.1);
   CHECK_TRUE(pm.active());
@@ -755,13 +1082,18 @@ void TestParameterManagerFreezesAtBest() {
   CHECK_TRUE(p.cycle_time_ms >= 0.5 && p.cycle_time_ms <= 50.0);
   CHECK_TRUE(p.fusion_threshold >= (1 << 20));
   CHECK_TRUE(p.algo_crossover >= (4 << 10) && p.algo_crossover <= (4 << 20));
+  // The compression categorical stays inside the automatic menu
+  // {none, fp16, int8} — int4 is never auto-selected.
+  CHECK_TRUE(p.wire_compression >= 0 && p.wire_compression <= 2);
 
-  // Pinned algorithm (tune_crossover=false) and pinned hier (tune_hier=
-  // false): the excluded coordinates are held at their initial values.
+  // Pinned algorithm (tune_crossover=false), pinned hier (tune_hier=false)
+  // and pinned compression (tune_compression=false): the excluded
+  // coordinates are held at their initial values.
   ParameterManager pinned;
   pinned.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
                     /*algo_crossover=*/123456, /*tune_crossover=*/false,
                     /*hier_enabled=*/true, /*tune_hier=*/false,
+                    /*wire_compression=*/3, /*tune_compression=*/false,
                     /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
                     /*max_samples=*/4, /*gp_noise=*/0.1);
   t = 0.0;
@@ -771,6 +1103,7 @@ void TestParameterManagerFreezesAtBest() {
   }
   CHECK_TRUE(pinned.Current().algo_crossover == 123456);
   CHECK_TRUE(pinned.Current().hier_enabled);
+  CHECK_TRUE(pinned.Current().wire_compression == 3);
 }
 
 }  // namespace
@@ -792,6 +1125,11 @@ int main() {
   TestShmAbortCleanup();
   TestDataPlaneAllreduceAlgos();
   TestDataPlaneHierarchicalAllreduce();
+  TestWireQuantizerRoundTrip();
+  TestWireInt4PackingAndTail();
+  TestWireErrorFeedbackConvergence();
+  TestDataPlaneCompressedAllreduce();
+  TestDataPlaneCompressedHierarchical();
   TestReduceBufferOps();
   TestGaussianProcessInterpolates();
   TestBayesianOptimizerPicksBestSample();

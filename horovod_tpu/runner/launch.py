@@ -79,6 +79,17 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--shm-ring-bytes", type=int, default=None,
                    help="per-direction shm ring capacity in bytes "
                         "(HVDTPU_SHM_RING_BYTES; default 1 MB)")
+    p.add_argument("--compression", default=None,
+                   choices=["none", "fp16", "int8", "int4", "auto"],
+                   help="wire compression for the native allreduce data "
+                        "plane (HVDTPU_COMPRESSION): quantize fp32 payloads "
+                        "to fp16 / bucket-512 int8 / int4 on the wire with "
+                        "error feedback; 'auto' hands the choice to the "
+                        "Bayesian autotuner")
+    p.add_argument("--compression-min-bytes", type=int, default=None,
+                   help="allreduce payloads below this many bytes stay "
+                        "uncompressed (HVDTPU_COMPRESSION_MIN_BYTES; "
+                        "default 1024)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
@@ -174,7 +185,8 @@ Available Tensor Operations:
     {mark(True)} reducescatter
     {mark(True)} hierarchical allreduce (ICI/DCN)
     {mark(True)} join
-    {mark(True)} compressed allreduce (maxmin/uni/exp/topk + error feedback)"""
+    {mark(True)} compressed allreduce (maxmin/uni/exp/topk + error feedback)
+    {mark(native)} wire compression, process mode (fp16/int8/int4 + error feedback)"""
 
 
 def _install_config_file_defaults(path: str, parser) -> None:
@@ -232,6 +244,16 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_SHM] = "0"
     if args.shm_ring_bytes is not None:
         env[ev.HVDTPU_SHM_RING_BYTES] = str(args.shm_ring_bytes)
+    # Wire compression: the flag owns the knob only when passed (a
+    # user-exported HVDTPU_COMPRESSION wins otherwise, like HVDTPU_SHM).
+    if args.compression is not None:
+        env[ev.HVDTPU_COMPRESSION] = args.compression
+    if args.compression_min_bytes is not None:
+        if args.compression_min_bytes < 0:
+            raise SystemExit(
+                "hvdrun: --compression-min-bytes must be >= 0")
+        env[ev.HVDTPU_COMPRESSION_MIN_BYTES] = str(
+            args.compression_min_bytes)
     if args.timeline:
         # Base path; per-worker suffixing happens where the worker identity
         # is known (static: per rank here in _build_env; elastic: the driver).

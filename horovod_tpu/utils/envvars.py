@@ -120,6 +120,50 @@ HVDTPU_COMPRESSION_CONFIG_FILE = "HVDTPU_COMPRESSION_CONFIG_FILE"
 # normalized quantizers (common.h:96-108).
 HVDTPU_COMPRESSION_NORM_TYPE = "HVDTPU_COMPRESSION_NORM_TYPE"
 
+# Wire-level compression in the native process-mode data plane
+# (native/compressed.{h,cpp}; reference: the fork's ops/compressed/
+# subsystem quantizing the MPI/SHM/P2P wire). HVDTPU_COMPRESSION doubles as
+# the selector: the wire modes none|fp16|int8|int4|auto map directly
+# ("auto" hands the choice to the Bayesian autotuner); "maxmin" rides its
+# HVDTPU_QUANTIZATION_BITS (8 -> int8, 4 -> int4) so one knob drives the
+# JAX and wire paths identically; the JAX-only compressors (bf16, uni, exp,
+# topk) leave the wire dense. MIN_BYTES: allreduces below this payload stay
+# raw (headers + extra passes would cost more than they save).
+# SKIP_REGEX: case-insensitive regex over tensor names — matching ops stay
+# dense (biases / norm layers, the fork's per-layer ignore rules).
+HVDTPU_COMPRESSION_MIN_BYTES = "HVDTPU_COMPRESSION_MIN_BYTES"
+HVDTPU_COMPRESSION_SKIP_REGEX = "HVDTPU_COMPRESSION_SKIP_REGEX"
+
+# Wire modes, mapped to hvdtpu::WireCompression (native/compressed.h).
+WIRE_COMPRESSION_MODES = {"none": 0, "fp16": 1, "int8": 2, "int4": 3,
+                          "auto": 4}
+# HVDTPU_COMPRESSION values that configure only the JAX-level compressors
+# (compression/config.py) and keep the native wire dense.
+JAX_ONLY_COMPRESSORS = ("bf16", "uni", "exp", "topk")
+DEFAULT_COMPRESSION_MIN_BYTES = 1024
+DEFAULT_COMPRESSION_SKIP_REGEX = r"bias|batch_?norm|layer_?norm"
+
+
+def get_wire_compression(name: str, bits: int = 4) -> int:
+    """Resolve an HVDTPU_COMPRESSION value to the native WireCompression
+    code, validating the full accepted vocabulary (wire modes + JAX-level
+    compressor names)."""
+    name = (name or "none").strip().lower()
+    if name in WIRE_COMPRESSION_MODES:
+        return WIRE_COMPRESSION_MODES[name]
+    if name == "maxmin":
+        if bits == 8:
+            return WIRE_COMPRESSION_MODES["int8"]
+        if bits == 4:
+            return WIRE_COMPRESSION_MODES["int4"]
+        return WIRE_COMPRESSION_MODES["none"]  # 1/2-bit: JAX path only
+    if name in JAX_ONLY_COMPRESSORS:
+        return WIRE_COMPRESSION_MODES["none"]
+    raise ValueError(
+        f"{HVDTPU_COMPRESSION} must be one of "
+        f"{sorted(WIRE_COMPRESSION_MODES)} + "
+        f"{sorted(('maxmin',) + JAX_ONLY_COMPRESSORS)}, got {name!r}")
+
 # Elastic (reference: HOROVOD_ELASTIC_TIMEOUT, HOROVOD_GLOO_TIMEOUT_SECONDS)
 HVDTPU_ELASTIC_TIMEOUT = "HVDTPU_ELASTIC_TIMEOUT"
 

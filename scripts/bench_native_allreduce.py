@@ -34,6 +34,10 @@ DEFAULT_LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvdtpu_core.so")
 
 ALGOS = {"auto": 0, "ring": 1, "recursive_doubling": 2, "tree": 3}
 HIER_MODES = {"off": 0, "on": 1, "auto": 2}
+# hvdtpu::WireCompression (native/compressed.h); relative result tolerance
+# per mode (quantized sums are approximate by design).
+COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
+               "int4": (3, 2e-1)}
 DTYPES = {"float32": (7, 4), "float16": (6, 2), "bfloat16": (10, 2)}
 OP_ALLREDUCE = 0
 REDUCE_SUM = 1
@@ -81,6 +85,17 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
     except AttributeError:
         pass  # pre-transport-subsystem build: TCP only
+    try:
+        lib.hvdtpu_set_compression.restype = ctypes.c_int
+        lib.hvdtpu_set_compression.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_char_p]
+        lib.hvdtpu_wire_stats.restype = None
+        lib.hvdtpu_wire_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+    except AttributeError:
+        pass  # pre-compression build: raw wire only
     return lib
 
 
@@ -132,6 +147,16 @@ def run_worker(args) -> int:
         print("SKIP shm/hier config: library has no transport subsystem",
               file=sys.stderr)
         return 0
+    if hasattr(lib, "hvdtpu_set_compression"):
+        # min_bytes 0: the bench drives single named tensors of exactly the
+        # sweep size — the production small-tensor bypass would silently
+        # turn the A/B into none-vs-none at the low end.
+        lib.hvdtpu_set_compression(core, COMPRESSION[args.compression][0],
+                                   0, b"")
+    elif args.compression != "none":
+        print("SKIP compression config: library has no wire compression",
+              file=sys.stderr)
+        return 0
     err = ctypes.create_string_buffer(1024)
     if lib.hvdtpu_start(core, err, len(err)) != 0:
         print(f"start failed: {err.value.decode()}", file=sys.stderr)
@@ -171,17 +196,26 @@ def run_worker(args) -> int:
             if args.dtype == "float32":
                 fout = ctypes.cast(out, ctypes.POINTER(ctypes.c_float))
                 want = n * (n + 1) / 2.0
-                if abs(fout[0] - want) > 1e-3 * want or \
-                   abs(fout[count - 1] - 2 * want) > 2e-3 * want:
+                tol = COMPRESSION[args.compression][1]
+                if abs(fout[0] - want) > tol * want or \
+                   abs(fout[count - 1] - 2 * want) > 2 * tol * want:
                     raise RuntimeError(
                         f"bad allreduce result at {nbytes}B: "
                         f"{fout[0]} / {fout[count - 1]}, want {want}/{2*want}")
+            row = {
+                "bytes": nbytes, "iters": iters, "avg_s": dt,
+                "algbw_gbps": nbytes / dt / 1e9,
+                "busbw_gbps": nbytes * 2 * (n - 1) / n / dt / 1e9,
+            }
+            if hasattr(lib, "hvdtpu_wire_stats"):
+                raw = ctypes.c_longlong(0)
+                wire = ctypes.c_longlong(0)
+                lib.hvdtpu_wire_stats(core, ctypes.byref(raw),
+                                      ctypes.byref(wire))
+                if wire.value > 0:
+                    row["wire_ratio"] = round(raw.value / wire.value, 3)
             if rank == 0:
-                print(json.dumps({
-                    "bytes": nbytes, "iters": iters, "avg_s": dt,
-                    "algbw_gbps": nbytes / dt / 1e9,
-                    "busbw_gbps": nbytes * 2 * (n - 1) / n / dt / 1e9,
-                }), flush=True)
+                print(json.dumps(row), flush=True)
     except Exception as e:  # pragma: no cover - surfaced by the parent
         print(f"worker rank {rank} failed: {e}", file=sys.stderr)
         rc = 1
@@ -217,6 +251,7 @@ def run_config(args, world: int, algo: str, sizes: list) -> tuple:
                "--segment", str(args.segment),
                "--transport", args.transport, "--hier", args.hier,
                "--shm-ring-bytes", str(args.shm_ring_bytes),
+               "--compression", args.compression,
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -243,7 +278,8 @@ def run_config(args, world: int, algo: str, sizes: list) -> tuple:
                 p.communicate()
     for row in rows:
         row.update({"world": world, "algo": algo, "dtype": args.dtype,
-                    "transport": args.transport, "hier": args.hier})
+                    "transport": args.transport, "hier": args.hier,
+                    "compression": args.compression})
     return rows, failed
 
 
@@ -282,6 +318,10 @@ def main(argv=None) -> int:
                    help="hierarchical two-level allreduce mode")
     p.add_argument("--shm-ring-bytes", type=int, default=0,
                    help="shm ring capacity per direction (0: default 1 MB)")
+    p.add_argument("--compression", default="none",
+                   choices=sorted(COMPRESSION),
+                   help="wire compression for the sweep (the compressed-vs-"
+                        "raw A/B: run once with none, once with int8)")
     p.add_argument("--cycle-time-ms", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--quick", action="store_true",

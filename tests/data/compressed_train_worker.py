@@ -1,0 +1,48 @@
+"""Worker: small-model data-parallel training whose gradients ride the
+wire-compressed allreduce (ISSUE 3 satellite). Prints the loss curve as a
+single "LOSSES <json>" line on rank 0 so the test can compare a compressed
+run against the dense baseline."""
+import json
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+# Deterministic synthetic linear-regression task, identical on every rank;
+# each rank trains on its stride-shard.
+rng = np.random.RandomState(1234)
+dim = 256
+true_w = rng.uniform(-1.0, 1.0, dim).astype(np.float32)
+X = rng.uniform(-1.0, 1.0, (256 * n, dim)).astype(np.float32)
+y = X @ true_w + 0.01 * rng.standard_normal(256 * n).astype(np.float32)
+Xs, ys = X[r::n], y[r::n]
+
+w = np.zeros(dim, np.float32)
+lr = 0.15
+losses = []
+for step in range(120):
+    e = Xs @ w - ys
+    loss = float(np.mean(e * e))
+    grad = (2.0 / len(ys) * (Xs.T @ e)).astype(np.float32)
+    # dim * 4 = 1 KB >= the test's HVDTPU_COMPRESSION_MIN_BYTES, so the
+    # gradient rides the compressed wire (with error feedback) when the
+    # test sets a quantized mode.
+    grad = np.asarray(hvd.allreduce(grad, name="grad", op=hvd.Average))
+    loss = float(np.asarray(hvd.allreduce(
+        np.array([loss], np.float32), name="loss", op=hvd.Average))[0])
+    w -= lr * grad
+    losses.append(loss)
+
+if r == 0:
+    print("LOSSES " + json.dumps(losses))
+print(f"rank {r}: ALL OK")
+sys.exit(0)

@@ -95,6 +95,36 @@ class TestArgParsing:
             parse_args(["-np", "2", "--allreduce-algo", "hypercube",
                         "python", "x.py"])
 
+    def test_compression_flags(self):
+        """--compression/--compression-min-bytes validate against the wire
+        menu and land in the workers' env (ISSUE 3 satellite)."""
+        from horovod_tpu.runner.launch import _apply_tuning_env
+        from horovod_tpu.utils import envvars as ev
+
+        args = parse_args(["-np", "2", "--compression", "int8",
+                           "--compression-min-bytes", "4096",
+                           "python", "x.py"])
+        assert args.compression == "int8"
+        env = _apply_tuning_env({}, args)
+        assert env[ev.HVDTPU_COMPRESSION] == "int8"
+        assert env[ev.HVDTPU_COMPRESSION_MIN_BYTES] == "4096"
+        # No flag: the knobs stay out of the env (a user-exported
+        # HVDTPU_COMPRESSION wins; the native default is none/1024).
+        args = parse_args(["-np", "2", "python", "x.py"])
+        env = _apply_tuning_env({}, args)
+        assert ev.HVDTPU_COMPRESSION not in env
+        assert ev.HVDTPU_COMPRESSION_MIN_BYTES not in env
+
+    def test_compression_flag_rejects_unknown(self):
+        with pytest.raises(SystemExit):
+            parse_args(["-np", "2", "--compression", "int2",
+                        "python", "x.py"])
+        with pytest.raises(SystemExit):
+            from horovod_tpu.runner.launch import _apply_tuning_env
+            args = parse_args(["-np", "2", "--compression-min-bytes", "-5",
+                               "python", "x.py"])
+            _apply_tuning_env({}, args)
+
 
 class TestPythonPlaceholder:
     """Per-slot interpreter substitution (a mixed local+remote job cannot

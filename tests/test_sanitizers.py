@@ -76,7 +76,10 @@ def test_tsan_native_unit_tests():
     this binary also covers the shm transport (ring wraparound, futex
     doorbell wakeup, abort-path shm_unlink cleanup) and the hierarchical
     allreduce worlds — the rings are MAP_SHARED atomics, so TSan checks the
-    exact cross-process protocol."""
+    exact cross-process protocol. Since ISSUE 3 it also runs the compressed
+    allreduce worlds (fp16/int8/int4 x ring/recursive-doubling x TCP/shm
+    lanes + compressed-leader hierarchical) and the wire quantizer's
+    round-trip/EF kernels."""
     r = subprocess.run(["make", "-C", NATIVE, "check-tsan"],
                        capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
