@@ -16,7 +16,8 @@ __version__ = "0.1.0"
 # Topology / lifecycle (reference: horovod/common/basics.py).
 from .runtime import (init, shutdown, is_initialized, rank, size, local_rank,
                       local_size, cross_rank, cross_size, is_homogeneous, mesh,
-                      dp_axis, mode, start_timeline, stop_timeline)
+                      dp_axis, mode, start_timeline, stop_timeline,
+                      metrics, metrics_dump)
 
 # Collectives (reference: horovod/torch/mpi_ops.py).
 from .ops.collectives import (

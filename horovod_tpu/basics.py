@@ -121,6 +121,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_wire_stats.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvdtpu_metrics_dump.restype = ctypes.c_longlong
+    lib.hvdtpu_metrics_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_longlong]
     lib.hvdtpu_start_timeline.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                           ctypes.c_int]
     lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_void_p]
@@ -290,12 +293,41 @@ class NativeCore:
     def wire_stats(self) -> tuple:
         """(raw_bytes, wire_bytes) cumulative allreduce payload accounting
         for this rank: what would have been sent uncompressed vs what the
-        data plane actually sent (equal when wire compression is off)."""
+        data plane actually sent (equal when wire compression is off).
+        Thin shim over the native metrics registry's
+        ``hvdtpu_allreduce_{raw,wire}_bytes_total`` counters — the same
+        values the ``/metrics`` endpoint serves."""
         raw = ctypes.c_longlong(0)
         wire = ctypes.c_longlong(0)
         self._lib.hvdtpu_wire_stats(self._core, ctypes.byref(raw),
                                     ctypes.byref(wire))
         return raw.value, wire.value
+
+    def metrics_dump(self) -> str:
+        """Prometheus text exposition of the native metrics registry
+        (counters, gauges, histograms instrumented throughout the
+        background loop and data plane; see docs/metrics.md)."""
+        core = self._core
+        if not core:
+            # Shut down: an HTTP handler thread that raced the teardown
+            # (the endpoint is stopped first, but an in-flight request may
+            # still reach here) gets an empty dump, not a dead pointer.
+            return ""
+        # Probe for the size, then copy; loop in case the registry grew a
+        # new series between the two calls.
+        need = self._lib.hvdtpu_metrics_dump(core, None, 0)
+        while True:
+            buf = ctypes.create_string_buffer(int(need) + 1)
+            got = self._lib.hvdtpu_metrics_dump(core, buf, len(buf))
+            if got <= len(buf) - 1:
+                return buf.raw[:got].decode()
+            need = got
+
+    def metrics(self) -> dict:
+        """Parsed snapshot of :meth:`metrics_dump` — see
+        :func:`horovod_tpu.observability.parse_prometheus_text` for the shape."""
+        from .observability import parse_prometheus_text
+        return parse_prometheus_text(self.metrics_dump())
 
     # -- collectives -------------------------------------------------------
 

@@ -40,6 +40,7 @@
 #include "compressed.h"
 #include "data_plane.h"
 #include "message.h"
+#include "metrics.h"
 #include "socket_util.h"
 #include "timeline.h"
 
@@ -306,12 +307,18 @@ class Core {
   // Current (possibly autotuned) loop parameters, for tests/introspection.
   double CurrentCycleTimeMs();
   int64_t CurrentFusionThreshold();
-  // Cumulative data-plane payload accounting (atomics in the data plane —
-  // safe to read from user threads while ops run).
+  // Cumulative data-plane payload accounting. Thin shim over the metrics
+  // registry (hvdtpu_allreduce_{raw,wire}_bytes_total) — the registry is
+  // the single source of truth; this keeps the pre-metrics C/Python API
+  // stable. Lock-free counters, safe from user threads while ops run.
   void WireStats(int64_t* raw_bytes, int64_t* wire_bytes) {
     *raw_bytes = data_plane_.total_raw_bytes();
     *wire_bytes = data_plane_.total_wire_bytes();
   }
+  // Prometheus text exposition of every registered series (C API:
+  // hvdtpu_metrics_dump; served over HTTP by horovod_tpu/observability.py).
+  // Callable from any thread at any point in the core lifecycle.
+  std::string MetricsDump() { return metrics_.Dump(); }
   CoreConfig* mutable_config() { return &cfg_; }  // pre-Start() only
 
  private:
@@ -344,6 +351,14 @@ class Core {
   CoreConfig cfg_;
   DataPlane data_plane_;
   Timeline timeline_;
+
+  // One histogram-pair + counter observation per completed data-plane op.
+  void ObserveOp(const char* op, double secs, int64_t bytes,
+                 const char* algo, const std::string& transport, bool hier,
+                 const char* compression, DataType dtype, bool ok);
+  // Refresh the autotune-owned parameter gauges (Start + every adoption).
+  void UpdateParamGauges(double cycle_ms, int64_t fusion, bool cache_on,
+                         int64_t crossover);
 
   // Wire-compression state: error-feedback residuals per (fused) tensor,
   // the compiled skip regex (with a per-name verdict memo — regex_search
@@ -414,6 +429,32 @@ class Core {
 
   void ApplyTimelineRequest();
   void FailAllOutstanding(const std::string& reason);
+
+  // Live-metrics registry (metrics.h) + handles pre-resolved in Start() so
+  // the background loop's per-cycle updates are pure lock-free atomic ops.
+  // Per-op histogram handles are label-dependent and resolved per op (a
+  // mutex-guarded map lookup — microseconds against millisecond-scale
+  // collectives, background thread only). Declared LAST so the registry's
+  // mutex/map do not displace the hot negotiation state above across
+  // cache lines.
+  Metrics metrics_;
+  Counter* m_cycles_ = nullptr;
+  Histogram* m_cycle_hist_ = nullptr;
+  Gauge* m_queue_depth_ = nullptr;
+  Gauge* m_outstanding_ = nullptr;
+  Gauge* m_stalled_ = nullptr;
+  Counter* m_stall_warnings_ = nullptr;
+  Gauge* m_dead_ranks_ = nullptr;
+  Gauge* m_cycle_time_gauge_ = nullptr;
+  Gauge* m_fusion_threshold_gauge_ = nullptr;
+  Gauge* m_cache_enabled_gauge_ = nullptr;
+  Gauge* m_crossover_gauge_ = nullptr;
+  Gauge* m_hier_gauge_ = nullptr;
+  Gauge* m_comp_mode_gauge_ = nullptr;
+  Histogram* m_fusion_batch_bytes_ = nullptr;
+  Histogram* m_fusion_utilization_ = nullptr;
+  Counter* m_fused_tensors_ = nullptr;
+  Counter* m_op_errors_ = nullptr;
 };
 
 void Core::RequestTimeline(bool start, const std::string& path,
@@ -439,6 +480,49 @@ void Core::ApplyTimelineRequest() {
   }
 }
 
+void Core::ObserveOp(const char* op, double secs, int64_t bytes,
+                     const char* algo, const std::string& transport,
+                     bool hier, const char* compression, DataType dtype,
+                     bool ok) {
+  MetricLabels labels{{"op", op},
+                      {"algo", algo},
+                      {"transport", transport},
+                      {"hier", hier ? "1" : "0"},
+                      {"compression", compression},
+                      {"dtype", DataTypeName(dtype)}};
+  metrics_
+      .GetHistogram("hvdtpu_op_seconds",
+                    "Data-plane wall time per collective op", LatencyBuckets(),
+                    labels)
+      ->Observe(secs);
+  metrics_
+      .GetHistogram("hvdtpu_op_bytes",
+                    "Payload bytes per collective op (raw, pre-compression)",
+                    BytesBuckets(), labels)
+      ->Observe(static_cast<double>(bytes));
+  metrics_
+      .GetCounter("hvdtpu_ops_total", "Completed collective ops",
+                  MetricLabels{{"op", op}})
+      ->Inc();
+  if (!ok) m_op_errors_->Inc();
+}
+
+void Core::UpdateParamGauges(double cycle_ms, int64_t fusion, bool cache_on,
+                             int64_t crossover) {
+  m_cycle_time_gauge_->Set(cycle_ms);
+  m_fusion_threshold_gauge_->Set(static_cast<double>(fusion));
+  m_cache_enabled_gauge_->Set(cache_on ? 1 : 0);
+  m_crossover_gauge_->Set(static_cast<double>(crossover));
+  // hier/compression are read back from the just-applied state so the
+  // gauges always show the EFFECTIVE values (forced or autotuned).
+  m_hier_gauge_->Set(data_plane_.hier_active() ? 1 : 0);
+  const int32_t comp =
+      cfg_.wire_compression == static_cast<int32_t>(WireCompression::AUTO)
+          ? comp_auto_
+          : cfg_.wire_compression;
+  m_comp_mode_gauge_->Set(static_cast<double>(comp));
+}
+
 double Core::CurrentCycleTimeMs() {
   std::lock_guard<std::mutex> lk(mu_);
   return cfg_.cycle_time_ms;
@@ -455,6 +539,70 @@ Status Core::Start() {
     timeline_.Initialize(cfg_.timeline_path, cfg_.rank);
   }
   cache_.SetCapacity(cfg_.cache_capacity);
+
+  // Metrics registry: route data-plane byte accounting into this core's
+  // registry (single source of truth behind hvdtpu_wire_stats AND /metrics)
+  // and pre-resolve every fixed-label handle the background loop touches.
+  data_plane_.set_metrics(&metrics_);
+  metrics_.GetGauge("hvdtpu_rank", "This worker's global rank")
+      ->Set(cfg_.rank);
+  metrics_.GetGauge("hvdtpu_world_size", "Number of ranks in the world")
+      ->Set(cfg_.size);
+  m_cycles_ = metrics_.GetCounter(
+      "hvdtpu_cycles_total", "Background-loop coordination cycles run");
+  m_cycle_hist_ = metrics_.GetHistogram(
+      "hvdtpu_cycle_seconds",
+      "Coordination tick latency: wall time of one background-loop cycle "
+      "(control-plane pump + any collectives it executed)",
+      LatencyBuckets());
+  m_queue_depth_ = metrics_.GetGauge(
+      "hvdtpu_negotiation_queue_depth",
+      "Coordinator message_table_ size: tensors announced by some ranks "
+      "and still waiting for the rest (always 0 on non-coordinators)");
+  m_outstanding_ = metrics_.GetGauge(
+      "hvdtpu_outstanding_ops",
+      "Collectives enqueued on this rank and not yet completed");
+  m_stalled_ = metrics_.GetGauge(
+      "hvdtpu_stalled",
+      "1 while the stall inspector sees at least one tensor past the "
+      "warning threshold, else 0 (coordinator only)");
+  m_stall_warnings_ = metrics_.GetCounter(
+      "hvdtpu_stall_warnings_total", "Stall warnings emitted by rank 0");
+  m_dead_ranks_ = metrics_.GetGauge(
+      "hvdtpu_dead_ranks",
+      "Workers that disconnected without joining (coordinator only)");
+  m_cycle_time_gauge_ = metrics_.GetGauge(
+      "hvdtpu_cycle_time_ms", "Current (possibly autotuned) cycle time");
+  m_fusion_threshold_gauge_ = metrics_.GetGauge(
+      "hvdtpu_fusion_threshold_bytes",
+      "Current (possibly autotuned) tensor-fusion threshold");
+  m_cache_enabled_gauge_ = metrics_.GetGauge(
+      "hvdtpu_cache_enabled",
+      "1 when the response-cache bare-name fast path is active");
+  m_crossover_gauge_ = metrics_.GetGauge(
+      "hvdtpu_algo_crossover_bytes",
+      "Current (possibly autotuned) ring/latency-algorithm crossover");
+  m_hier_gauge_ = metrics_.GetGauge(
+      "hvdtpu_hier_enabled",
+      "1 when the hierarchical two-level allreduce is on (forced or "
+      "autotuned)");
+  m_comp_mode_gauge_ = metrics_.GetGauge(
+      "hvdtpu_compression_mode",
+      "Effective wire-compression mode code (0 none, 1 fp16, 2 int8, "
+      "3 int4; under auto, the autotuner's current choice)");
+  m_fusion_batch_bytes_ = metrics_.GetHistogram(
+      "hvdtpu_fusion_batch_bytes",
+      "Total payload bytes per fused allreduce batch", BytesBuckets());
+  m_fusion_utilization_ = metrics_.GetHistogram(
+      "hvdtpu_fusion_utilization",
+      "Fused batch bytes as a fraction of the fusion threshold",
+      {0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0});
+  m_fused_tensors_ = metrics_.GetCounter(
+      "hvdtpu_fused_tensors_total",
+      "Tensors that rode a multi-tensor fused allreduce batch");
+  m_op_errors_ = metrics_.GetCounter(
+      "hvdtpu_op_errors_total", "Collectives that completed with an error");
+
   data_plane_.set_allreduce_algo(
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
   data_plane_.set_crossover_bytes(cfg_.allreduce_crossover);
@@ -652,6 +800,9 @@ Status Core::Start() {
                               cfg_.autotune_gp_noise);
   }
 
+  UpdateParamGauges(cfg_.cycle_time_ms, cfg_.fusion_threshold,
+                    cache_.enabled(), data_plane_.crossover_bytes());
+
   shutdown_ = false;
   background_ = std::thread([this] { BackgroundLoop(); });
   started_ = true;
@@ -826,7 +977,20 @@ void Core::BackgroundLoop() {
     if (shutdown_) break;
     ApplyTimelineRequest();
     if (cfg_.timeline_mark_cycles) timeline_.MarkCycle();
+    const double t0 = NowSeconds();
     PumpControlPlane();
+    // Coordination-tick accounting: latency of the productive part of the
+    // cycle (the idle poll in WaitForWork is deliberately excluded — an
+    // idle worker would otherwise bury the signal under cycle_time_ms
+    // observations) plus the queue-depth/outstanding gauges.
+    m_cycles_->Inc();
+    m_cycle_hist_->Observe(NowSeconds() - t0);
+    m_queue_depth_->Set(static_cast<double>(message_table_.size()));
+    m_dead_ranks_->Set(static_cast<double>(dead_ranks_.size()));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      m_outstanding_->Set(static_cast<double>(outstanding_.size()));
+    }
   }
 }
 
@@ -975,10 +1139,14 @@ void Core::PumpControlPlane() {
         data_plane_.set_crossover_bytes(crossover);
         data_plane_.set_hier_auto(hier_on);
         comp_auto_ = comp;
-        std::lock_guard<std::mutex> lk(mu_);
-        cfg_.cycle_time_ms = cycle;
-        cfg_.fusion_threshold = fusion;
-        cache_.SetEnabled(cache_on);
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          cfg_.cycle_time_ms = cycle;
+          cfg_.fusion_threshold = fusion;
+          cache_.SetEnabled(cache_on);
+        }
+        UpdateParamGauges(cycle, fusion, cache_on,
+                          data_plane_.crossover_bytes());
         continue;
       }
       if (type != CtrlMsg::RESPONSES) continue;
@@ -1426,6 +1594,8 @@ void Core::CoordinatorEmitResponses() {
         cfg_.fusion_threshold = p.fusion_threshold;
         cache_.SetEnabled(p.cache_enabled);
       }
+      UpdateParamGauges(p.cycle_time_ms, p.fusion_threshold, p.cache_enabled,
+                        data_plane_.crossover_bytes());
       if (cfg_.size > 1) {
         Writer w;
         w.I32(static_cast<int32_t>(CtrlMsg::PARAMS));
@@ -1535,18 +1705,18 @@ void Core::ExecuteResponse(const Response& resp) {
     }
     comp = EffectiveCompression(resp, total_bytes);
   }
+  const char* opname = resp.op_type == OpType::ALLREDUCE ? "ALLREDUCE"
+                       : resp.op_type == OpType::ALLGATHER ? "ALLGATHER"
+                       : resp.op_type == OpType::BROADCAST ? "BROADCAST"
+                       : resp.op_type == OpType::ALLTOALL ? "ALLTOALL"
+                                                          : "REDUCESCATTER";
   for (auto* e : entries) {
     timeline_.ActivityStart(
-        e->name,
-        resp.op_type == OpType::ALLREDUCE ? "ALLREDUCE"
-        : resp.op_type == OpType::ALLGATHER ? "ALLGATHER"
-        : resp.op_type == OpType::BROADCAST ? "BROADCAST"
-        : resp.op_type == OpType::ALLTOALL ? "ALLTOALL"
-                                            : "REDUCESCATTER",
-        lane,
+        e->name, opname, lane,
         resp.op_type == OpType::ALLREDUCE ? WireCompressionName(comp) : "");
   }
 
+  const double op_t0 = NowSeconds();
   Status st = Status::OK();
   switch (resp.op_type) {
     case OpType::ALLREDUCE: {
@@ -1623,6 +1793,14 @@ void Core::ExecuteResponse(const Response& resp) {
     }
     case OpType::JOIN:
       break;
+  }
+
+  // Non-allreduce ops carry no algorithm/compression dimension; label them
+  // neutrally so the op/transport/dtype breakdown still aggregates cleanly.
+  if (!entries.empty()) {
+    ObserveOp(opname, NowSeconds() - op_t0, entries[0]->byte_size(), "none",
+              data_plane_.transport_label(), false, "none", resp.dtype,
+              st.ok());
   }
 
   for (auto* e : entries) {
@@ -1742,6 +1920,29 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
   size_t elem = DataTypeSize(resp.dtype);
   int64_t total_elems = 0;
   for (const auto& s : resp.shapes) total_elems += NumElements(s);
+  const int64_t total_bytes = total_elems * static_cast<int64_t>(elem);
+
+  // Fusion-buffer utilization: how full each negotiated batch ran against
+  // the (possibly autotuned) threshold. Single-tensor batches count too —
+  // a utilization histogram stuck near 0 is the "raise the threshold or
+  // slow the cycle" signal the reference surfaces only via timeline
+  // archaeology.
+  m_fusion_batch_bytes_->Observe(static_cast<double>(total_bytes));
+  {
+    int64_t threshold;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      threshold = cfg_.fusion_threshold;
+    }
+    if (threshold > 0) {
+      m_fusion_utilization_->Observe(static_cast<double>(total_bytes) /
+                                     static_cast<double>(threshold));
+    }
+  }
+  if (entries.size() > 1) {
+    m_fused_tensors_->Add(static_cast<int64_t>(entries.size()));
+  }
+  const double op_t0 = NowSeconds();
 
   // Error-feedback residuals live at the compressing rank, keyed by the
   // fused batch's name signature (steady-state fusions reuse the buffer;
@@ -1782,6 +1983,10 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
                                  resp.reduce_op);
     }
     data_plane_.EndCompressedOp();
+    ObserveOp("ALLREDUCE", NowSeconds() - op_t0, total_bytes,
+              data_plane_.last_algo_label(), data_plane_.transport_label(),
+              data_plane_.hier_active(), WireCompressionName(comp),
+              resp.dtype, st.ok());
     if (st.ok()) {
       ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->postscale);
     }
@@ -1817,6 +2022,10 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
   data_plane_.EndCompressedOp();
   const int64_t op_raw = data_plane_.op_raw_bytes();
   const int64_t op_wire = data_plane_.op_wire_bytes();
+  ObserveOp("ALLREDUCE", NowSeconds() - op_t0, total_bytes,
+            data_plane_.last_algo_label(), data_plane_.transport_label(),
+            data_plane_.hier_active(), WireCompressionName(comp), resp.dtype,
+            st.ok());
 
   off = 0;
   for (size_t i = 0; i < entries.size(); ++i) {
@@ -1842,6 +2051,17 @@ void Core::CheckStalls() {
   // and force-shuts-down after stall_shutdown_secs (stall_inspector.cc
   // ShutdownIfStalled).
   double now = NowSeconds();
+  // `stalled` gauge: 1 while ANY tensor sits past the warning threshold
+  // (not just at the warning edge — it stays up until the laggard arrives
+  // and the slot leaves message_table_, so a scrape can't miss the window).
+  bool any_stalled = false;
+  for (const auto& kv : message_table_) {
+    if (now - kv.second.first_seen >= cfg_.stall_warn_secs) {
+      any_stalled = true;
+      break;
+    }
+  }
+  m_stalled_->Set(any_stalled ? 1 : 0);
   for (auto& kv : message_table_) {
     auto& slot = kv.second;
     if (cfg_.stall_shutdown_secs > 0 &&
@@ -1872,6 +2092,7 @@ void Core::CheckStalls() {
             kv.first.c_str(), have.c_str(), missing.c_str(),
             now - slot.first_seen);
     slot.stall_warned = true;
+    m_stall_warnings_->Inc();
   }
 }
 
@@ -2053,14 +2274,32 @@ int hvdtpu_set_compression(void* core, int mode, long long min_bytes,
 
 // Cumulative bytes-on-wire accounting for this rank's allreduce payloads:
 // raw = what the data plane would have sent uncompressed, wire = what it
-// actually sent (equal when compression is off). The per-op values ride the
-// timeline (docs/timeline.md raw_bytes/wire_bytes).
+// actually sent (equal when compression is off). Thin shim over the metrics
+// registry's hvdtpu_allreduce_{raw,wire}_bytes_total counters — the single
+// source of truth also served by hvdtpu_metrics_dump / the /metrics
+// endpoint. The per-op values ride the timeline (docs/timeline.md
+// raw_bytes/wire_bytes).
 void hvdtpu_wire_stats(void* core, long long* raw_bytes,
                        long long* wire_bytes) {
   int64_t raw = 0, wire = 0;
   static_cast<Core*>(core)->WireStats(&raw, &wire);
   if (raw_bytes != nullptr) *raw_bytes = raw;
   if (wire_bytes != nullptr) *wire_bytes = wire;
+}
+
+// Live-metrics dump (metrics.h): renders every registered series in
+// Prometheus text exposition format 0.0.4. Copies up to `buflen` bytes into
+// `buf` (NUL-terminated when there is room) and returns the FULL rendered
+// length — callers probe with (NULL, 0), allocate, and call again, looping
+// if the registry grew in between. Callable from any thread.
+long long hvdtpu_metrics_dump(void* core, char* buf, long long buflen) {
+  std::string text = static_cast<Core*>(core)->MetricsDump();
+  if (buf != nullptr && buflen > 0) {
+    long long n = std::min<long long>(buflen, text.size());
+    std::memcpy(buf, text.data(), static_cast<size_t>(n));
+    if (n < buflen) buf[n] = '\0';
+  }
+  return static_cast<long long>(text.size());
 }
 
 // Standalone quantizer entry points (no core instance needed): the

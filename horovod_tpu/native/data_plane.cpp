@@ -364,6 +364,18 @@ DataPlane::DataPlane(int rank, int size)
   for (int r = 0; r < size; ++r) world_group_[r] = r;
   local_group_ = {rank};
   leaders_ = {0};
+  own_metrics_.reset(new Metrics());
+  set_metrics(own_metrics_.get());
+}
+
+void DataPlane::set_metrics(Metrics* m) {
+  metrics_ = m;
+  raw_bytes_total_ = metrics_->GetCounter(
+      "hvdtpu_allreduce_raw_bytes_total",
+      "Allreduce payload bytes this rank would have sent uncompressed");
+  wire_bytes_total_ = metrics_->GetCounter(
+      "hvdtpu_allreduce_wire_bytes_total",
+      "Allreduce payload bytes this rank actually sent on the wire");
 }
 
 DataPlane::~DataPlane() { Shutdown(); }
@@ -621,12 +633,19 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
                             ReduceOp op) {
   op_raw_bytes_ = 0;
   op_wire_bytes_ = 0;
+  last_algo_label_ = "none";
   if (size_ == 1 || count == 0) return Status::OK();
-  Status st = hier_active()
-                  ? HierarchicalAllreduce(data, count, dtype, op)
-                  : AllreduceGroup(data, count, dtype, op, world_group_);
-  total_raw_bytes_ += op_raw_bytes_;
-  total_wire_bytes_ += op_wire_bytes_;
+  Status st;
+  if (hier_active()) {
+    st = HierarchicalAllreduce(data, count, dtype, op);
+    // Overwrites the leader-phase AllreduceGroup label: the op as a whole
+    // took the two-level path.
+    last_algo_label_ = "hierarchical";
+  } else {
+    st = AllreduceGroup(data, count, dtype, op, world_group_);
+  }
+  raw_bytes_total_->Add(op_raw_bytes_);
+  wire_bytes_total_->Add(op_wire_bytes_);
   return st;
 }
 
@@ -639,6 +658,9 @@ Status DataPlane::AllreduceGroup(void* data, int64_t count, DataType dtype,
     algo = bytes <= crossover_bytes_ ? AllreduceAlgo::RECURSIVE_DOUBLING
                                      : AllreduceAlgo::RING;
   }
+  last_algo_label_ = algo == AllreduceAlgo::RECURSIVE_DOUBLING
+                         ? "recursive_doubling"
+                         : algo == AllreduceAlgo::TREE ? "tree" : "ring";
   switch (algo) {
     case AllreduceAlgo::RECURSIVE_DOUBLING:
       if (CompressionActive(dtype, op)) {
@@ -1188,6 +1210,7 @@ void AddInto(T* dst, const T* src, int64_t count) {
 Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   op_raw_bytes_ = 0;
   op_wire_bytes_ = 0;
+  last_algo_label_ = "adasum";
   if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64) {
     return Status::Error(StatusCode::INVALID_ARGUMENT,
                          "Adasum supports float32/float64 only, got " +
@@ -1257,8 +1280,8 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
       return Status::Error(StatusCode::ABORTED, "adasum unfold recv failed");
     }
   }
-  total_raw_bytes_ += op_raw_bytes_;
-  total_wire_bytes_ += op_wire_bytes_;
+  raw_bytes_total_->Add(op_raw_bytes_);
+  wire_bytes_total_->Add(op_wire_bytes_);
   return Status::OK();
 }
 

@@ -18,6 +18,7 @@
 
 #include "common.h"
 #include "compressed.h"
+#include "metrics.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -138,12 +139,26 @@ class DataPlane {
   // Payload accounting for the timeline's per-op raw_bytes/wire_bytes args
   // and the cumulative hvdtpu_wire_stats counters: raw = bytes this rank
   // would have sent uncompressed, wire = bytes actually sent. Reset by
-  // Allreduce/AdasumAllreduce at entry; totals are atomics (user threads
-  // read them through the C API while the background thread runs ops).
+  // Allreduce/AdasumAllreduce at entry; cumulative totals live in the
+  // metrics registry (hvdtpu_allreduce_{raw,wire}_bytes_total) — the single
+  // source of truth behind both hvdtpu_wire_stats and /metrics — whose
+  // lock-free counters user threads may read while the background thread
+  // runs ops.
   int64_t op_raw_bytes() const { return op_raw_bytes_; }
   int64_t op_wire_bytes() const { return op_wire_bytes_; }
-  int64_t total_raw_bytes() const { return total_raw_bytes_; }
-  int64_t total_wire_bytes() const { return total_wire_bytes_; }
+  int64_t total_raw_bytes() const { return raw_bytes_total_->Get(); }
+  int64_t total_wire_bytes() const { return wire_bytes_total_->Get(); }
+
+  // Metrics registry to account into. The DataPlane constructor wires up a
+  // private registry so standalone instances (unit tests, bench harness)
+  // always have live counters; the core injects its own registry before
+  // Listen() so data-plane series land in the worker's /metrics dump.
+  void set_metrics(Metrics* m);
+  // Label of the algorithm the LAST Allreduce actually ran ("ring",
+  // "recursive_doubling", "tree", with AUTO resolved by size; "hier" phases
+  // report the top-level "hierarchical"). Background thread only — set by
+  // Allreduce, read by the core's per-op metric labels.
+  const char* last_algo_label() const { return last_algo_label_; }
 
   // Gather variable-length byte blocks from every rank; out = concatenated in
   // rank order. block_bytes[r] gives each rank's contribution size.
@@ -276,13 +291,21 @@ class DataPlane {
   int64_t inline_max_bytes_ = 0;
 
   // Per-op wire compression state (background thread only) + payload
-  // accounting (totals readable cross-thread).
+  // accounting (cumulative totals live in the metrics registry, readable
+  // cross-thread).
   WireCompression op_comp_ = WireCompression::NONE;
   float* op_residual_ = nullptr;
   int64_t op_raw_bytes_ = 0;
   int64_t op_wire_bytes_ = 0;
-  std::atomic<int64_t> total_raw_bytes_{0};
-  std::atomic<int64_t> total_wire_bytes_{0};
+  const char* last_algo_label_ = "none";
+  // Registry state last and behind a pointer: embedding the fallback
+  // registry inline shifted the hot members across cache lines and cost a
+  // measurable ~3% on the 64 MB shm ring bench (layout, not work — the
+  // counter adds themselves are two relaxed atomics per op).
+  std::unique_ptr<Metrics> own_metrics_;  // fallback for standalone use
+  Metrics* metrics_ = nullptr;
+  Counter* raw_bytes_total_ = nullptr;
+  Counter* wire_bytes_total_ = nullptr;
 };
 
 // dst[i] = dst[i] OP src[i], accumulating fp16/bf16 in float.

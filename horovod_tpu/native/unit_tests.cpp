@@ -32,6 +32,7 @@
 #include "compressed.h"
 #include "data_plane.h"
 #include "message.h"
+#include "metrics.h"
 #include "shm_transport.h"
 #include "socket_util.h"
 
@@ -1106,6 +1107,120 @@ void TestParameterManagerFreezesAtBest() {
   CHECK_TRUE(pinned.Current().wire_compression == 3);
 }
 
+// --- metrics registry (metrics.{h,cpp}) ------------------------------------
+
+void TestMetricsConcurrentIncrements() {
+  // 8 threads hammering one counter, one gauge, and one histogram through
+  // freshly-resolved handles: no increment may be lost (counter/histogram
+  // count are atomic adds) and the dump must reflect the exact totals.
+  Metrics m;
+  constexpr int kThreads = 8;
+  constexpr int kIters = 20000;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&m, t] {
+      Counter* c = m.GetCounter("test_ops_total", "ops");
+      Histogram* h = m.GetHistogram("test_lat_seconds", "lat", {0.5, 2.0});
+      Gauge* g = m.GetGauge("test_depth", "depth");
+      for (int i = 0; i < kIters; ++i) {
+        c->Inc();
+        h->Observe(i % 2 == 0 ? 0.25 : 1.0);
+        g->Set(t);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK_TRUE(m.GetCounter("test_ops_total", "ops")->Get() ==
+             kThreads * kIters);
+  Histogram* h = m.GetHistogram("test_lat_seconds", "lat", {0.5, 2.0});
+  CHECK_TRUE(h->Count() == kThreads * kIters);
+  CHECK_TRUE(h->BucketCount(0) == kThreads * kIters / 2);  // 0.25 <= 0.5
+  CHECK_TRUE(h->BucketCount(1) == kThreads * kIters / 2);  // 0.5 < 1.0 <= 2
+  CHECK_TRUE(h->BucketCount(2) == 0);                      // +Inf bucket
+  // Sum is CAS-accumulated: exact (all observed values are binary fractions).
+  CHECK_TRUE(h->Sum() == kThreads * kIters * (0.25 + 1.0) / 2);
+  double depth = m.GetGauge("test_depth", "depth")->Get();
+  CHECK_TRUE(depth >= 0 && depth < kThreads);  // some thread's last Set
+}
+
+void TestMetricsHistogramBucketBoundaries() {
+  // Prometheus contract: `le` is INCLUSIVE — a value exactly on a bound
+  // lands in that bucket; the first value past the last bound goes to +Inf.
+  Metrics m;
+  Histogram* h =
+      m.GetHistogram("test_bytes", "bytes", {10.0, 100.0, 1000.0});
+  h->Observe(10.0);    // bucket 0 (le=10)
+  h->Observe(10.5);    // bucket 1
+  h->Observe(100.0);   // bucket 1 (le=100)
+  h->Observe(1000.0);  // bucket 2
+  h->Observe(1000.5);  // +Inf
+  CHECK_TRUE(h->BucketCount(0) == 1);
+  CHECK_TRUE(h->BucketCount(1) == 2);
+  CHECK_TRUE(h->BucketCount(2) == 1);
+  CHECK_TRUE(h->BucketCount(3) == 1);
+  CHECK_TRUE(h->Count() == 5);
+
+  std::string dump = m.Dump();
+  // Cumulative rendering: le="100" must count buckets 0+1 = 3.
+  CHECK_TRUE(dump.find("test_bytes_bucket{le=\"100\"} 3") !=
+             std::string::npos);
+  CHECK_TRUE(dump.find("test_bytes_bucket{le=\"+Inf\"} 5") !=
+             std::string::npos);
+  CHECK_TRUE(dump.find("test_bytes_count 5") != std::string::npos);
+}
+
+void TestMetricsDumpDeterminism() {
+  // Identical contents registered in different orders must render to the
+  // SAME text (families sorted by name, series by label string) — the
+  // aggregator and tests diff dumps across ranks.
+  auto build = [](bool reversed) {
+    auto m = std::make_unique<Metrics>();
+    auto add = [&](int which) {
+      if (which == 0) {
+        m->GetCounter("zz_total", "z", {{"op", "A"}})->Add(3);
+      } else if (which == 1) {
+        m->GetCounter("zz_total", "z", {{"op", "B"}})->Add(4);
+      } else {
+        m->GetGauge("aa_depth", "a")->Set(7);
+      }
+    };
+    if (reversed) { add(2); add(1); add(0); }
+    else { add(0); add(1); add(2); }
+    return m;
+  };
+  std::string d1 = build(false)->Dump();
+  std::string d2 = build(true)->Dump();
+  CHECK_TRUE(d1 == d2);
+  // aa_depth sorts before zz_total; labeled series sort by label string.
+  CHECK_TRUE(d1.find("aa_depth") < d1.find("zz_total"));
+  CHECK_TRUE(d1.find("zz_total{op=\"A\"} 3") < d1.find("zz_total{op=\"B\"} 4"));
+  // Every non-comment line is `name{labels} value` — well-formed exposition.
+  CHECK_TRUE(d1.find("# TYPE aa_depth gauge") != std::string::npos);
+  CHECK_TRUE(d1.find("# TYPE zz_total counter") != std::string::npos);
+}
+
+void TestMetricsLabelEscaping() {
+  Metrics m;
+  m.GetCounter("esc_total", "esc", {{"name", "a\"b\\c\nd"}})->Inc();
+  std::string dump = m.Dump();
+  CHECK_TRUE(dump.find("esc_total{name=\"a\\\"b\\\\c\\nd\"} 1") !=
+             std::string::npos);
+}
+
+void TestDataPlaneWireCountersInRegistry() {
+  // The DataPlane's cumulative byte accounting must live in the injected
+  // registry (single source of truth for hvdtpu_wire_stats AND /metrics).
+  Metrics m;
+  DataPlane plane(0, 1);
+  plane.set_metrics(&m);
+  CHECK_TRUE(plane.total_raw_bytes() == 0);
+  Counter* raw = m.GetCounter("hvdtpu_allreduce_raw_bytes_total", "");
+  raw->Add(123);
+  CHECK_TRUE(plane.total_raw_bytes() == 123);
+  CHECK_TRUE(m.Dump().find("hvdtpu_allreduce_raw_bytes_total 123") !=
+             std::string::npos);
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -1131,6 +1246,11 @@ int main() {
   TestDataPlaneCompressedAllreduce();
   TestDataPlaneCompressedHierarchical();
   TestReduceBufferOps();
+  TestMetricsConcurrentIncrements();
+  TestMetricsHistogramBucketBoundaries();
+  TestMetricsDumpDeterminism();
+  TestMetricsLabelEscaping();
+  TestDataPlaneWireCountersInRegistry();
   TestGaussianProcessInterpolates();
   TestBayesianOptimizerPicksBestSample();
   TestParameterManagerFreezesAtBest();

@@ -90,6 +90,15 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                    help="allreduce payloads below this many bytes stay "
                         "uncompressed (HVDTPU_COMPRESSION_MIN_BYTES; "
                         "default 1024)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="base port for the live-metrics endpoints "
+                        "(HVDTPU_METRICS_PORT): worker rank r serves "
+                        "/metrics + /healthz on base+r; the driver serves "
+                        "the merged world view on base+np and prints a "
+                        "periodic one-line summary. 0 (default) disables")
+    p.add_argument("--metrics-interval", type=float, default=None,
+                   help="driver scrape/summary period in seconds "
+                        "(HVDTPU_METRICS_INTERVAL; default 10)")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
@@ -186,7 +195,8 @@ Available Tensor Operations:
     {mark(True)} hierarchical allreduce (ICI/DCN)
     {mark(True)} join
     {mark(True)} compressed allreduce (maxmin/uni/exp/topk + error feedback)
-    {mark(native)} wire compression, process mode (fp16/int8/int4 + error feedback)"""
+    {mark(native)} wire compression, process mode (fp16/int8/int4 + error feedback)
+    {mark(native)} live metrics (/metrics + /healthz per worker, driver aggregation)"""
 
 
 def _install_config_file_defaults(path: str, parser) -> None:
@@ -211,16 +221,23 @@ def _free_port() -> int:
     return port
 
 
+def _ensure_job_secret(args) -> str:
+    """One shared secret per job (reference: runner/common/util/secret.py,
+    generated by the launcher and injected into every worker): the native
+    controller, the HTTP KV store, and the metrics endpoints reject
+    unauthenticated connections. Idempotent; a user-exported
+    ``HVDTPU_SECRET`` wins over generation."""
+    if not getattr(args, "_job_secret", None):
+        import secrets as _secrets
+        args._job_secret = os.environ.get(ev.HVDTPU_SECRET) or \
+            _secrets.token_hex(16)
+    return args._job_secret
+
+
 def _apply_tuning_env(env: dict, args) -> dict:
     """Forward the runtime tuning knobs shared by the static and elastic
     paths (reference: config_parser.py mapping CLI flags → HOROVOD_* env)."""
-    # One shared secret per job (reference: runner/common/util/secret.py,
-    # generated by the launcher and injected into every worker): the native
-    # controller and the HTTP KV store reject unauthenticated connections.
-    if not getattr(args, "_job_secret", None):
-        import secrets as _secrets
-        args._job_secret = os.environ.get(ev.HVDTPU_SECRET) or             _secrets.token_hex(16)
-    env[ev.HVDTPU_SECRET] = args._job_secret
+    env[ev.HVDTPU_SECRET] = _ensure_job_secret(args)
     env[ev.HVDTPU_CYCLE_TIME] = str(args.cycle_time_ms)
     env[ev.HVDTPU_FUSION_THRESHOLD] = str(
         int(args.fusion_threshold_mb * 1024 * 1024))
@@ -254,6 +271,14 @@ def _apply_tuning_env(env: dict, args) -> dict:
                 "hvdrun: --compression-min-bytes must be >= 0")
         env[ev.HVDTPU_COMPRESSION_MIN_BYTES] = str(
             args.compression_min_bytes)
+    # Live metrics: the flag owns the knob only when passed (a
+    # user-exported HVDTPU_METRICS_PORT wins otherwise, like HVDTPU_SHM).
+    if args.metrics_port is not None:
+        if args.metrics_port < 0:
+            raise SystemExit("hvdrun: --metrics-port must be >= 0")
+        env[ev.HVDTPU_METRICS_PORT] = str(args.metrics_port)
+    if args.metrics_interval is not None:
+        env[ev.HVDTPU_METRICS_INTERVAL] = str(args.metrics_interval)
     if args.timeline:
         # Base path; per-worker suffixing happens where the worker identity
         # is known (static: per rank here in _build_env; elastic: the driver).
@@ -375,7 +400,7 @@ def run_launcher(args: argparse.Namespace) -> int:
     hostnames = [s.hostname for s in slots]
     if not args.no_preflight and any(not _is_local(h) for h in hostnames):
         from .preflight import check_connectivity
-        _apply_tuning_env({}, args)  # ensure args._job_secret exists
+        _ensure_job_secret(args)
         # listen_host = the slot that will actually run rank 0 (it binds the
         # port); controller_host may be an advertise ADDRESS of that host.
         check_connectivity(hostnames, controller_host, controller_port,
@@ -383,6 +408,35 @@ def run_launcher(args: argparse.Namespace) -> int:
                            timeout=args.preflight_timeout,
                            secret=args._job_secret,
                            listen_host=slots[0].hostname)
+
+    # Live metrics: preflight the per-worker ports (base+rank) and the
+    # driver aggregator port (base+np) BEFORE spawning, and print the
+    # scrape URLs so the operator can point a browser/Prometheus at them.
+    metrics_base = args.metrics_port if args.metrics_port is not None else \
+        ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
+    aggregator = None
+    if metrics_base > 0:
+        from .preflight import check_metrics_ports
+        agg_port = metrics_base + args.num_proc
+        check_metrics_ports(hostnames, metrics_base, aggregator_port=agg_port)
+        from .metrics_agg import MetricsAggregator
+        endpoints = {s.rank: (s.hostname, metrics_base + s.rank)
+                     for s in slots}
+        for s in slots:
+            print(f"hvdrun: metrics: rank {s.rank} -> "
+                  f"http://{s.hostname}:{metrics_base + s.rank}/metrics",
+                  file=sys.stderr)
+        # The aggregator binds on THIS (driver) machine, which need not be
+        # the controller host — advertise the driver's reachable address.
+        from .preflight import local_addr
+        print(f"hvdrun: metrics: world -> "
+              f"http://{local_addr()}:{agg_port}/metrics (aggregated)",
+              file=sys.stderr)
+        interval = (args.metrics_interval if args.metrics_interval is not None
+                    else ev.get_float(ev.HVDTPU_METRICS_INTERVAL, 10.0))
+        aggregator = MetricsAggregator(endpoints, port=agg_port,
+                                       secret=_ensure_job_secret(args),
+                                       interval_s=interval)
 
     commands, envs, names, stdins = [], [], [], []
     for slot in slots:
@@ -405,8 +459,15 @@ def run_launcher(args: argparse.Namespace) -> int:
         if args.verbose:
             print(f"hvdrun: {names[-1]}: {' '.join(commands[-1])}",
                   file=sys.stderr)
-    return safe_exec.run_workers(commands, envs, names, verbose=args.verbose,
-                                 stdin_datas=stdins)
+    if aggregator is not None:
+        aggregator.start()
+    try:
+        return safe_exec.run_workers(commands, envs, names,
+                                     verbose=args.verbose,
+                                     stdin_datas=stdins)
+    finally:
+        if aggregator is not None:
+            aggregator.stop()
 
 
 def main(argv: List[str] = None) -> int:

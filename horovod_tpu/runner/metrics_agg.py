@@ -1,0 +1,254 @@
+"""Driver-side metrics aggregation for ``hvdrun``.
+
+No reference analog: the reference's driver is launch-and-wait only, and its
+runtime visibility is the post-hoc timeline. Here the launcher scrapes every
+worker's ``/metrics`` endpoint (``HVDTPU_METRICS_PORT`` base + rank, secret
+proof attached), serves a merged world-level ``/metrics`` on
+``base + world_size`` — every per-rank sample re-labeled with ``rank="r"``
+so one Prometheus scrape of the driver sees the whole job — and prints a
+periodic one-line summary (step rate, wire compression ratio, slowest rank,
+stall flags) to stderr.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..observability import (MetricsServer, parse_prometheus_text,
+                             sample_value, scrape)
+
+# Greedy label block (matches observability.py's parser): a sample's value
+# never contains '}', so everything up to the LAST '}' is the label set —
+# the non-greedy [^}]* variant would skip samples whose label VALUES contain
+# '}' (legal under the exposition escaping rules) and leave them un-ranked.
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?(\s+\S+)$')
+
+
+def relabel_with_rank(text: str, rank: int) -> str:
+    """Inject ``rank="r"`` into every sample line of an exposition dump
+    (comment lines pass through untouched)."""
+    out: List[str] = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            out.append(line)
+            continue
+        name, labels, rest = m.group(1), m.group(2), m.group(3)
+        if labels:
+            labels = labels[:-1] + f',rank="{rank}"}}'
+        else:
+            labels = f'{{rank="{rank}"}}'
+        out.append(name + labels + rest)
+    return "\n".join(out) + "\n"
+
+
+def merge_dumps(dumps: Dict[int, str]) -> str:
+    """Merge per-rank dumps into one world-level exposition: every sample
+    gains a ``rank`` label, and all samples of a family stay in ONE
+    contiguous group under a single # HELP/# TYPE header (the exposition
+    format forbids interleaving a family's lines with other families —
+    strict consumers like promtool reject rank-by-rank concatenation).
+
+    Per-rank dumps are already family-grouped (native Dump() is sorted and
+    deterministic), so each is split into blocks at # HELP boundaries and
+    the blocks are re-joined family by family, ranks in order.
+    """
+    order: List[str] = []           # family names, first-seen order
+    meta: Dict[str, List[str]] = {}     # family -> its # HELP/# TYPE lines
+    samples: Dict[str, List[str]] = {}  # family -> relabeled sample lines
+
+    for rank in sorted(dumps):
+        family = ""
+        for line in relabel_with_rank(dumps[rank], rank).splitlines():
+            if not line:
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                    family = parts[2]
+                if family not in meta:
+                    order.append(family)
+                    meta[family] = []
+                    samples[family] = []
+                if line not in meta[family]:
+                    meta[family].append(line)
+                continue
+            if family not in meta:  # headerless dump (hand-rolled text)
+                order.append(family)
+                meta[family] = []
+                samples[family] = []
+            samples[family].append(line)
+
+    out: List[str] = []
+    for family in order:
+        out.extend(meta[family])
+        out.extend(samples[family])
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# Per-rank snapshot the summary differences between rounds:
+# (timestamp, {rank: ops_total}, {rank: (op_seconds_sum, op_count)}).
+SummaryPrev = Tuple[float, Dict[int, float], Dict[int, Tuple[float, float]]]
+
+
+def summarize(parsed_by_rank: Dict[int, dict],
+              prev: Optional[SummaryPrev],
+              now: float) -> Tuple[str, SummaryPrev]:
+    """One-line job summary from per-rank parsed metrics.
+
+    The op rate and the slowest-rank ms/op are INTERVAL deltas against
+    ``prev`` (a rank slow only during warmup must not be reported slowest
+    forever), computed per rank and only over ranks present in both
+    snapshots (a failed scrape must not dent the rate, then spike it when
+    the worker returns). Wire ratio and stall flags are levels. Returns
+    (line, new_prev).
+    """
+    ops_now: Dict[int, float] = {}
+    opsec_now: Dict[int, Tuple[float, float]] = {}
+    raw = wire = 0.0
+    stalled: List[int] = []
+    for rank, parsed in sorted(parsed_by_rank.items()):
+        ops_now[rank] = sum(
+            v for (suf, _l, v) in parsed.get("hvdtpu_ops_total",
+                                             {}).get("samples", [])
+            if suf == "")
+        raw += sample_value(parsed, "hvdtpu_allreduce_raw_bytes_total") or 0
+        wire += sample_value(parsed, "hvdtpu_allreduce_wire_bytes_total") or 0
+        if (sample_value(parsed, "hvdtpu_stalled") or 0) > 0:
+            stalled.append(rank)
+        secs = sum(v for (suf, _l, v) in
+                   parsed.get("hvdtpu_op_seconds", {}).get("samples", [])
+                   if suf == "sum")
+        count = sum(v for (suf, lbl, v) in
+                    parsed.get("hvdtpu_op_seconds", {}).get("samples", [])
+                    if suf == "bucket" and lbl.get("le") == "+Inf")
+        opsec_now[rank] = (secs, count)
+
+    rate = float("nan")
+    slowest_rank, slowest_avg = None, -1.0
+    if prev is not None:
+        t0, ops_prev, opsec_prev = prev
+        dt = max(now - t0, 1e-9)
+        rate = sum(ops_now[r] - ops_prev[r]
+                   for r in ops_now if r in ops_prev)
+        rate = max(rate, 0.0) / dt
+        for r, (secs, count) in opsec_now.items():
+            if r not in opsec_prev:
+                continue
+            dsecs = secs - opsec_prev[r][0]
+            dcount = count - opsec_prev[r][1]
+            if dcount > 0 and dsecs / dcount > slowest_avg:
+                slowest_avg, slowest_rank = dsecs / dcount, r
+    else:
+        # First round: no interval yet — fall back to lifetime averages.
+        for r, (secs, count) in opsec_now.items():
+            if count > 0 and secs / count > slowest_avg:
+                slowest_avg, slowest_rank = secs / count, r
+    ratio = raw / wire if wire > 0 else 1.0
+    parts = [
+        f"ops/s={rate:.1f}" if rate == rate else "ops/s=n/a",
+        f"wire_ratio={ratio:.2f}x",
+        (f"slowest=rank{slowest_rank}({slowest_avg * 1e3:.1f}ms/op)"
+         if slowest_rank is not None else "slowest=n/a"),
+        f"stalled={stalled if stalled else '[]'}",
+    ]
+    return "hvdrun metrics: " + " ".join(parts), (now, ops_now, opsec_now)
+
+
+class MetricsAggregator:
+    """Scrape-all-workers loop + merged world ``/metrics`` endpoint.
+
+    ``endpoints`` maps rank -> (host, port). The aggregator tolerates
+    unreachable workers (they drop out of the merged view until the next
+    successful scrape — a dead rank must not take the job's observability
+    down with it).
+    """
+
+    def __init__(self, endpoints: Dict[int, Tuple[str, int]],
+                 port: int = 0, secret: Optional[str] = None,
+                 interval_s: float = 10.0, print_summary: bool = True,
+                 out=None):
+        self._endpoints = dict(endpoints)
+        self._secret = secret
+        self._interval = interval_s
+        self._print = print_summary
+        self._out = out if out is not None else sys.stderr
+        self._merged = ""
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._prev: Optional[SummaryPrev] = None
+        self._server = MetricsServer(dump_fn=self.merged, port=port,
+                                     secret=secret,
+                                     health={"role": "driver",
+                                             "workers": len(endpoints)})
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def merged(self) -> str:
+        with self._lock:
+            return self._merged
+
+    def scrape_once(self) -> Dict[int, str]:
+        """One pass over every worker; refreshes the merged dump and
+        returns the raw per-rank texts (ranks that failed are absent).
+        Workers are scraped concurrently so a handful of dead endpoints
+        (3 s timeout each) cannot push one round past the summary interval
+        and stale the merged view exactly when the operator needs it."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(item):
+            rank, (host, port) = item
+            try:
+                return rank, scrape(host, port, secret=self._secret,
+                                    timeout=3.0)
+            except Exception:
+                return rank, None  # not up yet / mid-exit: skip this round
+
+        with ThreadPoolExecutor(
+                max_workers=min(16, max(1, len(self._endpoints)))) as pool:
+            results = list(pool.map(one, self._endpoints.items()))
+        dumps = {rank: text for rank, text in results if text is not None}
+        with self._lock:
+            self._merged = merge_dumps(dumps)
+        return dumps
+
+    def summary_line(self, dumps: Dict[int, str]) -> str:
+        parsed = {r: parse_prometheus_text(t) for r, t in dumps.items()}
+        line, self._prev = summarize(parsed, self._prev, time.monotonic())
+        return line
+
+    def _loop(self) -> None:
+        # Scrape-then-wait (not wait-then-scrape): the merged endpoint is
+        # advertised at launch, so it must populate as soon as workers come
+        # up, not one full --metrics-interval later. While no worker has
+        # answered yet (job still booting), retry on a short warmup period
+        # instead of sleeping out a potentially long interval.
+        while not self._stop.is_set():
+            dumps = self.scrape_once()
+            if self._print and dumps:
+                print(self.summary_line(dumps), file=self._out, flush=True)
+            wait = self._interval if dumps else min(1.0, self._interval)
+            if self._stop.wait(wait):
+                return
+
+    def start(self) -> None:
+        self._server.start()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._server.stop()

@@ -160,6 +160,43 @@ def probe_main() -> int:
     return 1
 
 
+def check_metrics_ports(hostnames: List[str], base_port: int,
+                        aggregator_port: Optional[int] = None) -> None:
+    """Bind-probe the per-worker metrics ports before spawning workers.
+
+    Worker rank r serves ``/metrics`` on ``base_port + r`` on its own host;
+    a port already in use would otherwise surface as a mid-rendezvous
+    worker death. Only LOCAL slots can be probed from here (remote binds
+    need the worker's host; those still fail fast inside ``hvd.init`` with
+    the port named). ``aggregator_port`` is the driver's merged endpoint —
+    always local. Raises ``RuntimeError`` naming every busy port.
+    """
+    from .safe_exec import is_local_host
+
+    failures = []
+    probes = [(host, base_port + rank, f"rank {rank}")
+              for rank, host in enumerate(hostnames)
+              if is_local_host(host)]
+    if aggregator_port is not None:
+        probes.append(("localhost", aggregator_port, "driver aggregator"))
+    for host, port, who in probes:
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind(("", port))
+        except OSError as e:
+            failures.append(f"  {who}: port {port} on {host}: {e}")
+        finally:
+            s.close()
+    if failures:
+        raise RuntimeError(
+            "metrics-port preflight failed (HVDTPU_METRICS_PORT / "
+            "--metrics-port assigns base+rank per worker):\n" +
+            "\n".join(failures) +
+            "\nPick a base port with world_size+1 free ports above it, "
+            "or set it to 0 to disable the live-metrics endpoints.")
+
+
 def check_connectivity(hostnames: List[str], controller_host: str,
                        controller_port: int,
                        spawn: Callable[[str, Dict[str, str]], object],

@@ -61,6 +61,9 @@ class _RuntimeState:
     dp_axis: str = DP_AXIS
     # Process-mode native controller handle (horovod_tpu.basics.NativeCore).
     core: Optional[object] = None
+    # Per-worker /metrics + /healthz endpoint (horovod_tpu.observability),
+    # started when HVDTPU_METRICS_PORT > 0 in process mode.
+    metrics_server: Optional[object] = None
     # Monotonic epoch, bumped on shutdown/re-init (elastic resets).
     epoch: int = 0
     # SPMD-mode timeline: an XLA profiler trace is active.
@@ -122,6 +125,12 @@ class _SingleRankCore:
 
     def fusion_threshold(self):
         return 0
+
+    def metrics_dump(self):
+        return ""  # no native registry without the compiled core
+
+    def metrics(self):
+        return {}
 
 
 _init_kwargs: dict = {}
@@ -367,6 +376,31 @@ def init(comm: Optional[Sequence[int]] = None,
                     "(horovod_tpu/basics.py + horovod_tpu/native); build "
                     "it with `make -C horovod_tpu/native`") from e
             st.core.start()
+            # Per-worker live-metrics endpoint: rank r serves /metrics +
+            # /healthz on HVDTPU_METRICS_PORT + r (0 = off), secret-gated
+            # like the rendezvous KV server. Started after the core so a
+            # scrape never races init; a bind failure is fatal and names
+            # the knob (hvdrun preflights the ports before spawning).
+            metrics_base = ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
+            if metrics_base > 0:
+                from .observability import MetricsServer
+                port = metrics_base + st.rank
+                try:
+                    st.metrics_server = MetricsServer(
+                        dump_fn=st.core.metrics_dump, port=port,
+                        secret=ev.get_str(ev.HVDTPU_SECRET) or None,
+                        health={"rank": st.rank, "size": st.size})
+                except OSError as exc:
+                    # The core already joined the world — tear it down
+                    # before failing or it would linger as a zombie rank
+                    # (holding the controller connection, and on rank 0
+                    # the controller port) past this failed init.
+                    st.core.shutdown()
+                    raise NotInitializedError(
+                        f"cannot bind the metrics endpoint on port {port} "
+                        f"({ev.HVDTPU_METRICS_PORT}={metrics_base} + rank "
+                        f"{st.rank}): {exc}") from exc
+                st.metrics_server.start()
             log.debug("init: process mode rank=%d size=%d local=%d/%d",
                       st.rank, st.size, st.local_rank, st.local_size)
         else:
@@ -399,6 +433,8 @@ def shutdown() -> None:
     with _lock:
         if not _state.initialized:
             return
+        if _state.metrics_server is not None:
+            _state.metrics_server.stop()
         if _state.core is not None:
             _state.core.shutdown()
         _state = _RuntimeState(epoch=_state.epoch)
@@ -493,6 +529,34 @@ def core():
 
 def epoch() -> int:
     return _state.epoch
+
+
+def metrics_dump() -> str:
+    """Prometheus text exposition of this worker's live metrics (process
+    mode; see ``docs/metrics.md`` for the catalog). The same text the
+    per-worker ``/metrics`` endpoint serves. SPMD mode has no native
+    background loop to instrument and returns an empty string — use the
+    XLA profiler there."""
+    st = _require_init()
+    if st.core is not None and hasattr(st.core, "metrics_dump"):
+        return st.core.metrics_dump()
+    return ""
+
+
+def metrics() -> dict:
+    """Parsed live-metrics snapshot:
+    ``{family: {"type", "help", "samples": [(suffix, labels, value)]}}``
+    (see :func:`horovod_tpu.observability.parse_prometheus_text`). Empty outside
+    process mode."""
+    from .observability import parse_prometheus_text
+    text = metrics_dump()
+    return parse_prometheus_text(text) if text else {}
+
+
+def metrics_server():
+    """The worker's running :class:`horovod_tpu.observability.MetricsServer`
+    (``HVDTPU_METRICS_PORT`` > 0 in process mode) or None."""
+    return _require_init().metrics_server
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

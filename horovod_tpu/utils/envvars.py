@@ -98,6 +98,17 @@ HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE = "HVDTPU_AUTOTUNE_STEPS_PER_SAMPLE"
 HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HVDTPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
 HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
 
+# Live metrics (native/metrics.{h,cpp} + horovod_tpu/observability.py; no
+# reference analog — the reference's only runtime visibility is the
+# post-hoc timeline). METRICS_PORT is the BASE port: worker rank r serves
+# /metrics + /healthz on base+r on its host; hvdrun's driver aggregator
+# serves the merged world view on base+world_size and prints a periodic
+# one-line summary. 0 (default) disables the endpoints (the in-process
+# hvd.metrics() dict and hvdtpu_metrics_dump C API always work).
+# METRICS_INTERVAL: driver scrape/summary period in seconds.
+HVDTPU_METRICS_PORT = "HVDTPU_METRICS_PORT"
+HVDTPU_METRICS_INTERVAL = "HVDTPU_METRICS_INTERVAL"
+
 # Logging (reference: HOROVOD_LOG_LEVEL, HOROVOD_LOG_HIDE_TIME —
 # horovod/common/logging.cc)
 HVDTPU_LOG_LEVEL = "HVDTPU_LOG_LEVEL"

@@ -1,0 +1,143 @@
+"""Worker: live-metrics endpoint smoke + counter-agreement checks.
+
+Runs a small collective mix, then asserts on this rank's own registry
+(hvd.metrics()), scrapes rank 0's HTTP /metrics + /healthz endpoint
+(HVDTPU_METRICS_PORT base + 0, HMAC proof attached when HVDTPU_SECRET is
+set), and — when TEST_TIMELINE_PATH is set — cross-checks the cumulative
+raw/wire byte counters against the sum of the timeline's per-op
+raw_bytes/wire_bytes args (the ISSUE 4 acceptance criterion: /metrics and
+the timeline must tell one story).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.observability import (parse_prometheus_text, sample_value,
+                                       scrape)  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+base = int(os.environ["HVDTPU_METRICS_PORT"])
+secret = os.environ.get("HVDTPU_SECRET") or None
+comp_mode = (os.environ.get("HVDTPU_COMPRESSION") or "none").lower()
+tl_path = os.environ.get("TEST_TIMELINE_PATH")
+if tl_path:
+    tl_path += f".{r}.json"
+    hvd.start_timeline(tl_path)
+
+# --- collective mix --------------------------------------------------------
+count = 1 << 16  # 256 KB fp32: above the compression min-bytes bypass
+for i in range(3):
+    x = np.full(count, float(r + i + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, name=f"grad/w{i}", op=hvd.Sum))
+    np.testing.assert_allclose(
+        out, np.full(count, sum(q + i + 1 for q in range(n)), np.float32))
+hvd.allgather(np.arange(4, dtype=np.float32) + r, name="gath")
+hvd.broadcast(np.full(8, 7.0, np.float32), root_rank=0, name="bcast")
+hvd.allreduce(np.ones(4, np.float32), name="barrier1", op=hvd.Sum)
+
+# --- own registry ----------------------------------------------------------
+m = hvd.metrics()
+assert (sample_value(m, "hvdtpu_ops_total", op="ALLREDUCE") or 0) >= 4, m
+assert (sample_value(m, "hvdtpu_ops_total", op="ALLGATHER") or 0) >= 1
+assert (sample_value(m, "hvdtpu_cycles_total") or 0) > 0
+assert sample_value(m, "hvdtpu_rank") == float(r)
+assert sample_value(m, "hvdtpu_world_size") == float(n)
+
+# Per-op latency histogram labeled by algo/transport/hier/compression/dtype:
+# the big fp32 allreduces must appear under the effective wire mode with a
+# real algorithm + transport label.
+op_samples = [
+    (lbl, v) for (suf, lbl, v) in m["hvdtpu_op_seconds"]["samples"]
+    if suf == "count" and lbl.get("op") == "ALLREDUCE"
+    and lbl.get("compression") == comp_mode]
+assert op_samples, m["hvdtpu_op_seconds"]["samples"]
+# The busiest label set is the three identical big ops (the tiny barrier
+# allreduce may land under a different algo label).
+lbl, lbl_count = max(op_samples, key=lambda s: s[1])
+assert lbl_count >= 3, op_samples
+assert lbl["algo"] in ("ring", "recursive_doubling", "tree",
+                       "hierarchical", "adasum"), lbl
+assert lbl["transport"] in ("shm", "tcp", "shm+tcp"), lbl
+assert lbl["hier"] in ("0", "1") and lbl["dtype"] == "float32", lbl
+# Matching bytes histogram under the same label set.
+assert (sample_value(m, "hvdtpu_op_bytes", suffix="count", **lbl) or 0) \
+    == lbl_count
+
+# Fusion instrumentation ran for every allreduce batch.
+assert (sample_value(m, "hvdtpu_fusion_batch_bytes", suffix="count")
+        or 0) >= 4
+
+# wire_stats() is a thin shim over the SAME registry counters.
+from horovod_tpu import runtime  # noqa: E402
+raw, wire = runtime._state.core.wire_stats()
+assert raw == sample_value(m, "hvdtpu_allreduce_raw_bytes_total"), (raw, m)
+assert wire == sample_value(m, "hvdtpu_allreduce_wire_bytes_total")
+assert raw > 0
+if comp_mode in ("fp16", "int8", "int4"):
+    assert wire < raw, (raw, wire)
+else:
+    assert wire == raw, (raw, wire)
+
+# --- scrape rank 0 over HTTP ----------------------------------------------
+# (every rank does it, proving the endpoint serves concurrent remote reads;
+# the final barrier keeps rank 0 alive until everyone finished scraping)
+text = scrape("127.0.0.1", base + 0, secret=secret, timeout=10.0)
+parsed = parse_prometheus_text(text)  # raises on malformed exposition
+for family in ("hvdtpu_cycle_seconds", "hvdtpu_op_seconds",
+               "hvdtpu_ops_total", "hvdtpu_allreduce_raw_bytes_total",
+               "hvdtpu_allreduce_wire_bytes_total", "hvdtpu_stalled",
+               "hvdtpu_negotiation_queue_depth", "hvdtpu_outstanding_ops",
+               "hvdtpu_cycle_time_ms", "hvdtpu_fusion_threshold_bytes"):
+    assert family in parsed, (family, sorted(parsed))
+assert parsed["hvdtpu_op_seconds"]["type"] == "histogram"
+assert sample_value(parsed, "hvdtpu_rank") == 0.0
+health = json.loads(scrape("127.0.0.1", base + 0, "/healthz",
+                           secret=secret, timeout=10.0))
+assert health["status"] == "ok" and health["rank"] == 0, health
+if secret:
+    # With a cluster secret set, a proof-less scrape of a LIVE worker
+    # endpoint must be rejected (tests/test_security.py satellite).
+    import urllib.error
+    try:
+        scrape("127.0.0.1", base + 0, timeout=10.0)
+        raise AssertionError("unauthenticated scrape was not rejected")
+    except urllib.error.HTTPError as e:
+        assert e.code == 403, e.code
+
+hvd.allreduce(np.ones(4, np.float32), name="barrier2", op=hvd.Sum)
+
+# --- timeline agreement ----------------------------------------------------
+if tl_path:
+    # Counters frozen after barrier2 (no further allreduces); the sum of the
+    # timeline's per-op raw/wire args must equal the cumulative counters.
+    m = hvd.metrics()
+    raw_total = sample_value(m, "hvdtpu_allreduce_raw_bytes_total")
+    wire_total = sample_value(m, "hvdtpu_allreduce_wire_bytes_total")
+    hvd.stop_timeline()
+    deadline = time.time() + 30
+    while True:
+        try:
+            events = json.load(open(tl_path))
+            break
+        except Exception:
+            assert time.time() < deadline, "timeline never closed"
+            time.sleep(0.05)
+    done = [e for e in events
+            if e.get("ph") == "E" and "raw_bytes" in e.get("args", {})]
+    assert done, "no raw_bytes op-done events in the timeline"
+    tl_raw = sum(e["args"]["raw_bytes"] for e in done)
+    tl_wire = sum(e["args"]["wire_bytes"] for e in done)
+    assert tl_raw == raw_total, (tl_raw, raw_total)
+    assert tl_wire == wire_total, (tl_wire, wire_total)
+
+hvd.shutdown()
+print("ALL OK")

@@ -1,0 +1,64 @@
+"""Worker: process-mode stall-WARNING regression (the core.cpp stall path
+had no test at all before the observability PR).
+
+Rank 1 withholds the tensor for a few seconds while rank 0 announces it
+and watches its own live metrics: the stall warning must fire within
+``HVDTPU_STALL_CHECK_TIME_SECONDS`` (the host test asserts rank 0's stderr
+names the missing rank and the tensor) and the ``hvdtpu_stalled`` gauge
+must flip to 1 — then clear once the laggard arrives and the collective
+completes. No shutdown is configured: the job must FINISH cleanly.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.observability import sample_value  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+warn_s = float(os.environ.get("HVDTPU_STALL_CHECK_TIME_SECONDS", "1"))
+hold_s = float(os.environ.get("TEST_STALL_HOLD_SECONDS", "6"))
+
+x = np.full(16, float(r + 1), np.float32)
+
+if r == 0:
+    handle = hvd.allreduce_async(x, name="withheld", op=hvd.Sum)
+    # The gauge must flip within stall_warn_secs (+ scheduling slack),
+    # while rank 1 is still withholding.
+    deadline = time.monotonic() + hold_s - 1.0
+    flipped = False
+    while time.monotonic() < deadline:
+        m = hvd.metrics()
+        if (sample_value(m, "hvdtpu_stalled") or 0) >= 1:
+            flipped = True
+            break
+        time.sleep(0.1)
+    assert flipped, "hvdtpu_stalled gauge never flipped while stalled"
+    assert (sample_value(hvd.metrics(), "hvdtpu_stall_warnings_total")
+            or 0) >= 1, "stall warning counter did not increment"
+    print("STALL GAUGE FLIPPED")
+    out = np.asarray(hvd.synchronize(handle))
+    np.testing.assert_allclose(out, np.full(16, n * (n + 1) / 2.0))
+    # Laggard arrived, table drained: the gauge must clear.
+    deadline = time.monotonic() + 10.0
+    cleared = False
+    while time.monotonic() < deadline:
+        if (sample_value(hvd.metrics(), "hvdtpu_stalled") or 0) == 0:
+            cleared = True
+            break
+        time.sleep(0.1)
+    assert cleared, "hvdtpu_stalled gauge stuck at 1 after recovery"
+else:
+    time.sleep(hold_s)  # withhold: rank 0's inspector must warn meanwhile
+    out = np.asarray(hvd.allreduce(x, name="withheld", op=hvd.Sum))
+    np.testing.assert_allclose(out, np.full(16, n * (n + 1) / 2.0))
+
+hvd.shutdown()
+print("ALL OK")

@@ -115,6 +115,31 @@ class TestArgParsing:
         assert ev.HVDTPU_COMPRESSION not in env
         assert ev.HVDTPU_COMPRESSION_MIN_BYTES not in env
 
+    def test_metrics_port_flags(self):
+        """--metrics-port/--metrics-interval land in the workers' env as
+        HVDTPU_METRICS_PORT/_INTERVAL (ISSUE 4 satellite); no flag keeps
+        the knobs out (a user-exported env var wins; native default off)."""
+        from horovod_tpu.runner.launch import _apply_tuning_env
+        from horovod_tpu.utils import envvars as ev
+
+        args = parse_args(["-np", "2", "--metrics-port", "9100",
+                           "--metrics-interval", "2.5", "python", "x.py"])
+        assert args.metrics_port == 9100
+        env = _apply_tuning_env({}, args)
+        assert env[ev.HVDTPU_METRICS_PORT] == "9100"
+        assert env[ev.HVDTPU_METRICS_INTERVAL] == "2.5"
+        args = parse_args(["-np", "2", "python", "x.py"])
+        env = _apply_tuning_env({}, args)
+        assert ev.HVDTPU_METRICS_PORT not in env
+        assert ev.HVDTPU_METRICS_INTERVAL not in env
+
+    def test_metrics_port_rejects_negative(self):
+        from horovod_tpu.runner.launch import _apply_tuning_env
+        with pytest.raises(SystemExit):
+            args = parse_args(["-np", "2", "--metrics-port", "-1",
+                               "python", "x.py"])
+            _apply_tuning_env({}, args)
+
     def test_compression_flag_rejects_unknown(self):
         with pytest.raises(SystemExit):
             parse_args(["-np", "2", "--compression", "int2",
@@ -252,3 +277,28 @@ class TestPreflight:
                            "10.0.0.5", "--no-preflight", "python", "t.py"])
         assert args.controller_advertise_address == "10.0.0.5"
         assert args.no_preflight
+
+    def test_metrics_port_preflight_busy_port(self):
+        """hvdrun probes every local worker's metrics port (base+rank)
+        before spawning; a busy port fails fast naming rank and port
+        (ISSUE 4 satellite)."""
+        import socket
+
+        import pytest
+        from horovod_tpu.runner.preflight import check_metrics_ports
+        from test_metrics import _free_port_block
+
+        base = _free_port_block(3)
+        blocker = socket.socket()
+        blocker.bind(("", base))  # rank 0's endpoint
+        try:
+            with pytest.raises(RuntimeError) as e:
+                check_metrics_ports(["localhost", "127.0.0.1"], base,
+                                    aggregator_port=base + 2)
+            assert f"port {base}" in str(e.value)
+            assert "rank 0" in str(e.value)
+        finally:
+            blocker.close()
+        # All free: passes silently.
+        check_metrics_ports(["localhost", "127.0.0.1"], base,
+                            aggregator_port=base + 2)

@@ -70,6 +70,74 @@ class TestKVAuth:
             server.stop()
 
 
+class TestMetricsAuth:
+    """The per-worker /metrics + /healthz endpoint is secret-gated with the
+    same HMAC proof header as the KV store (ISSUE 4 satellite): with a
+    cluster secret set, unauthenticated scrapes must get 403."""
+
+    def _server(self, secret):
+        from horovod_tpu.observability import MetricsServer
+        server = MetricsServer(dump_fn=lambda: "hvdtpu_up 1\n", port=0,
+                               secret=secret, health={"rank": 0})
+        server.start()
+        return server
+
+    def test_unauthenticated_scrape_rejected(self):
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret")
+        try:
+            for path in ("/metrics", "/healthz"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    scrape("127.0.0.1", server.port, path)
+                assert e.value.code == 403, path
+        finally:
+            server.stop()
+
+    def test_wrong_secret_rejected(self):
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                scrape("127.0.0.1", server.port, secret="wrong")
+            assert e.value.code == 403
+        finally:
+            server.stop()
+
+    def test_authenticated_scrape_ok(self):
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret")
+        try:
+            assert "hvdtpu_up 1" in scrape("127.0.0.1", server.port,
+                                           secret="s3cret")
+            import json
+            health = json.loads(scrape("127.0.0.1", server.port, "/healthz",
+                                       secret="s3cret"))
+            assert health["status"] == "ok"
+        finally:
+            server.stop()
+
+    def test_no_secret_server_is_open(self):
+        from horovod_tpu.observability import scrape
+        server = self._server(None)
+        try:
+            assert "hvdtpu_up 1" in scrape("127.0.0.1", server.port)
+        finally:
+            server.stop()
+
+    def test_worker_endpoint_in_secret_world(self):
+        """Full 2-rank world with HVDTPU_SECRET + metrics on: the workers
+        scrape rank 0 with the proof attached AND verify a proof-less
+        scrape of the live endpoint gets 403 (metrics_worker does both)."""
+        from test_metrics import _free_port_block
+
+        base = _free_port_block(2)
+        results = launch_world(
+            2, os.path.join(REPO, "tests", "data", "metrics_worker.py"),
+            extra_env={"HVDTPU_SECRET": "metrics-secret-1",
+                       "HVDTPU_METRICS_PORT": str(base)})
+        assert_all_ok(results)
+
+
 def _frame(payload: bytes) -> bytes:
     # SendFrame wire format: u64 length prefix (native/socket_util.cpp:117).
     return struct.pack("<Q", len(payload)) + payload
