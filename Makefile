@@ -1,0 +1,38 @@
+# Top-level entry points for the static correctness layer and the native
+# test matrix (docs/static-analysis.md). CI drop-in: scripts/ci_checks.sh
+# chains the lot with a summary table; every target here exits non-zero on
+# any finding.
+
+NATIVE := horovod_tpu/native
+
+# The full static gate: cross-language invariant linter, ruff (if
+# installed), clang-tidy and clang thread-safety analysis (both skip with a
+# notice when clang is absent — CI-only there; the linter and tests always
+# run).
+lint: invariants ruff tidy analyze
+
+invariants:
+	python3 scripts/check_invariants.py
+
+# Python lint ([tool.ruff] in pyproject.toml). Graceful skip keeps `make
+# lint` usable on boxes without ruff; CI installs it.
+ruff:
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check horovod_tpu/ scripts/check_invariants.py tests/test_static_analysis.py; \
+	else \
+	  echo "ruff: not installed; SKIPPED (python lint is CI-only on ruff-less boxes)"; \
+	fi
+
+tidy analyze:
+	$(MAKE) -C $(NATIVE) $@
+
+# Native builds + unit-test matrix (plain, TSan, ASan+UBSan, UBSan-only).
+native check check-tsan check-asan check-ubsan tsan asan ubsan clean:
+	$(MAKE) -C $(NATIVE) $(subst native,all,$@)
+
+# Tier-1 test suite (ROADMAP.md).
+test:
+	JAX_PLATFORMS=cpu python3 -m pytest tests/ -q -m 'not slow'
+
+.PHONY: lint invariants ruff tidy analyze native check check-tsan \
+        check-asan check-ubsan tsan asan ubsan clean test

@@ -36,12 +36,23 @@ _DTYPES = {"uint8": 0, "int8": 1, "int32": 4, "int64": 5, "float16": 6,
 _ALLREDUCE_ALGOS = {name: code
                     for code, name in enumerate(ev.ALLREDUCE_ALGOS)}
 
+# Control-plane frame tags and response codes: byte-for-byte mirrors of
+# hvdtpu::CtrlMsg (native/core.cpp) and hvdtpu::ResponseType
+# (native/message.h). Python never builds control frames in production — the
+# native core owns that wire — but the security tests craft raw HELLO frames
+# from these, and the invariant linter (scripts/check_invariants.py) holds
+# both languages to the same values: a silent tag drift would corrupt the
+# control plane, not crash it.
+_CTRL_MSGS = {"hello": 1, "peers": 2, "ready": 3, "responses": 4, "join": 5,
+              "need_full": 6, "params": 7}
+_RESPONSE_TYPES = {"ok": 0, "error": 1, "join_done": 2, "shutdown": 3}
+
 
 def _ensure_built() -> str:
     # HVDTPU_NATIVE_LIB points at an alternative build of the core — the
     # sanitizer CI (native/Makefile `tsan`/`asan` targets, SURVEY.md §5)
     # reruns the process-mode suite against the instrumented .so this way.
-    override = os.environ.get("HVDTPU_NATIVE_LIB")
+    override = ev.get_str(ev.HVDTPU_NATIVE_LIB)
     if override:
         return override
     if not os.path.exists(_LIB_PATH):

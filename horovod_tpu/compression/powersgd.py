@@ -34,6 +34,7 @@ from jax import lax
 
 from .. import runtime
 from ..ops import collectives as C
+from ..utils import envvars as ev
 
 
 class PowerSGDState(NamedTuple):
@@ -87,8 +88,6 @@ def powersgd_init(grads, rank: int = 2, seed: int = 0,
     raises above a hard cap; without a cap, a global residual tree over
     ``$HVDTPU_POWERSGD_RESIDUAL_WARN`` bytes (default 1 GiB) logs a
     warning pointing at the sharding specs."""
-    import os
-
     from ..utils import logging as log
 
     leaves = jax.tree.leaves(grads)
@@ -96,8 +95,8 @@ def powersgd_init(grads, rank: int = 2, seed: int = 0,
         4 * world_size * _as_matrix(leaf).shape[0] * _as_matrix(leaf).shape[1]
         for leaf in leaves if leaf.ndim >= 2)
     cap = max_residual_bytes
-    if cap is None and os.environ.get("HVDTPU_POWERSGD_RESIDUAL_CAP"):
-        cap = int(os.environ["HVDTPU_POWERSGD_RESIDUAL_CAP"])
+    if cap is None and ev.get_str(ev.HVDTPU_POWERSGD_RESIDUAL_CAP):
+        cap = ev.get_int(ev.HVDTPU_POWERSGD_RESIDUAL_CAP, 0)
     if cap is not None and residual_bytes > cap:
         raise ValueError(
             f"PowerSGD residual state would take {residual_bytes:,} bytes "
@@ -105,8 +104,7 @@ def powersgd_init(grads, rank: int = 2, seed: int = 0,
             f"the {cap:,}-byte cap — shard it with powersgd_state_specs "
             "(per-device cost is then one gradient copy), lower world_size, "
             "or raise the cap")
-    warn_at = int(os.environ.get("HVDTPU_POWERSGD_RESIDUAL_WARN",
-                                 1 << 30))
+    warn_at = ev.get_int(ev.HVDTPU_POWERSGD_RESIDUAL_WARN, 1 << 30)
     if cap is None and residual_bytes > warn_at:
         log.warning(
             f"PowerSGD residual state is {residual_bytes / (1 << 30):.1f} "

@@ -365,16 +365,14 @@ class _NotificationManager:
         if self._initialized:
             return
         self._initialized = True
-        import os
-
         from ..utils import envvars as ev
-        addr = os.environ.get(ev.HVDTPU_RENDEZVOUS_ADDR)
+        addr = ev.get_str(ev.HVDTPU_RENDEZVOUS_ADDR)
         if addr:
             from ..runner.http_kv import KVStoreClient
             from .. import runtime as _rt
             self._client = KVStoreClient(
-                addr, int(os.environ.get(ev.HVDTPU_RENDEZVOUS_PORT, "0")),
-                secret=os.environ.get(ev.HVDTPU_SECRET) or None)
+                addr, ev.get_int(ev.HVDTPU_RENDEZVOUS_PORT, 0),
+                secret=ev.get_str(ev.HVDTPU_SECRET))
             self._seen_epoch = _rt._elastic_last_epoch
 
     def poll(self) -> None:
@@ -398,10 +396,11 @@ class _NotificationManager:
         reference analog: worker exit detection in driver.py:291)."""
         if self._client is None:
             return
-        import os
+        from ..utils import envvars as ev
         try:
             self._client.put("/rendezvous/hint",
-                             os.environ.get("HVDTPU_WORKER_ID", "?").encode())
+                             (ev.get_str(ev.HVDTPU_WORKER_ID) or
+                              "?").encode())
         except Exception:
             pass
 

@@ -23,7 +23,6 @@ store; ``EstimatorModel.transform`` serves predictions, mirroring the Spark
 
 from __future__ import annotations
 
-import os
 import pickle
 from typing import Any, Callable, Optional, Tuple
 

@@ -78,6 +78,11 @@ class BayesianOptimizer {
 // operations.cc:615-643; the cache switch mirrors the reference's
 // CategoricalParameter dimensions, parameter_manager.h:165/:225 —
 // represented here as a thresholded third GP dimension).
+// Concurrency contract: a ParameterManager lives on rank 0 and is touched
+// only from the core's background loop (Initialize runs on the user thread
+// strictly before that loop starts) — no locks, no annotations needed. The
+// core republishes adopted values into its own GUARDED_BY(mu_) fields /
+// PARAMS frames; nothing reads this object cross-thread.
 class ParameterManager {
  public:
   struct Params {

@@ -82,6 +82,10 @@ void WireDecompressAdd(WireCompression c, const uint8_t* src, int64_t count,
 
 // Per-tensor error-feedback residual buffers, keyed by the (fused) op's
 // name signature. Local to the compressing rank; nothing is negotiated.
+// Concurrency contract: background-loop-owned (error feedback is applied
+// inside the serialized collective path), so it carries no lock — the same
+// single-driver rule as DataPlane, enforced socially and by `make analyze`
+// finding any new mutex-free cross-thread state it would take to break it.
 class ResidualStore {
  public:
   // The residual buffer for `key`, zero-initialized when new or when the

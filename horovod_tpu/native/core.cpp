@@ -279,7 +279,10 @@ struct CoreConfig {
 class Core {
  public:
   explicit Core(const CoreConfig& cfg)
-      : cfg_(cfg), data_plane_(cfg.rank, cfg.size) {}
+      : cfg_(cfg),
+        data_plane_(cfg.rank, cfg.size),
+        cycle_time_ms_(cfg.cycle_time_ms),
+        fusion_threshold_(cfg.fusion_threshold) {}
 
   ~Core() {
     Shutdown();
@@ -287,26 +290,29 @@ class Core {
     CloseFd(wake_pipe_[1]);
   }
 
-  Status Start();
-  void Shutdown();
+  Status Start() EXCLUDES(mu_);
+  void Shutdown() EXCLUDES(mu_);
 
   // Returns handle >= 0, or Status error via *status.
-  int64_t Enqueue(TensorEntry entry, Status* status);
-  Status WaitHandle(int64_t handle);
-  int PollHandle(int64_t handle);
-  int64_t ResultBytes(int64_t handle);
+  int64_t Enqueue(TensorEntry entry, Status* status) EXCLUDES(mu_);
+  Status WaitHandle(int64_t handle) EXCLUDES(mu_);
+  int PollHandle(int64_t handle) EXCLUDES(mu_);
+  int64_t ResultBytes(int64_t handle) EXCLUDES(mu_);
   // Copies result and releases the handle.
-  Status CopyResult(int64_t handle, void* dst, int64_t capacity);
-  int64_t Join();  // blocks until all ranks joined; returns last rank
+  Status CopyResult(int64_t handle, void* dst, int64_t capacity)
+      EXCLUDES(mu_);
+  // Blocks until all ranks joined; returns the last joined rank.
+  int64_t Join() EXCLUDES(mu_);
 
   // Runtime timeline control (reference: horovod_start_timeline /
   // horovod_stop_timeline, operations.cc:735-790). Thread-safe: the request
   // is applied by the background thread at the top of its next cycle so the
   // Timeline object stays single-owner.
-  void RequestTimeline(bool start, const std::string& path, bool mark_cycles);
+  void RequestTimeline(bool start, const std::string& path, bool mark_cycles)
+      EXCLUDES(timeline_req_mu_);
   // Current (possibly autotuned) loop parameters, for tests/introspection.
-  double CurrentCycleTimeMs();
-  int64_t CurrentFusionThreshold();
+  double CurrentCycleTimeMs() EXCLUDES(mu_);
+  int64_t CurrentFusionThreshold() EXCLUDES(mu_);
   // Cumulative data-plane payload accounting. Thin shim over the metrics
   // registry (hvdtpu_allreduce_{raw,wire}_bytes_total) — the registry is
   // the single source of truth; this keeps the pre-metrics C/Python API
@@ -322,22 +328,23 @@ class Core {
   CoreConfig* mutable_config() { return &cfg_; }  // pre-Start() only
 
  private:
-  void BackgroundLoop();
-  void WaitForWork();                // poll control fds + wake pipe
+  void BackgroundLoop() EXCLUDES(mu_);
+  void WaitForWork() EXCLUDES(mu_);  // poll control fds + wake pipe
   void Wake();                       // nudge the background loop
-  void PumpControlPlane();           // role-dependent per-cycle work
-  void CoordinatorIngest();          // rank 0: read worker frames
-  void CoordinatorEmitResponses();   // rank 0: match + fuse + broadcast
+  void PumpControlPlane() EXCLUDES(mu_);  // role-dependent per-cycle work
+  void CoordinatorIngest() EXCLUDES(mu_);  // rank 0: read worker frames
+  // rank 0: match + fuse + broadcast
+  void CoordinatorEmitResponses() EXCLUDES(mu_);
   void WorkerSendReady(std::vector<Request> reqs,
                        std::vector<std::string> cached);
   void HandleReadyRequests(std::vector<Request> reqs);  // coordinator table
   Response BuildResponse(const std::string& name);
-  void ExecuteResponseList(const std::vector<Response>& list);
-  void ExecuteResponse(const Response& resp);
+  void ExecuteResponseList(const std::vector<Response>& list) EXCLUDES(mu_);
+  void ExecuteResponse(const Response& resp) EXCLUDES(mu_);
   void ExecuteFusedAllreduce(const Response& resp,
                              std::vector<TensorEntry*>& entries,
-                             WireCompression comp);
-  void CompleteEntry(TensorEntry* e, const Status& st);
+                             WireCompression comp) EXCLUDES(mu_);
+  void CompleteEntry(TensorEntry* e, const Status& st) EXCLUDES(mu_);
   void CheckStalls();
   // Effective wire compression for one negotiated allreduce: the configured
   // (or autotuned) mode, gated on dtype fp32, op SUM/AVERAGE, total payload
@@ -385,15 +392,31 @@ class Core {
   int wake_pipe_[2] = {-1, -1};
 
   // Tensor queue + outstanding table (reference: tensor_queue.{h,cc}).
-  std::mutex mu_;
-  std::condition_variable cv_;                 // completion + enqueue signal
-  std::deque<TensorEntry*> pending_;           // enqueued, not yet announced
-  std::unordered_map<std::string, TensorEntry*> outstanding_;  // by name
-  std::unordered_map<int64_t, TensorEntry*> handles_;
-  std::unordered_map<int64_t, Status> done_;   // completed handle -> status
-  int64_t next_handle_ = 0;
+  // mu_ is the only lock shared between user threads (Enqueue/Wait/Poll/
+  // CopyResult/Join) and the background thread; everything it guards is
+  // annotated below and checked by `make analyze`.
+  Mutex mu_;
+  CondVar cv_;                                 // completion + enqueue signal
+  // enqueued, not yet announced
+  std::deque<TensorEntry*> pending_ GUARDED_BY(mu_);
+  // by name
+  std::unordered_map<std::string, TensorEntry*> outstanding_ GUARDED_BY(mu_);
+  std::unordered_map<int64_t, TensorEntry*> handles_ GUARDED_BY(mu_);
+  // completed handle -> status
+  std::unordered_map<int64_t, Status> done_ GUARDED_BY(mu_);
+  int64_t next_handle_ GUARDED_BY(mu_) = 0;
+  // Runtime-mutable loop parameters (autotune adoption / PARAMS frames write
+  // them, user threads read them via CurrentCycleTimeMs/CurrentFusion
+  // Threshold). Split out of cfg_ so they can carry GUARDED_BY — the rest of
+  // cfg_ is immutable once Start() spawns the background thread.
+  double cycle_time_ms_ GUARDED_BY(mu_) = 1.0;
+  int64_t fusion_threshold_ GUARDED_BY(mu_) = 64 * 1024 * 1024;
 
   // Coordinator negotiation state (reference: controller message_table_).
+  // Background-thread-owned, like cache_, param_manager_, residual_store_,
+  // comp_*_ and worker_fds_ (after Start): only BackgroundLoop's call tree
+  // touches them, so they need no lock and carry no annotation — thread
+  // ownership is a contract the analysis cannot express.
   struct PendingName {
     std::vector<Request> requests;
     double first_seen = 0;
@@ -403,8 +426,7 @@ class Core {
   std::deque<std::string> ready_names_;               // count reached
   std::set<int32_t> joined_ranks_;
   std::set<int32_t> dead_ranks_;  // disconnected workers (never come back)
-  bool join_pending_local_ = false;
-  int64_t join_handle_ = -1;
+  bool join_pending_local_ GUARDED_BY(mu_) = false;
   std::atomic<int32_t> last_joined_rank_{-1};
   std::atomic<bool> join_done_{false};
 
@@ -421,14 +443,14 @@ class Core {
   ParameterManager param_manager_;
 
   // Pending timeline start/stop, applied by the background thread.
-  std::mutex timeline_req_mu_;
-  bool timeline_req_pending_ = false;
-  bool timeline_req_start_ = false;
-  std::string timeline_req_path_;
-  bool timeline_req_mark_ = false;
+  Mutex timeline_req_mu_;
+  bool timeline_req_pending_ GUARDED_BY(timeline_req_mu_) = false;
+  bool timeline_req_start_ GUARDED_BY(timeline_req_mu_) = false;
+  std::string timeline_req_path_ GUARDED_BY(timeline_req_mu_);
+  bool timeline_req_mark_ GUARDED_BY(timeline_req_mu_) = false;
 
-  void ApplyTimelineRequest();
-  void FailAllOutstanding(const std::string& reason);
+  void ApplyTimelineRequest() EXCLUDES(timeline_req_mu_);
+  void FailAllOutstanding(const std::string& reason) EXCLUDES(mu_);
 
   // Live-metrics registry (metrics.h) + handles pre-resolved in Start() so
   // the background loop's per-cycle updates are pure lock-free atomic ops.
@@ -459,7 +481,7 @@ class Core {
 
 void Core::RequestTimeline(bool start, const std::string& path,
                            bool mark_cycles) {
-  std::lock_guard<std::mutex> lk(timeline_req_mu_);
+  MutexLock lk(timeline_req_mu_);
   timeline_req_pending_ = true;
   timeline_req_start_ = start;
   timeline_req_path_ = path;
@@ -467,7 +489,7 @@ void Core::RequestTimeline(bool start, const std::string& path,
 }
 
 void Core::ApplyTimelineRequest() {
-  std::lock_guard<std::mutex> lk(timeline_req_mu_);
+  MutexLock lk(timeline_req_mu_);
   if (!timeline_req_pending_) return;
   timeline_req_pending_ = false;
   if (timeline_req_start_) {
@@ -524,13 +546,13 @@ void Core::UpdateParamGauges(double cycle_ms, int64_t fusion, bool cache_on,
 }
 
 double Core::CurrentCycleTimeMs() {
-  std::lock_guard<std::mutex> lk(mu_);
-  return cfg_.cycle_time_ms;
+  MutexLock lk(mu_);
+  return cycle_time_ms_;
 }
 
 int64_t Core::CurrentFusionThreshold() {
-  std::lock_guard<std::mutex> lk(mu_);
-  return cfg_.fusion_threshold;
+  MutexLock lk(mu_);
+  return fusion_threshold_;
 }
 
 Status Core::Start() {
@@ -771,6 +793,15 @@ Status Core::Start() {
     if (!st.ok()) return st;
   }
 
+  // Current (possibly previously-autotuned, on a restart) loop parameters.
+  double cycle_ms_now;
+  int64_t fusion_now;
+  {
+    MutexLock lk(mu_);
+    cycle_ms_now = cycle_time_ms_;
+    fusion_now = fusion_threshold_;
+  }
+
   if (cfg_.autotune && cfg_.rank == 0) {
     // After Connect on purpose: the hier switch joins the GP only under
     // AUTO with a topology where the two-level path exists and can matter —
@@ -787,7 +818,7 @@ Status Core::Start() {
         cfg_.wire_compression ==
             static_cast<int32_t>(WireCompression::AUTO) &&
         cfg_.size > 1;
-    param_manager_.Initialize(cfg_.cycle_time_ms, cfg_.fusion_threshold,
+    param_manager_.Initialize(cycle_ms_now, fusion_now,
                               cfg_.cache_capacity > 0,
                               data_plane_.crossover_bytes(),
                               data_plane_.allreduce_algo() ==
@@ -800,8 +831,8 @@ Status Core::Start() {
                               cfg_.autotune_gp_noise);
   }
 
-  UpdateParamGauges(cfg_.cycle_time_ms, cfg_.fusion_threshold,
-                    cache_.enabled(), data_plane_.crossover_bytes());
+  UpdateParamGauges(cycle_ms_now, fusion_now, cache_.enabled(),
+                    data_plane_.crossover_bytes());
 
   shutdown_ = false;
   background_ = std::thread([this] { BackgroundLoop(); });
@@ -812,15 +843,15 @@ Status Core::Start() {
 void Core::Shutdown() {
   if (!started_) return;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     shutdown_ = true;  // under mu_: no lost wakeups for waiters
   }
-  cv_.notify_all();
+  cv_.NotifyAll();
   Wake();
   if (background_.joinable()) background_.join();
   // Fail any still-outstanding handles.
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     for (auto& kv : handles_) {
       done_[kv.first] =
           Status::Error(StatusCode::ABORTED, "shut down before completion");
@@ -830,7 +861,7 @@ void Core::Shutdown() {
     outstanding_.clear();
     pending_.clear();
   }
-  cv_.notify_all();
+  cv_.NotifyAll();
   data_plane_.Shutdown();
   if (control_fd_ >= 0) CloseFd(control_fd_);
   if (cfg_.rank == 0) {
@@ -842,7 +873,7 @@ void Core::Shutdown() {
 }
 
 int64_t Core::Enqueue(TensorEntry entry, Status* status) {
-  std::unique_lock<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   if (shutdown_) {
     *status = Status::Error(StatusCode::ABORTED, "core is shut down");
     return -1;
@@ -870,15 +901,15 @@ int64_t Core::Enqueue(TensorEntry entry, Status* status) {
   timeline_.QueueStart(e->name);
   *status = Status::OK();
   int64_t h = e->handle;
-  lk.unlock();
-  cv_.notify_all();
+  lk.Unlock();
+  cv_.NotifyAll();
   Wake();
   return h;
 }
 
 Status Core::WaitHandle(int64_t handle) {
-  std::unique_lock<std::mutex> lk(mu_);
-  cv_.wait(lk, [&] { return done_.count(handle) != 0 || shutdown_.load(); });
+  MutexLock lk(mu_);
+  while (done_.count(handle) == 0 && !shutdown_.load()) cv_.Wait(lk);
   auto it = done_.find(handle);
   if (it == done_.end()) {
     return Status::Error(StatusCode::ABORTED, "core shut down while waiting");
@@ -887,19 +918,19 @@ Status Core::WaitHandle(int64_t handle) {
 }
 
 int Core::PollHandle(int64_t handle) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   return done_.count(handle) != 0 ? 1 : 0;
 }
 
 int64_t Core::ResultBytes(int64_t handle) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto it = handles_.find(handle);
   if (it == handles_.end()) return -1;
   return static_cast<int64_t>(it->second->output.size());
 }
 
 Status Core::CopyResult(int64_t handle, void* dst, int64_t capacity) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   auto hit = handles_.find(handle);
   auto dit = done_.find(handle);
   if (hit == handles_.end() || dit == done_.end()) {
@@ -922,14 +953,14 @@ Status Core::CopyResult(int64_t handle, void* dst, int64_t capacity) {
 
 int64_t Core::Join() {
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     join_pending_local_ = true;
     join_done_ = false;
   }
-  cv_.notify_all();
+  cv_.NotifyAll();
   Wake();
-  std::unique_lock<std::mutex> lk(mu_);
-  cv_.wait(lk, [&] { return join_done_.load() || shutdown_.load(); });
+  MutexLock lk(mu_);
+  while (!join_done_.load() && !shutdown_.load()) cv_.Wait(lk);
   if (!join_done_.load()) return -2;  // woken by a broken world, not a join
   return last_joined_rank_.load();
 }
@@ -960,8 +991,8 @@ void Core::WaitForWork() {
   }
   double cycle_ms;
   {
-    std::lock_guard<std::mutex> lk(mu_);
-    cycle_ms = cfg_.cycle_time_ms;
+    MutexLock lk(mu_);
+    cycle_ms = cycle_time_ms_;
   }
   int timeout = std::max(1, static_cast<int>(std::lround(cycle_ms)));
   (void)poll(pfds.data(), pfds.size(), timeout);
@@ -988,7 +1019,7 @@ void Core::BackgroundLoop() {
     m_queue_depth_->Set(static_cast<double>(message_table_.size()));
     m_dead_ranks_->Set(static_cast<double>(dead_ranks_.size()));
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       m_outstanding_->Set(static_cast<double>(outstanding_.size()));
     }
   }
@@ -999,7 +1030,7 @@ void Core::PumpControlPlane() {
   std::vector<Request> reqs;
   bool announce_join = false;
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     while (!pending_.empty()) {
       TensorEntry* e = pending_.front();
       pending_.pop_front();
@@ -1078,14 +1109,14 @@ void Core::PumpControlPlane() {
           // only a mid-operation loss is an error worth failing over.
           bool have_outstanding;
           {
-            std::lock_guard<std::mutex> lk(mu_);
+            MutexLock lk(mu_);
             have_outstanding = !outstanding_.empty();
             shutdown_ = true;  // under mu_: no lost wakeups for waiters
           }
           if (have_outstanding) {
             LogWarn(cfg_.rank, "lost connection to coordinator");
           }
-          cv_.notify_all();
+          cv_.NotifyAll();
         }
         return;
       }
@@ -1097,7 +1128,7 @@ void Core::PumpControlPlane() {
         int64_t n = r.I64();
         std::vector<Request> fulls;
         {
-          std::lock_guard<std::mutex> lk(mu_);
+          MutexLock lk(mu_);
           for (int64_t i = 0; i < n && r.ok(); ++i) {
             std::string name = r.Str();
             if (!r.ok()) break;
@@ -1140,9 +1171,9 @@ void Core::PumpControlPlane() {
         data_plane_.set_hier_auto(hier_on);
         comp_auto_ = comp;
         {
-          std::lock_guard<std::mutex> lk(mu_);
-          cfg_.cycle_time_ms = cycle;
-          cfg_.fusion_threshold = fusion;
+          MutexLock lk(mu_);
+          cycle_time_ms_ = cycle;
+          fusion_threshold_ = fusion;
           cache_.SetEnabled(cache_on);
         }
         UpdateParamGauges(cycle, fusion, cache_on,
@@ -1191,7 +1222,7 @@ void Core::CoordinatorIngest() {
           // HorovodInternalError semantics, horovod/common/exceptions.py).
           bool have_outstanding;
           {
-            std::lock_guard<std::mutex> lk(mu_);
+            MutexLock lk(mu_);
             have_outstanding = !outstanding_.empty();
           }
           if (!message_table_.empty() || have_outstanding) {
@@ -1449,7 +1480,7 @@ Response Core::BuildResponse(const std::string& name) {
 }
 
 void Core::FailAllOutstanding(const std::string& reason) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   for (auto& kv : handles_) {
     if (done_.count(kv.first) == 0) {
       done_[kv.first] = Status::Error(StatusCode::ABORTED, reason);
@@ -1457,7 +1488,7 @@ void Core::FailAllOutstanding(const std::string& reason) {
     }
   }
   pending_.clear();
-  cv_.notify_all();
+  cv_.NotifyAll();
 }
 
 void Core::CoordinatorEmitResponses() {
@@ -1484,17 +1515,24 @@ void Core::CoordinatorEmitResponses() {
     ready_names_.clear();
     FailAllOutstanding("a peer process failed during a collective");
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       shutdown_ = true;
     }
-    cv_.notify_all();
+    cv_.NotifyAll();
     return;
   }
 
   std::vector<Response> list;
 
   // Fuse ready allreduces with matching (dtype, reduce_op) under the fusion
-  // threshold (reference: FuseResponses, controller.cc:686).
+  // threshold (reference: FuseResponses, controller.cc:686). Snapshot the
+  // (autotune-mutable) threshold once per emit pass — the only writer is
+  // this same background thread, so it cannot move mid-loop.
+  int64_t fusion_threshold_now;
+  {
+    MutexLock lk(mu_);
+    fusion_threshold_now = fusion_threshold_;
+  }
   while (!ready_names_.empty()) {
     std::string name = ready_names_.front();
     ready_names_.pop_front();
@@ -1519,7 +1557,7 @@ void Core::CoordinatorEmitResponses() {
         if (fusable) {
           int64_t extra = NumElements(peek.shapes[0]) *
                           static_cast<int64_t>(DataTypeSize(peek.dtype));
-          if (fused_bytes + extra > cfg_.fusion_threshold) {
+          if (fused_bytes + extra > fusion_threshold_now) {
             ++it;
             continue;
           }
@@ -1589,9 +1627,9 @@ void Core::CoordinatorEmitResponses() {
       data_plane_.set_hier_auto(p.hier_enabled);
       comp_auto_ = p.wire_compression;
       {
-        std::lock_guard<std::mutex> lk(mu_);
-        cfg_.cycle_time_ms = p.cycle_time_ms;
-        cfg_.fusion_threshold = p.fusion_threshold;
+        MutexLock lk(mu_);
+        cycle_time_ms_ = p.cycle_time_ms;
+        fusion_threshold_ = p.fusion_threshold;
         cache_.SetEnabled(p.cache_enabled);
       }
       UpdateParamGauges(p.cycle_time_ms, p.fusion_threshold, p.cache_enabled,
@@ -1619,10 +1657,10 @@ void Core::ExecuteResponseList(const std::vector<Response>& list) {
 }
 
 void Core::CompleteEntry(TensorEntry* e, const Status& st) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   outstanding_.erase(e->name);
   done_[e->handle] = st;
-  cv_.notify_all();
+  cv_.NotifyAll();
 }
 
 void Core::ExecuteResponse(const Response& resp) {
@@ -1632,21 +1670,21 @@ void Core::ExecuteResponse(const Response& resp) {
                            ? "a peer process failed during a collective"
                            : resp.error_message);
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       shutdown_ = true;
     }
-    cv_.notify_all();
+    cv_.NotifyAll();
     return;
   }
   if (resp.type == ResponseType::JOIN_DONE) {
     {
       // Flag writes must happen under mu_ or a waiter that just evaluated its
       // predicate (false) can block after this notify and hang forever.
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       last_joined_rank_ = resp.last_joined_rank;
       join_done_ = true;
     }
-    cv_.notify_all();
+    cv_.NotifyAll();
     return;
   }
 
@@ -1655,7 +1693,7 @@ void Core::ExecuteResponse(const Response& resp) {
   std::vector<TensorEntry*> entries;
   std::vector<std::unique_ptr<TensorEntry>> zombies;  // zero stand-ins
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     for (size_t i = 0; i < resp.names.size(); ++i) {
       auto it = outstanding_.find(resp.names[i]);
       if (it != outstanding_.end()) {
@@ -1931,8 +1969,8 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
   {
     int64_t threshold;
     {
-      std::lock_guard<std::mutex> lk(mu_);
-      threshold = cfg_.fusion_threshold;
+      MutexLock lk(mu_);
+      threshold = fusion_threshold_;
     }
     if (threshold > 0) {
       m_fusion_utilization_->Observe(static_cast<double>(total_bytes) /

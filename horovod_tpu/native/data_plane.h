@@ -60,6 +60,16 @@ enum class HierMode : int32_t {
   AUTO = 2,
 };
 
+// Concurrency contract (checked indirectly by `make analyze`: this type
+// holds no mutex on purpose): a DataPlane is driven by exactly ONE thread —
+// the core's background loop (plus the Python host thread during
+// Listen/Connect, strictly before that loop starts). Collectives, setters
+// and per-op counters are therefore unsynchronized by design. The only
+// cross-thread members are the metrics-registry counters
+// (total_raw_bytes/total_wire_bytes, relaxed atomics readable from any
+// thread) and the worker threads SendRecvSegmented spawns internally, which
+// are joined before the collective returns. Adding a second driving thread
+// requires adding a Mutex + GUARDED_BY annotations here first.
 class DataPlane {
  public:
   DataPlane(int rank, int size);

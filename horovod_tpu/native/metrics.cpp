@@ -88,7 +88,7 @@ Metrics::Family* Metrics::Resolve(const std::string& name,
 
 Counter* Metrics::GetCounter(const std::string& name, const std::string& help,
                              const MetricLabels& labels) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   Family* f = Resolve(name, help, Kind::COUNTER);
   if (f == nullptr) { static Counter orphan; return &orphan; }
   Series& s = f->series[RenderLabels(labels)];
@@ -98,7 +98,7 @@ Counter* Metrics::GetCounter(const std::string& name, const std::string& help,
 
 Gauge* Metrics::GetGauge(const std::string& name, const std::string& help,
                          const MetricLabels& labels) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   Family* f = Resolve(name, help, Kind::GAUGE);
   if (f == nullptr) { static Gauge orphan; return &orphan; }
   Series& s = f->series[RenderLabels(labels)];
@@ -110,7 +110,7 @@ Histogram* Metrics::GetHistogram(const std::string& name,
                                  const std::string& help,
                                  const std::vector<double>& bounds,
                                  const MetricLabels& labels) {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   Family* f = Resolve(name, help, Kind::HISTOGRAM);
   if (f == nullptr) { static Histogram orphan({1.0}); return &orphan; }
   Series& s = f->series[RenderLabels(labels)];
@@ -119,14 +119,14 @@ Histogram* Metrics::GetHistogram(const std::string& name,
 }
 
 size_t Metrics::SeriesCount() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   size_t n = 0;
   for (const auto& kv : families_) n += kv.second.series.size();
   return n;
 }
 
 std::string Metrics::Dump() const {
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   std::string out;
   out.reserve(4096);
   for (const auto& fam : families_) {

@@ -24,9 +24,10 @@
 #include <cstdint>
 #include <map>
 #include <memory>
-#include <mutex>
 #include <string>
 #include <vector>
+
+#include "common.h"
 
 namespace hvdtpu {
 
@@ -106,21 +107,21 @@ class Metrics {
   // existing name abort in debug builds and return a fresh unnamed metric
   // otherwise (a programming error, not a runtime condition).
   Counter* GetCounter(const std::string& name, const std::string& help,
-                      const MetricLabels& labels = {});
+                      const MetricLabels& labels = {}) EXCLUDES(mu_);
   Gauge* GetGauge(const std::string& name, const std::string& help,
-                  const MetricLabels& labels = {});
+                  const MetricLabels& labels = {}) EXCLUDES(mu_);
   Histogram* GetHistogram(const std::string& name, const std::string& help,
                           const std::vector<double>& bounds,
-                          const MetricLabels& labels = {});
+                          const MetricLabels& labels = {}) EXCLUDES(mu_);
 
   // Prometheus text exposition format, version 0.0.4: # HELP / # TYPE lines
   // followed by one sample line per series (histograms expand into
   // cumulative _bucket{le=...} + _sum + _count). Deterministic: families
   // sorted by name, series by label string.
-  std::string Dump() const;
+  std::string Dump() const EXCLUDES(mu_);
 
   // Number of distinct (name, labels) series — bounds cardinality in tests.
-  size_t SeriesCount() const;
+  size_t SeriesCount() const EXCLUDES(mu_);
 
  private:
   enum class Kind { COUNTER, GAUGE, HISTOGRAM };
@@ -136,10 +137,10 @@ class Metrics {
   };
 
   Family* Resolve(const std::string& name, const std::string& help,
-                  Kind kind);
+                  Kind kind) REQUIRES(mu_);
 
-  mutable std::mutex mu_;
-  std::map<std::string, Family> families_;
+  mutable Mutex mu_;
+  std::map<std::string, Family> families_ GUARDED_BY(mu_);
 };
 
 // {k="v",k2="v2"} (empty string for no labels). Values are escaped per the

@@ -28,6 +28,14 @@ namespace hvdtpu {
 // HVDTPU_SHM_RING_BYTES.
 constexpr int64_t kDefaultShmRingBytes = 1 << 20;
 
+// Concurrency contract (see common.h's TSA layer; this type is mutex-free on
+// purpose): each ring is strict SPSC across two PROCESSES — the producer
+// side owns the head cursor, the consumer the tail, both published with
+// acquire/release atomics in the mapped segment; futex words handle
+// cross-process wakeups. Within a process, a ShmTransport is driven by the
+// core's background loop only (the same single-driver rule as DataPlane),
+// except Abort()/abort flag reads, which are async-signal-style atomics any
+// thread may touch during shutdown.
 class ShmTransport : public Transport {
  public:
   // Creator (lower rank) allocates and initializes the segment; the opener

@@ -5,7 +5,7 @@ namespace hvdtpu {
 Timeline::~Timeline() { Shutdown(); }
 
 void Timeline::Initialize(const std::string& path, int rank) {
-  std::lock_guard<std::mutex> st(state_mu_);
+  MutexLock st(state_mu_);
   if (initialized_ || path.empty()) return;
   file_ = fopen(path.c_str(), "w");
   if (file_ == nullptr) return;
@@ -13,11 +13,12 @@ void Timeline::Initialize(const std::string& path, int rank) {
   start_ = std::chrono::steady_clock::now();
   fputs("[\n", file_);
   first_ = true;
-  stop_ = false;
   {
-    // Drop events raced in after a previous Shutdown drained the writer.
-    std::lock_guard<std::mutex> lk(mu_);
+    // Drop events raced in after a previous Shutdown drained the writer,
+    // and clear the previous run's stop flag.
+    MutexLock lk(mu_);
     while (!queue_.empty()) queue_.pop();
+    stop_ = false;
   }
   initialized_ = true;
   writer_ = std::thread([this] { WriterLoop(); });
@@ -27,17 +28,17 @@ void Timeline::Shutdown() {
   {
     // Flip the flag under state_mu_: Emit holds state_mu_ for its whole
     // body, so after this block no emitter can be touching timeline state.
-    std::lock_guard<std::mutex> st(state_mu_);
+    MutexLock st(state_mu_);
     if (!initialized_) return;
     initialized_ = false;
   }
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     stop_ = true;
   }
-  cv_.notify_all();
+  cv_.NotifyAll();
   if (writer_.joinable()) writer_.join();
-  std::lock_guard<std::mutex> st(state_mu_);
+  MutexLock st(state_mu_);
   fputs("\n]\n", file_);
   fclose(file_);
   file_ = nullptr;
@@ -77,7 +78,7 @@ void Timeline::Emit(const std::string& name, char ph,
   int64_t ts;
   int rank;
   {
-    std::lock_guard<std::mutex> st(state_mu_);
+    MutexLock st(state_mu_);
     if (!initialized_) return;
     ts = NowUs();
     rank = rank_;
@@ -96,24 +97,24 @@ void Timeline::Emit(const std::string& name, char ph,
   if (!cat.empty()) e += ", \"cat\": \"" + JsonEscape(cat) + "\"";
   e += "}";
   {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     queue_.push(Event{std::move(e)});
   }
-  cv_.notify_one();
+  cv_.NotifyOne();
 }
 
 void Timeline::WriterLoop() {
-  std::unique_lock<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   while (true) {
-    cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+    while (!stop_ && queue_.empty()) cv_.Wait(lk);
     while (!queue_.empty()) {
       Event e = std::move(queue_.front());
       queue_.pop();
-      lk.unlock();
+      lk.Unlock();
       if (!first_) fputs(",\n", file_);
       first_ = false;
       fputs(e.json.c_str(), file_);
-      lk.lock();
+      lk.Lock();
     }
     if (stop_ && queue_.empty()) break;
   }
@@ -160,16 +161,16 @@ void Timeline::OpDone(const std::string& name, const std::string& result,
 }
 
 void Timeline::MarkCycle() {
-  std::lock_guard<std::mutex> st(state_mu_);
+  MutexLock st(state_mu_);
   if (!initialized_) return;
   char buf[160];
   snprintf(buf, sizeof(buf),
            "{\"name\": \"CYCLE %d\", \"ph\": \"i\", \"ts\": %lld, "
            "\"pid\": \"cycle\", \"tid\": %d, \"s\": \"g\"}",
            cycle_++, static_cast<long long>(NowUs()), rank_);
-  std::lock_guard<std::mutex> lk(mu_);
+  MutexLock lk(mu_);
   queue_.push(Event{std::string(buf)});
-  cv_.notify_one();
+  cv_.NotifyOne();
 }
 
 }  // namespace hvdtpu

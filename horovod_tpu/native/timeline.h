@@ -9,12 +9,12 @@
 
 #include <atomic>
 #include <chrono>
-#include <condition_variable>
 #include <cstdio>
-#include <mutex>
 #include <queue>
 #include <string>
 #include <thread>
+
+#include "common.h"
 
 namespace hvdtpu {
 
@@ -23,8 +23,8 @@ class Timeline {
   ~Timeline();
 
   // No-op unless initialized. file comes from HVDTPU_TIMELINE.
-  void Initialize(const std::string& path, int rank);
-  void Shutdown();
+  void Initialize(const std::string& path, int rank) EXCLUDES(state_mu_, mu_);
+  void Shutdown() EXCLUDES(state_mu_, mu_);
   bool Initialized() const { return initialized_; }
 
   // Phase events for a named tensor (tensor name becomes the trace "pid" row,
@@ -47,32 +47,38 @@ class Timeline {
   // (docs/timeline.md).
   void OpDone(const std::string& name, const std::string& result,
               int64_t raw_bytes = -1, int64_t wire_bytes = -1);
-  void MarkCycle();  // HVDTPU_TIMELINE_MARK_CYCLES
+  void MarkCycle() EXCLUDES(state_mu_, mu_);  // HVDTPU_TIMELINE_MARK_CYCLES
 
  private:
   struct Event {
     std::string json;
   };
   void Emit(const std::string& name, char ph, const std::string& args_json,
-            const std::string& cat = "");
-  void WriterLoop();
-  int64_t NowUs() const;
+            const std::string& cat = "") EXCLUDES(state_mu_, mu_);
+  void WriterLoop() EXCLUDES(mu_);
+  int64_t NowUs() const REQUIRES(state_mu_);
 
-  // Lifecycle state (initialized_/file_/start_/rank_) can be mutated by the
-  // background thread (runtime start/stop requests) while user threads Emit
-  // from EnqueueOp — state_mu_ guards it. Lock order: state_mu_ before mu_.
-  std::mutex state_mu_;
+  // Lifecycle state can be mutated by the background thread (runtime
+  // start/stop requests) while user threads Emit from EnqueueOp — state_mu_
+  // guards it. Lock order: state_mu_ before mu_ (Emit/MarkCycle take both).
+  Mutex state_mu_ ACQUIRED_BEFORE(mu_);
+  // Lock-free fast-path check in Initialized(); every WRITE happens under
+  // state_mu_ so Emit's snapshot (rank_/start_) stays consistent with it.
   std::atomic<bool> initialized_{false};
-  int rank_ = 0;
+  int rank_ GUARDED_BY(state_mu_) = 0;
+  std::chrono::steady_clock::time_point start_ GUARDED_BY(state_mu_);
+  int cycle_ GUARDED_BY(state_mu_) = 0;
+  // Writer-thread-owned between Initialize and Shutdown: Initialize writes
+  // file_/first_ before spawning writer_, Shutdown touches them only after
+  // join(). Not GUARDED_BY — ownership transfers via thread start/join,
+  // which the analysis cannot express (and no lock is ever needed).
   FILE* file_ = nullptr;
   bool first_ = true;
-  std::chrono::steady_clock::time_point start_;
-  std::mutex mu_;
-  std::condition_variable cv_;
-  std::queue<Event> queue_;
-  bool stop_ = false;
+  Mutex mu_;
+  CondVar cv_;
+  std::queue<Event> queue_ GUARDED_BY(mu_);
+  bool stop_ GUARDED_BY(mu_) = false;
   std::thread writer_;
-  int cycle_ = 0;
 };
 
 }  // namespace hvdtpu

@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import runtime
 from ..ops import collectives as C
+from ..utils import envvars as ev
 from ..utils import logging as log
 
 # (inner_axis, outer_axis, mesh-shape signature) -> sorted list of
@@ -58,7 +59,7 @@ _warned_uncalibrated = set()
 # on restart). ``autotune_hierarchical`` writes the file after calibrating;
 # ``choose_hierarchical`` loads it on the first uncalibrated query, so a
 # restarted training job keeps its decisions without re-measuring.
-_AUTOTUNE_LOG_ENV = "HVDTPU_AUTOTUNE_LOG"
+_AUTOTUNE_LOG_ENV = ev.HVDTPU_AUTOTUNE_LOG
 _env_loaded = False
 
 
@@ -82,7 +83,7 @@ def save_hierarchical_decisions(path: Optional[str] = None) -> Optional[str]:
     mesh-shape) signature; returns the path written, or None when no path
     is configured. Atomic (tmp + rename) so a crash mid-write never leaves
     a truncated table for the next start to load."""
-    path = path or os.environ.get(_AUTOTUNE_LOG_ENV)
+    path = path or ev.get_str(_AUTOTUNE_LOG_ENV)
     if not path:
         return None
     with _lock:
@@ -113,7 +114,7 @@ def load_hierarchical_decisions(path: Optional[str] = None) -> int:
     the in-process decision table; returns how many mesh signatures were
     loaded. Entries for OTHER mesh shapes load fine and simply never match
     ``_mesh_key`` — one log file can serve several topologies."""
-    path = path or os.environ.get(_AUTOTUNE_LOG_ENV)
+    path = path or ev.get_str(_AUTOTUNE_LOG_ENV)
     if not path or not os.path.exists(path):
         return 0
     with open(path) as f:
@@ -250,7 +251,7 @@ def choose_hierarchical(inner_axis: str, outer_axis: str,
     with _lock:
         table = _decisions.get(key)
     if not table and not _env_loaded \
-            and os.environ.get(_AUTOTUNE_LOG_ENV):
+            and ev.get_str(_AUTOTUNE_LOG_ENV):
         # First uncalibrated query of a fresh process: a prior run's
         # persisted table (same mesh signature) beats re-measuring.
         _env_loaded = True

@@ -16,10 +16,12 @@ except ImportError:  # pragma: no cover
     _fn_pickler = pickle
 from typing import Any, Callable, List, Optional
 
+from horovod_tpu.utils import envvars as ev
+
 # Protocol env consumed by task_runner (forwarded over ssh automatically:
 # safe_exec.ssh_wrap exports every HVDTPU_* variable).
-_KV_ADDR_ENV = "HVDTPU_RUN_KV_ADDR"
-_KV_PORT_ENV = "HVDTPU_RUN_KV_PORT"
+_KV_ADDR_ENV = ev.HVDTPU_RUN_KV_ADDR
+_KV_PORT_ENV = ev.HVDTPU_RUN_KV_PORT
 
 
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
@@ -41,10 +43,9 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
     from .http_kv import KVStoreServer
     from .preflight import local_addr
     from .safe_exec import PYTHON_PLACEHOLDER
-    from ..utils import envvars as ev
 
     kwargs = kwargs or {}
-    secret = os.environ.get(ev.HVDTPU_SECRET) or _secrets.token_hex(16)
+    secret = ev.get_str(ev.HVDTPU_SECRET) or _secrets.token_hex(16)
     server = KVStoreServer(secret=secret)
     server.start()
     server.put("/run/fn", _fn_pickler.dumps((fn, args, kwargs)))

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 import socket
 import threading
 import time
@@ -73,7 +72,7 @@ class ElasticDriver:
         # (falling back to os.environ alone would let the server and the
         # workers authenticate with different values).
         self._secret = env.get(ev.HVDTPU_SECRET) or \
-            os.environ.get(ev.HVDTPU_SECRET)
+            ev.get_str(ev.HVDTPU_SECRET)
         if self._secret:
             self._base_env[ev.HVDTPU_SECRET] = self._secret
         self._kv = KVStoreServer(secret=self._secret)

@@ -229,7 +229,7 @@ def _ensure_job_secret(args) -> str:
     ``HVDTPU_SECRET`` wins over generation."""
     if not getattr(args, "_job_secret", None):
         import secrets as _secrets
-        args._job_secret = os.environ.get(ev.HVDTPU_SECRET) or \
+        args._job_secret = ev.get_str(ev.HVDTPU_SECRET) or \
             _secrets.token_hex(16)
     return args._job_secret
 

@@ -20,7 +20,6 @@ Failures name the unreachable host and the address tried, and point at
 
 from __future__ import annotations
 
-import os
 import socket
 import time
 from typing import Callable, Dict, List, Optional
@@ -36,7 +35,7 @@ def local_addr() -> str:
     ``HVDTPU_ADVERTISE_ADDR`` overrides (the multi-NIC escape hatch);
     otherwise the default-route NIC is picked via a connectionless UDP
     socket (reference: driver-service address collection)."""
-    override = os.environ.get(ev.HVDTPU_ADVERTISE_ADDR)
+    override = ev.get_str(ev.HVDTPU_ADVERTISE_ADDR)
     if override:
         return override
     try:
@@ -90,16 +89,16 @@ def probe_main() -> int:
     on each job host). Role and endpoints come from the environment."""
     from .http_kv import KVStoreClient
 
-    kv_addr = os.environ["HVDTPU_PREFLIGHT_KV_ADDR"]
-    kv_port = int(os.environ["HVDTPU_PREFLIGHT_KV_PORT"])
-    host = os.environ["HVDTPU_PREFLIGHT_HOST"]
-    role = os.environ["HVDTPU_PREFLIGHT_ROLE"]  # "listen" | "connect"
-    ctrl_host, ctrl_port = os.environ["HVDTPU_PREFLIGHT_CONTROLLER"]\
-        .rsplit(":", 1)
+    kv_addr = ev.get_required(ev.HVDTPU_PREFLIGHT_KV_ADDR)
+    kv_port = int(ev.get_required(ev.HVDTPU_PREFLIGHT_KV_PORT))
+    host = ev.get_required(ev.HVDTPU_PREFLIGHT_HOST)
+    role = ev.get_required(ev.HVDTPU_PREFLIGHT_ROLE)  # "listen" | "connect"
+    ctrl_host, ctrl_port = ev.get_required(
+        ev.HVDTPU_PREFLIGHT_CONTROLLER).rsplit(":", 1)
     ctrl_port = int(ctrl_port)
-    timeout = float(os.environ.get("HVDTPU_PREFLIGHT_TIMEOUT", "30"))
+    timeout = ev.get_float(ev.HVDTPU_PREFLIGHT_TIMEOUT, 30.0)
     deadline = time.monotonic() + timeout
-    secret = os.environ.get(ev.HVDTPU_SECRET) or None
+    secret = ev.get_str(ev.HVDTPU_SECRET)
     client = KVStoreClient(kv_addr, kv_port, timeout=5.0, secret=secret)
 
     if role == "listen":

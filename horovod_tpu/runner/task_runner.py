@@ -13,7 +13,6 @@ Reference: the remote-exec side of ``horovod.run`` (``horovod/runner/__init__.py
 
 from __future__ import annotations
 
-import os
 import pickle
 import sys
 
@@ -31,13 +30,13 @@ def _run_under_runtime(fn, args, kwargs):
 
 def main() -> int:
     if sys.argv[1] == "--kv":
-        from horovod_tpu.runner import _KV_ADDR_ENV, _KV_PORT_ENV
         from horovod_tpu.runner.http_kv import KVStoreClient
         from horovod_tpu.utils import envvars as ev
 
         client = KVStoreClient(
-            os.environ[_KV_ADDR_ENV], int(os.environ[_KV_PORT_ENV]),
-            timeout=30.0, secret=os.environ.get(ev.HVDTPU_SECRET) or None)
+            ev.get_required(ev.HVDTPU_RUN_KV_ADDR),
+            int(ev.get_required(ev.HVDTPU_RUN_KV_PORT)),
+            timeout=30.0, secret=ev.get_str(ev.HVDTPU_SECRET))
         payload = client.get("/run/fn")
         if payload is None:
             raise RuntimeError("launcher KV store has no /run/fn payload")

@@ -27,7 +27,6 @@ modes, selected automatically:
 from __future__ import annotations
 
 import dataclasses
-import os
 import subprocess
 import threading
 from typing import Optional, Sequence, Tuple
@@ -137,8 +136,7 @@ _init_kwargs: dict = {}
 
 
 def _detect_mode() -> str:
-    if os.environ.get(ev.HVDTPU_SIZE) or os.environ.get(
-            ev.HVDTPU_RENDEZVOUS_ADDR):
+    if ev.get_str(ev.HVDTPU_SIZE) or ev.get_str(ev.HVDTPU_RENDEZVOUS_ADDR):
         return "process"
     return "spmd"
 
@@ -162,7 +160,7 @@ def _elastic_assignment() -> Optional[dict]:
 
     from .runner.http_kv import KVStoreClient
     port = ev.get_int(ev.HVDTPU_RENDEZVOUS_PORT, 0)
-    worker_id = ev.get_str("HVDTPU_WORKER_ID")
+    worker_id = ev.get_str(ev.HVDTPU_WORKER_ID)
     client = KVStoreClient(addr, port,
                            secret=ev.get_str(ev.HVDTPU_SECRET) or None)
     timeout = ev.get_float(ev.HVDTPU_ELASTIC_TIMEOUT, 600.0)
@@ -257,7 +255,7 @@ def _build_mesh(mesh_shape, axis_names, devices):
         devices = jax.devices()
     n = len(devices)
     if mesh_shape is None:
-        shape_env = os.environ.get(ev.HVDTPU_MESH_SHAPE)
+        shape_env = ev.get_str(ev.HVDTPU_MESH_SHAPE)
         if shape_env:
             # e.g. "dp=4,tp=2"
             mesh_shape = {}
@@ -317,7 +315,7 @@ def init(comm: Optional[Sequence[int]] = None,
         # instead of paying the 20-40 s first-compile again. Mirrors the
         # reference's persist-tuned-state ethos (HOROVOD_AUTOTUNE_LOG);
         # here the expensive state is the compiled XLA program.
-        cache_dir = os.environ.get("HVDTPU_COMPILATION_CACHE_DIR")
+        cache_dir = ev.get_str(ev.HVDTPU_COMPILATION_CACHE_DIR)
         if cache_dir:
             try:
                 import jax as _jax

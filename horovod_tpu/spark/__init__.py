@@ -19,6 +19,8 @@ import socket
 import time
 from typing import Any, Callable, Optional
 
+from horovod_tpu.utils import envvars as ev
+
 __all__ = ["run", "run_elastic", "Store", "LocalStore", "FilesystemStore",
            "HDFSStore", "DBFSLocalStore", "PandasDataFrame",
            "Estimator", "EstimatorModel", "TorchEstimator", "TorchModel"]
@@ -101,7 +103,7 @@ def _env_with_job_secret(env: Optional[dict]) -> dict:
     import secrets as _secrets
     env = dict(env or {})
     env["HVDTPU_SECRET"] = env.get("HVDTPU_SECRET") or \
-        os.environ.get("HVDTPU_SECRET") or _secrets.token_hex(16)
+        ev.get_str(ev.HVDTPU_SECRET) or _secrets.token_hex(16)
     return env
 
 
@@ -147,7 +149,7 @@ def _spark_task(rank: int, num_proc: int, kv_addr: str, kv_port: int,
     from horovod_tpu.runner.http_kv import KVStoreClient
 
     deadline = time.monotonic() + start_timeout
-    secret = (env or {}).get("HVDTPU_SECRET") or os.environ.get("HVDTPU_SECRET")
+    secret = (env or {}).get("HVDTPU_SECRET") or ev.get_str(ev.HVDTPU_SECRET)
     client = KVStoreClient(kv_addr, kv_port, timeout=10.0, secret=secret)
     me = _local_addr()
     client.put(f"/spark/host/{rank}", me.encode())
@@ -238,7 +240,7 @@ def _elastic_spark_task(index: int, kv_addr: str, kv_port: int,
     me = _local_addr()
     worker_id = f"{me}:task{index}"
     secret = (env or {}).get("HVDTPU_SECRET") or \
-        os.environ.get("HVDTPU_SECRET")
+        ev.get_str(ev.HVDTPU_SECRET)
     client = KVStoreClient(kv_addr, kv_port, timeout=10.0, secret=secret)
     stop_beat = threading.Event()
     threading.Thread(target=heartbeat_loop,

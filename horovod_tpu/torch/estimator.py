@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import copy
 import io
-import os
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np

@@ -183,6 +183,58 @@ HVDTPU_ELASTIC_TIMEOUT = "HVDTPU_ELASTIC_TIMEOUT"
 HVDTPU_MESH_SHAPE = "HVDTPU_MESH_SHAPE"
 HVDTPU_DP_AXIS = "HVDTPU_DP_AXIS"
 
+# Native-library override: point the ctypes loader at an alternative build of
+# libhvdtpu_core.so — the sanitizer suites (native/Makefile tsan/asan/ubsan
+# targets) rerun the process-mode tests against instrumented builds this way.
+HVDTPU_NATIVE_LIB = "HVDTPU_NATIVE_LIB"
+
+# PowerSGD error-feedback residual accounting (compression/powersgd.py):
+# CAP = hard ceiling in BYTES on total residual state (init raises above
+# it), WARN = byte threshold that logs a warning (default 1 GiB).
+HVDTPU_POWERSGD_RESIDUAL_CAP = "HVDTPU_POWERSGD_RESIDUAL_CAP"
+HVDTPU_POWERSGD_RESIDUAL_WARN = "HVDTPU_POWERSGD_RESIDUAL_WARN"
+
+# XLA compilation-cache directory exported to workers so elastic restarts /
+# onchip_watch attempts reuse warm compiles (scripts/onchip_watch.py STAGE_A).
+HVDTPU_COMPILATION_CACHE_DIR = "HVDTPU_COMPILATION_CACHE_DIR"
+
+# ---------------------------------------------------------------------------
+# Internal variables: set by the launcher / test harness for its own child
+# processes, never meant to be set by users (docs/envvars.md "Internal").
+# Declared here so the invariant linter (scripts/check_invariants.py) can
+# verify every HVDTPU_* string in the tree against this registry.
+# ---------------------------------------------------------------------------
+
+# Elastic worker identity token, injected per-attempt by the elastic driver
+# (runner/elastic/driver.py) and echoed in state-sync commits.
+HVDTPU_WORKER_ID = "HVDTPU_WORKER_ID"
+# runner.run()'s function-shipping KV store address, injected into workers.
+HVDTPU_RUN_KV_ADDR = "HVDTPU_RUN_KV_ADDR"
+HVDTPU_RUN_KV_PORT = "HVDTPU_RUN_KV_PORT"
+# Connectivity-preflight probe parameters (runner/preflight.py _probe_main:
+# the probe subprocess reads its marching orders from these).
+HVDTPU_PREFLIGHT_KV_ADDR = "HVDTPU_PREFLIGHT_KV_ADDR"
+HVDTPU_PREFLIGHT_KV_PORT = "HVDTPU_PREFLIGHT_KV_PORT"
+HVDTPU_PREFLIGHT_HOST = "HVDTPU_PREFLIGHT_HOST"
+HVDTPU_PREFLIGHT_ROLE = "HVDTPU_PREFLIGHT_ROLE"
+HVDTPU_PREFLIGHT_CONTROLLER = "HVDTPU_PREFLIGHT_CONTROLLER"
+HVDTPU_PREFLIGHT_TIMEOUT = "HVDTPU_PREFLIGHT_TIMEOUT"
+
+# Names the invariant linter requires to be documented under
+# docs/envvars.md's "## Internal" section rather than a user-facing table
+# (ENV-DOC in scripts/check_invariants.py).
+INTERNAL_ENV_VARS = frozenset({
+    HVDTPU_WORKER_ID,
+    HVDTPU_RUN_KV_ADDR,
+    HVDTPU_RUN_KV_PORT,
+    HVDTPU_PREFLIGHT_KV_ADDR,
+    HVDTPU_PREFLIGHT_KV_PORT,
+    HVDTPU_PREFLIGHT_HOST,
+    HVDTPU_PREFLIGHT_ROLE,
+    HVDTPU_PREFLIGHT_CONTROLLER,
+    HVDTPU_PREFLIGHT_TIMEOUT,
+})
+
 
 def get_int(name: str, default: int) -> int:
     v = os.environ.get(name)
@@ -216,3 +268,11 @@ def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
     if v is None or v == "":
         return default
     return v
+
+
+def get_required(name: str) -> str:
+    """A variable the caller cannot proceed without (launcher-injected
+    internals like the preflight probe parameters). Raises KeyError like a
+    raw ``os.environ[name]`` would, so existing failure modes are
+    unchanged."""
+    return os.environ[name]

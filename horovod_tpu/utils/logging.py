@@ -9,8 +9,9 @@ stdlib ``logging`` module, controlled by ``HVDTPU_LOG_LEVEL`` ∈
 from __future__ import annotations
 
 import logging as _pylogging
-import os
 import sys
+
+from . import envvars as ev
 
 TRACE = 5
 _pylogging.addLevelName(TRACE, "TRACE")
@@ -30,12 +31,12 @@ def _make_logger() -> _pylogging.Logger:
     logger = _pylogging.getLogger("horovod_tpu")
     if not logger.handlers:
         handler = _pylogging.StreamHandler(sys.stderr)
-        hide_time = os.environ.get("HVDTPU_LOG_HIDE_TIME", "").lower() in ("1", "true")
+        hide_time = ev.get_bool(ev.HVDTPU_LOG_HIDE_TIME)
         fmt = "[%(levelname)s] %(message)s" if hide_time else \
             "%(asctime)s [%(levelname)s] %(message)s"
         handler.setFormatter(_pylogging.Formatter(fmt))
         logger.addHandler(handler)
-        level_name = os.environ.get("HVDTPU_LOG_LEVEL", "warning").lower()
+        level_name = (ev.get_str(ev.HVDTPU_LOG_LEVEL) or "warning").lower()
         logger.setLevel(_LEVELS.get(level_name, _pylogging.WARNING))
         logger.propagate = False
     return logger
@@ -46,7 +47,7 @@ logger = _make_logger()
 
 def _prefix(msg: str) -> str:
     # Rank prefix, like the reference's "[<rank>]:" (logging.cc LogMessage).
-    rank = os.environ.get("HVDTPU_RANK")
+    rank = ev.get_str(ev.HVDTPU_RANK)
     return f"[{rank}]: {msg}" if rank is not None else msg
 
 

@@ -1,0 +1,595 @@
+#!/usr/bin/env python3
+"""Cross-language invariant linter (stdlib-only; tier-1 via
+tests/test_static_analysis.py, CI via `make lint`).
+
+The config surface of this rebuild exists in three languages at once:
+``HVDTPU_*`` environment variables (Python registry + C++ parsers), hvdrun
+flags, Prometheus metric names, and the binary wire-format tags shared by
+``native/core.cpp`` and the Python mirrors in ``basics.py``. Nothing about
+the type system keeps those copies in sync — and per the source paper most
+distributed-training failures are silent coordination/config divergence, so
+a drifted frame tag or renamed env var corrupts a job instead of crashing
+it. This linter makes each agreement a test failure instead.
+
+Rules (each reported as ``path:line: [RULE] message``):
+
+  ENV-DECL    every HVDTPU_* token used under horovod_tpu/ is declared in
+              utils/envvars.py (constant name == string value).
+  ENV-DOC     every declared HVDTPU_* has a docs/envvars.md row, and every
+              documented one is declared (both drift directions);
+              INTERNAL_ENV_VARS members must sit in the "## Internal"
+              section, not a user-facing table.
+  ENV-RAW     no raw os.environ / os.getenv READ of an HVDTPU_* key outside
+              utils/envvars.py — use the typed registry helpers
+              (envvars.get_str/get_int/get_float/get_bool/get_required).
+              Writes (launcher env injection) are allowed.
+  MET-DOC     metric families registered against the native metrics registry
+              appear in docs/metrics.md's catalog, and vice versa.
+  FLAG-DOC    every hvdrun flag (runner/launch.py add_argument) has a
+              docs/runner.md mention, and every flag-reference row names a
+              real flag.
+  ENUM-MIRROR native wire enums (DataType/OpType/ReduceOp/ResponseType/
+              CtrlMsg/AllreduceAlgo/HierMode/WireCompression) match their
+              Python mirrors byte-for-byte, both directions.
+
+Exit status: 0 on a clean tree, 1 if any rule fired. ``--root`` points the
+linter at an alternative tree (the negative fixtures under
+tests/data/lint_fixtures/); rules whose *source* files are absent in that
+tree are skipped and listed in the end-of-run summary, so fixtures stay
+minimal while the real tree runs everything (the tier-1 test asserts the
+full rule set ran).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+
+ENV_RE = re.compile(r"HVDTPU_[A-Z0-9_]+")
+# HVDTPU_-prefixed identifiers that are not environment variables (the C++
+# thread-safety-annotation macro family in native/common.h).
+NON_ENV_TOKENS = {"HVDTPU_TSA"}
+
+ENVVARS_PY = "horovod_tpu/utils/envvars.py"
+ENV_DOC = "docs/envvars.md"
+METRICS_DOC = "docs/metrics.md"
+RUNNER_DOC = "docs/runner.md"
+LAUNCH_PY = "horovod_tpu/runner/launch.py"
+NATIVE_DIR = "horovod_tpu/native"
+
+
+class Finding:
+    def __init__(self, path, line, rule, message):
+        self.path, self.line, self.rule, self.message = path, line, rule, message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _line_of(text: str, offset: int) -> int:
+    return text.count("\n", 0, offset) + 1
+
+
+def _read(root: Path, rel: str):
+    p = root / rel
+    if not p.is_file():
+        return None
+    return p.read_text(encoding="utf-8", errors="replace")
+
+
+# ---------------------------------------------------------------------------
+# envvars registry model
+# ---------------------------------------------------------------------------
+
+def parse_registry(root: Path, findings):
+    """-> (declared {name: line}, internal set) or None if envvars.py absent."""
+    src = _read(root, ENVVARS_PY)
+    if src is None:
+        return None
+    tree = ast.parse(src)
+    declared, internal = {}, set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if ENV_RE.fullmatch(tgt.id):
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                if node.value.value != tgt.id:
+                    findings.append(Finding(
+                        ENVVARS_PY, node.lineno, "ENV-DECL",
+                        f"constant {tgt.id} is bound to "
+                        f"{node.value.value!r}; registry constants must "
+                        "equal their own name"))
+                declared[tgt.id] = node.lineno
+        elif tgt.id == "INTERNAL_ENV_VARS":
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and ENV_RE.fullmatch(n.id):
+                    internal.add(n.id)
+                elif isinstance(n, ast.Constant) and \
+                        isinstance(n.value, str) and ENV_RE.fullmatch(n.value):
+                    internal.add(n.value)
+    return declared, internal
+
+
+def iter_source_files(root: Path):
+    base = root / "horovod_tpu"
+    if not base.is_dir():
+        return
+    for p in sorted(base.rglob("*")):
+        if p.suffix in (".py", ".cpp", ".h") and p.is_file():
+            yield p
+
+
+def check_env_rules(root: Path, findings, ran):
+    reg = parse_registry(root, findings)
+    if reg is None:
+        return
+    declared, internal = reg
+    ran += ["ENV-DECL"]
+
+    # ENV-DECL: usage -> declaration.
+    for p in iter_source_files(root):
+        rel = p.relative_to(root).as_posix()
+        text = p.read_text(encoding="utf-8", errors="replace")
+        for m in ENV_RE.finditer(text):
+            name = m.group(0)
+            if name in NON_ENV_TOKENS:
+                continue
+            if name not in declared and rel != ENVVARS_PY:
+                findings.append(Finding(
+                    rel, _line_of(text, m.start()), "ENV-DECL",
+                    f"{name} is not declared in {ENVVARS_PY}; every "
+                    "HVDTPU_* knob must live in the registry"))
+                declared.setdefault(name, 0)  # report each name once
+
+    # ENV-DOC: declaration <-> docs/envvars.md, both directions.
+    doc = _read(root, ENV_DOC)
+    if doc is None:
+        findings.append(Finding(
+            ENV_DOC, 1, "ENV-DOC",
+            f"{ENV_DOC} is missing; it is the reference table the "
+            "ENV-DOC rule checks declarations against"))
+    else:
+        ran += ["ENV-DOC"]
+        documented = {}
+        for m in ENV_RE.finditer(doc):
+            documented.setdefault(m.group(0), _line_of(doc, m.start()))
+        # INTERNAL_ENV_VARS members must sit in the doc's "## Internal"
+        # section (they are launcher/test plumbing, not user knobs — filing
+        # one under a user-facing heading misadvertises it as settable).
+        im = re.search(r"^## Internal\b.*?$(.*?)(?=^## |\Z)", doc,
+                       re.S | re.M)
+        internal_doc = {m.group(0) for m in ENV_RE.finditer(im.group(1))} \
+            if im is not None else set()
+        for name, line in sorted(declared.items()):
+            if line == 0:
+                continue  # already reported as undeclared usage
+            if name not in documented:
+                findings.append(Finding(
+                    ENVVARS_PY, line, "ENV-DOC",
+                    f"{name} is declared but has no row in {ENV_DOC}"))
+            elif name in internal and name not in internal_doc:
+                findings.append(Finding(
+                    ENVVARS_PY, line, "ENV-DOC",
+                    f"{name} is in INTERNAL_ENV_VARS but not documented "
+                    f"under {ENV_DOC}'s \"## Internal\" section"))
+        for name, line in sorted(documented.items()):
+            if name in NON_ENV_TOKENS:
+                continue
+            if name not in declared:
+                findings.append(Finding(
+                    ENV_DOC, line, "ENV-DOC",
+                    f"{name} is documented but not declared in "
+                    f"{ENVVARS_PY} (stale doc or missing declaration)"))
+
+    # ENV-RAW: ast scan of Python files for raw environment reads.
+    ran += ["ENV-RAW"]
+    for p in iter_source_files(root):
+        rel = p.relative_to(root).as_posix()
+        if p.suffix != ".py" or rel == ENVVARS_PY:
+            continue
+        try:
+            tree = ast.parse(p.read_text(encoding="utf-8", errors="replace"))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "ENV-RAW",
+                                    f"unparseable Python: {e.msg}"))
+            continue
+        for f in find_raw_env_reads(tree):
+            findings.append(Finding(
+                rel, f[0], "ENV-RAW",
+                f"raw environment read of {f[1]}; route it through "
+                "horovod_tpu.utils.envvars (get_str/get_int/get_float/"
+                "get_bool/get_required)"))
+
+
+def _env_key_name(node, consts={}):
+    """HVDTPU_* name if this ast node is an env-var key, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) and \
+            ENV_RE.fullmatch(node.value):
+        return node.value
+    if isinstance(node, ast.Attribute) and ENV_RE.fullmatch(node.attr):
+        return node.attr  # envvars.HVDTPU_X / ev.HVDTPU_X
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id]  # KEY = "HVDTPU_X"; os.environ[KEY]
+    return None
+
+
+def _collect_env_consts(tree):
+    """Names bound to an HVDTPU_* string literal or registry attribute
+    (``_KV_ADDR_ENV = "HVDTPU_RUN_KV_ADDR"``, ``KEY = ev.HVDTPU_X``), so a
+    read keyed through a variable cannot slip past ENV-RAW."""
+    consts = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        if isinstance(val, ast.Constant) and isinstance(val.value, str) and \
+                ENV_RE.fullmatch(val.value):
+            name = val.value
+        elif isinstance(val, ast.Attribute) and ENV_RE.fullmatch(val.attr):
+            name = val.attr
+        else:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                consts[tgt.id] = name
+    return consts
+
+
+def _is_os_environ(node):
+    return (isinstance(node, ast.Attribute) and node.attr == "environ" and
+            isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+def find_raw_env_reads(tree):
+    out = []
+    consts = _collect_env_consts(tree)
+    for node in ast.walk(tree):
+        # os.environ[KEY] in Load context (writes are launcher env injection
+        # and stay legal).
+        if isinstance(node, ast.Subscript) and _is_os_environ(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            name = _env_key_name(node.slice, consts)
+            if name:
+                out.append((node.lineno, name))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            key = None
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("get", "pop", "setdefault") and \
+                    _is_os_environ(fn.value) and node.args:
+                key = _env_key_name(node.args[0], consts)
+            elif isinstance(fn, ast.Attribute) and fn.attr == "getenv" and \
+                    isinstance(fn.value, ast.Name) and fn.value.id == "os" \
+                    and node.args:
+                key = _env_key_name(node.args[0], consts)
+            if key:
+                out.append((node.lineno, key))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metric catalog
+# ---------------------------------------------------------------------------
+
+METRIC_REG_RE = re.compile(
+    r"Get(?:Counter|Gauge|Histogram)\(\s*\"(hvdtpu_[a-z0-9_]+)\"", re.S)
+
+
+def check_metrics(root: Path, findings, ran):
+    native = root / NATIVE_DIR
+    if not native.is_dir():
+        return
+    registered = {}  # name -> (relpath, line)
+    for p in sorted(native.glob("*.cpp")) + sorted(native.glob("*.h")):
+        if p.name == "unit_tests.cpp":
+            continue
+        text = p.read_text(encoding="utf-8", errors="replace")
+        for m in METRIC_REG_RE.finditer(text):
+            registered.setdefault(
+                m.group(1),
+                (p.relative_to(root).as_posix(), _line_of(text, m.start())))
+    if not registered:
+        return
+    doc = _read(root, METRICS_DOC)
+    if doc is None:
+        findings.append(Finding(
+            METRICS_DOC, 1, "MET-DOC",
+            f"{METRICS_DOC} is missing but the native core registers "
+            f"{len(registered)} metric families"))
+        return
+    ran += ["MET-DOC"]
+    # The catalog section's backticked names are the documented set; names
+    # mentioned elsewhere (surfaces table, prose) don't count as catalog rows.
+    m = re.search(r"^## Metric catalog$(.*?)(?=^## |\Z)", doc, re.S | re.M)
+    if m is None:
+        findings.append(Finding(
+            METRICS_DOC, 1, "MET-DOC",
+            'no "## Metric catalog" section found'))
+        return
+    section, sec_off = m.group(1), m.start(1)
+    # Catalog rows are markdown table lines; only the NAME column counts (a
+    # backticked metric in a meaning cell is prose, not a catalog entry).
+    documented = {}
+    offset = sec_off
+    for raw in section.splitlines(keepends=True):
+        if raw.lstrip().startswith("|"):
+            name_cell = raw.split("|")[1] if raw.count("|") >= 2 else ""
+            for bm in re.finditer(r"`(hvdtpu_[a-z0-9_]+)`", name_cell):
+                documented.setdefault(bm.group(1), _line_of(doc, offset))
+        offset += len(raw)
+    for name, (rel, line) in sorted(registered.items()):
+        if name not in documented:
+            findings.append(Finding(
+                rel, line, "MET-DOC",
+                f"metric {name} is registered here but missing from "
+                f"{METRICS_DOC}'s catalog"))
+    for name, line in sorted(documented.items()):
+        if name not in registered:
+            findings.append(Finding(
+                METRICS_DOC, line, "MET-DOC",
+                f"metric {name} is in the catalog but never registered "
+                "in the native core (stale doc?)"))
+
+
+# ---------------------------------------------------------------------------
+# hvdrun flags
+# ---------------------------------------------------------------------------
+
+def check_flags(root: Path, findings, ran):
+    src = _read(root, LAUNCH_PY)
+    if src is None:
+        return
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return
+    flags = {}  # "--flag" -> line
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "add_argument":
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                        and a.value.startswith("--"):
+                    flags[a.value] = node.lineno
+    if not flags:
+        return
+    doc = _read(root, RUNNER_DOC)
+    if doc is None:
+        findings.append(Finding(
+            RUNNER_DOC, 1, "FLAG-DOC",
+            f"{RUNNER_DOC} is missing but hvdrun defines "
+            f"{len(flags)} flags"))
+        return
+    ran += ["FLAG-DOC"]
+    # Forward: every flag needs a "## Flag reference" table row — a prose
+    # mention elsewhere in the doc does not count, or deleting a row would
+    # slip through whenever the flag also appears in running text. A doc
+    # without the section falls back to whole-file search (fixture trees).
+    m = re.search(r"^## Flag reference$(.*?)(?=^## |\Z)", doc, re.S | re.M)
+    haystack = m.group(1) if m is not None else doc
+    for flag, line in sorted(flags.items()):
+        if not re.search(re.escape(flag) + r"(?![\w-])", haystack):
+            findings.append(Finding(
+                LAUNCH_PY, line, "FLAG-DOC",
+                f"hvdrun flag {flag} has no {RUNNER_DOC} "
+                "flag-reference row"))
+    # Reverse: flag-reference rows must name real flags.
+    if m is not None:
+        for rm in re.finditer(r"`(--[a-z][\w-]*)`", m.group(1)):
+            if rm.group(1) not in flags:
+                findings.append(Finding(
+                    RUNNER_DOC, _line_of(doc, m.start(1) + rm.start()),
+                    "FLAG-DOC",
+                    f"documented flag {rm.group(1)} does not exist in "
+                    f"{LAUNCH_PY} (stale doc?)"))
+
+
+# ---------------------------------------------------------------------------
+# native enum <-> Python mirror parity
+# ---------------------------------------------------------------------------
+
+CPP_ENUM_RE = r"enum class {name}\s*:\s*int32_t\s*\{{(.*?)\}};"
+CPP_ENTRY_RE = re.compile(r"^\s*([A-Z][A-Z0-9_]*)\s*=\s*(\d+)\s*,?\s*(?://.*)?$")
+
+
+def parse_cpp_enum(root: Path, rel: str, name: str):
+    """-> ({ENTRY: code}, line) or None if file/enum absent."""
+    text = _read(root, rel)
+    if text is None:
+        return None
+    m = re.search(CPP_ENUM_RE.format(name=name), text, re.S)
+    if m is None:
+        return None
+    entries = {}
+    for raw in m.group(1).splitlines():
+        em = CPP_ENTRY_RE.match(raw)
+        if em:
+            entries[em.group(1)] = int(em.group(2))
+    return entries, _line_of(text, m.start())
+
+
+def parse_py_dict(root: Path, rel: str, var: str):
+    """Module-level `var = {str: int, ...}` -> ({key: val}, line) or None."""
+    src = _read(root, rel)
+    if src is None:
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == var and isinstance(node.value, ast.Dict):
+            try:
+                d = {k.value: v.value
+                     for k, v in zip(node.value.keys, node.value.values)}
+            except AttributeError:
+                return None
+            return d, node.lineno
+    return None
+
+
+def parse_py_tuple(root: Path, rel: str, var: str):
+    src = _read(root, rel)
+    if src is None:
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == var and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            return ([e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)], node.lineno)
+    return None
+
+
+def parse_py_intenum(root: Path, rel: str, cls: str):
+    src = _read(root, rel)
+    if src is None:
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            d = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.targets[0], ast.Name) and \
+                        isinstance(stmt.value, ast.Constant) and \
+                        isinstance(stmt.value.value, int):
+                    d[stmt.targets[0].id] = stmt.value.value
+            return d, node.lineno
+    return None
+
+
+def _diff_enum(rule_ran, pair_name, cpp, py, py_rel, py_line,
+               key_of_entry=lambda e: e.lower()):
+    """Both-direction value comparison; findings anchor on the Python mirror
+    (the usual edit site) and name the native enum."""
+    cpp_entries, _ = cpp
+    py_map, _ = py
+    rule_ran.append(pair_name)
+    for entry, code in sorted(cpp_entries.items()):
+        key = key_of_entry(entry)
+        if key not in py_map:
+            yield Finding(
+                py_rel, py_line, "ENUM-MIRROR",
+                f"{pair_name}: native entry {entry}={code} has no Python "
+                f"mirror key {key!r}")
+        elif py_map[key] != code:
+            yield Finding(
+                py_rel, py_line, "ENUM-MIRROR",
+                f"{pair_name}: {key!r} is {py_map[key]} here but "
+                f"{entry}={code} in the native enum — wire values must "
+                "match byte-for-byte")
+    entry_keys = {key_of_entry(e) for e in cpp_entries}
+    for key in sorted(py_map):
+        if key not in entry_keys:
+            yield Finding(
+                py_rel, py_line, "ENUM-MIRROR",
+                f"{pair_name}: Python mirror key {key!r} has no native "
+                "enum entry")
+
+
+def check_enum_mirrors(root: Path, findings, ran):
+    pairs_run = []
+
+    def dict_pair(name, cpp_rel, enum, py_rel, var):
+        cpp = parse_cpp_enum(root, cpp_rel, enum)
+        py = parse_py_dict(root, py_rel, var)
+        if cpp is None or py is None:
+            return
+        findings.extend(_diff_enum(pairs_run, name, cpp, py, py_rel, py[1]))
+
+    dict_pair("DataType", f"{NATIVE_DIR}/common.h", "DataType",
+              "horovod_tpu/basics.py", "_DTYPES")
+    dict_pair("OpType", f"{NATIVE_DIR}/common.h", "OpType",
+              "horovod_tpu/basics.py", "_OP_TYPES")
+    dict_pair("CtrlMsg", f"{NATIVE_DIR}/core.cpp", "CtrlMsg",
+              "horovod_tpu/basics.py", "_CTRL_MSGS")
+    dict_pair("ResponseType", f"{NATIVE_DIR}/message.h", "ResponseType",
+              "horovod_tpu/basics.py", "_RESPONSE_TYPES")
+    dict_pair("WireCompression", f"{NATIVE_DIR}/compressed.h",
+              "WireCompression", ENVVARS_PY, "WIRE_COMPRESSION_MODES")
+
+    # ReduceOp: IntEnum mirror, names compared verbatim.
+    cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/common.h", "ReduceOp")
+    py = parse_py_intenum(root, "horovod_tpu/ops/collectives.py", "ReduceOp")
+    if cpp is not None and py is not None:
+        findings.extend(_diff_enum(
+            pairs_run, "ReduceOp", cpp, py,
+            "horovod_tpu/ops/collectives.py", py[1],
+            key_of_entry=lambda e: e))
+
+    # AllreduceAlgo: tuple mirror, index == code.
+    cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/data_plane.h", "AllreduceAlgo")
+    py = parse_py_tuple(root, ENVVARS_PY, "ALLREDUCE_ALGOS")
+    if cpp is not None and py is not None:
+        as_dict = ({name: i for i, name in enumerate(py[0])}, py[1])
+        findings.extend(_diff_enum(pairs_run, "AllreduceAlgo",
+                                   cpp, as_dict, ENVVARS_PY, py[1]))
+
+    # HierMode: alias dict — canonical aliases must map to the enum codes
+    # and no alias may name a code the enum lacks.
+    cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/data_plane.h", "HierMode")
+    py = parse_py_dict(root, ENVVARS_PY, "ALLREDUCE_HIER_MODES")
+    if cpp is not None and py is not None:
+        pairs_run.append("HierMode")
+        entries, _ = cpp
+        aliases, line = py
+        for canon in ("off", "on", "auto"):
+            want = entries.get(canon.upper())
+            got = aliases.get(canon)
+            if got != want:
+                findings.append(Finding(
+                    ENVVARS_PY, line, "ENUM-MIRROR",
+                    f"HierMode: alias {canon!r} maps to {got} but the "
+                    f"native enum has {canon.upper()}={want}"))
+        bad = set(aliases.values()) - set(entries.values())
+        if bad:
+            findings.append(Finding(
+                ENVVARS_PY, line, "ENUM-MIRROR",
+                f"HierMode: alias codes {sorted(bad)} do not exist in the "
+                "native enum"))
+
+    if pairs_run:
+        ran.append("ENUM-MIRROR(%s)" % ",".join(pairs_run))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="tree to lint (default: the repo this script "
+                         "lives in); used by the negative-fixture tests")
+    args = ap.parse_args(argv)
+    root = Path(args.root) if args.root else Path(__file__).resolve().parent.parent
+    findings, ran = [], []
+    check_env_rules(root, findings, ran)
+    check_metrics(root, findings, ran)
+    check_flags(root, findings, ran)
+    check_enum_mirrors(root, findings, ran)
+    for f in findings:
+        print(f)
+    print(f"check_invariants: {len(findings)} finding(s); "
+          f"rules run: {', '.join(ran) if ran else 'none'}",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# CI gate: static correctness layer + the full native sanitizer matrix
+# (docs/static-analysis.md). Runs every check even after a failure and ends
+# with a pass/fail table; exits non-zero if anything failed, so this is a
+# one-line CI job:
+#
+#   bash scripts/ci_checks.sh
+#
+# On boxes without clang/ruff the tidy/analyze/ruff legs of `make lint`
+# self-skip (printing SKIPPED); the invariant linter and the native test
+# matrix always run.
+
+set -u
+cd "$(dirname "$0")/.."
+
+declare -a NAMES RESULTS
+overall=0
+
+run_check() {
+  local name="$1"; shift
+  echo
+  echo "=== ${name}: $* ==="
+  if "$@"; then
+    RESULTS+=("PASS")
+  else
+    RESULTS+=("FAIL")
+    overall=1
+  fi
+  NAMES+=("${name}")
+}
+
+run_check "lint"        make lint
+run_check "check"       make check
+run_check "check-tsan"  make check-tsan
+run_check "check-asan"  make check-asan
+run_check "check-ubsan" make check-ubsan
+
+echo
+echo "============ CI summary ============"
+for i in "${!NAMES[@]}"; do
+  printf '  %-12s %s\n' "${NAMES[$i]}" "${RESULTS[$i]}"
+done
+echo "===================================="
+exit "${overall}"

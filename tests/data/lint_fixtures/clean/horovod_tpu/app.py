@@ -1,0 +1,4 @@
+"""Fixture consumer using the registry helper."""
+from .utils import envvars as ev
+
+VALUE = ev.get_str(ev.HVDTPU_CLEAN)

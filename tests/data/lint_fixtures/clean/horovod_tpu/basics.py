@@ -1,0 +1,2 @@
+"""Fixture Python mirror, in sync."""
+_CTRL_MSGS = {"hello": 1, "peers": 2}

@@ -1,0 +1,5 @@
+// Fixture frame tags, in sync with basics.py.
+enum class CtrlMsg : int32_t {
+  HELLO = 1,
+  PEERS = 2,
+};

@@ -1,0 +1,4 @@
+// Fixture: one registered, documented metric.
+void Instrument(Metrics& m) {
+  m.GetCounter("hvdtpu_fixture_clean_total", "documented")->Inc();
+}

@@ -1,0 +1,8 @@
+"""Fixture launcher, fully documented."""
+import argparse
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="hvdrun")
+    p.add_argument("--documented-flag", help="has a row")
+    return p

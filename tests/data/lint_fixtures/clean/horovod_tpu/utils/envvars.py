@@ -1,0 +1,8 @@
+"""Fixture registry, fully documented."""
+import os
+
+HVDTPU_CLEAN = "HVDTPU_CLEAN"
+
+
+def get_str(name, default=None):
+    return os.environ.get(name, default)

@@ -1,0 +1,2 @@
+"""Fixture Python mirror with a stale PEERS tag."""
+_CTRL_MSGS = {"hello": 1, "peers": 2}

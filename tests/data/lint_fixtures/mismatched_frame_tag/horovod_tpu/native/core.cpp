@@ -1,0 +1,5 @@
+// Fixture: control-plane frame tags.
+enum class CtrlMsg : int32_t {
+  HELLO = 1,
+  PEERS = 3,  // drifted: Python still says 2
+};

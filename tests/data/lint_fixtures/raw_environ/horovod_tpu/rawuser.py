@@ -1,0 +1,12 @@
+"""Fixture: four raw reads (subscript, .get, getenv, variable-keyed
+subscript) and one legal write."""
+import os
+
+from .utils import envvars as ev
+
+A = os.environ["HVDTPU_RAWREAD"]
+B = os.environ.get(ev.HVDTPU_RAWREAD)
+C = os.getenv("HVDTPU_RAWREAD")
+_KEY = "HVDTPU_RAWREAD"
+D = os.environ[_KEY]
+os.environ["HVDTPU_RAWREAD"] = "writes are launcher env injection"

@@ -1,0 +1,2 @@
+"""Fixture registry."""
+HVDTPU_RAWREAD = "HVDTPU_RAWREAD"

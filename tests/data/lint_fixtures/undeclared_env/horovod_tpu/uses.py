@@ -1,0 +1,4 @@
+"""Fixture: uses an env var the registry never declared."""
+from .utils import envvars as ev
+
+FLAG = ev.get_str("HVDTPU_NOT_DECLARED")

@@ -1,0 +1,2 @@
+"""Fixture registry: one declared knob."""
+HVDTPU_DECLARED = "HVDTPU_DECLARED"

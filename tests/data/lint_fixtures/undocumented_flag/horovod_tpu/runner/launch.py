@@ -1,0 +1,10 @@
+"""Fixture launcher with one documented and one undocumented flag."""
+import argparse
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="hvdrun")
+    p.add_argument("--documented-flag", help="has a row")
+    p.add_argument("--ghost-flag", help="no row anywhere")
+    p.add_argument("--prose-only-flag", help="mentioned in prose, no row")
+    return p

@@ -1,0 +1,5 @@
+// Fixture: registers two metrics; only one has a catalog row.
+void Instrument(Metrics& m) {
+  m.GetCounter("hvdtpu_fixture_documented_total", "in the catalog")->Inc();
+  m.GetCounter("hvdtpu_fixture_missing_total", "not in the catalog")->Inc();
+}

@@ -151,6 +151,38 @@ def test_tsan_pipelined_allreduce():
     assert results[0][1].count('"bytes"') == 2, results[0][1]
 
 
+def test_ubsan_native_unit_tests():
+    """Standalone UBSan build of the native unit-test binary (ISSUE 5
+    satellite): -fsanitize=undefined alone with -fno-sanitize-recover=all,
+    so pure-UB findings (misaligned loads, signed overflow in the quantizer
+    math, bad enum casts from wire bytes) abort instead of riding along
+    under ASan's error path where an address report can mask them."""
+    r = subprocess.run(["make", "-C", NATIVE, "check-ubsan"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    assert "ALL OK" in r.stdout
+    for line in (r.stdout + r.stderr).splitlines():
+        assert "runtime error" not in line, line
+
+
+def test_ubsan_process_mode():
+    """The full process-mode op menu against the UBSan-only .so. libubsan
+    is preloaded for the uninstrumented python host; any runtime-error
+    report fails the run via halt_on_error (the build is
+    -fno-sanitize-recover=all, so recovery is impossible anyway)."""
+    rt = _gcc_file("libubsan.so")
+    stdcxx = _gcc_file("libstdc++.so")
+    if not rt or not stdcxx:
+        pytest.skip("libubsan.so/libstdc++.so not found")
+    lib = _build("ubsan")
+    results = launch_world(2, WORKER, extra_env={
+        "HVDTPU_NATIVE_LIB": lib,
+        "LD_PRELOAD": f"{rt} {stdcxx}",
+        "UBSAN_OPTIONS": "print_stacktrace=1,halt_on_error=1",
+    }, timeout=240)
+    _scan(results, "runtime error")
+
+
 def test_asan_ubsan_process_mode():
     rt = _gcc_file("libasan.so")
     stdcxx = _gcc_file("libstdc++.so")

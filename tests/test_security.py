@@ -147,7 +147,8 @@ def _rogue_hello(port: int, stop: threading.Event):
     """Keep sending unauthenticated HELLO frames at the coordinator: rank 1,
     no secret proof. An unauthenticated controller would accept this as the
     real rank 1 and the job would break."""
-    payload = (struct.pack("<i", 1)            # CtrlMsg::HELLO
+    from horovod_tpu import basics
+    payload = (struct.pack("<i", basics._CTRL_MSGS["hello"])
                + struct.pack("<i", 1)          # rank 1
                + struct.pack("<q", 9) + b"127.0.0.1"
                + struct.pack("<i", 1))         # bogus data-plane port
