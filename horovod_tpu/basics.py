@@ -115,6 +115,15 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_stall_shutdown.restype = ctypes.c_int
     lib.hvdtpu_set_stall_shutdown.argtypes = [ctypes.c_void_p,
                                               ctypes.c_double]
+    lib.hvdtpu_set_failure_detection.restype = ctypes.c_int
+    lib.hvdtpu_set_failure_detection.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double, ctypes.c_double]
+    lib.hvdtpu_set_chaos.restype = ctypes.c_int
+    lib.hvdtpu_set_chaos.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_int]
+    lib.hvdtpu_observe_recovery.restype = ctypes.c_int
+    lib.hvdtpu_observe_recovery.argtypes = [ctypes.c_void_p, ctypes.c_double]
     lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
     lib.hvdtpu_set_allreduce_tuning.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong]
@@ -210,11 +219,31 @@ class NativeCore:
         if secret:
             # Authenticated control plane (reference: secret.py shared key).
             self._lib.hvdtpu_set_secret(self._core, secret.encode())
-        # Stall force-shutdown (reference: HOROVOD_STALL_SHUTDOWN_TIME_SECONDS,
-        # 0 = disabled).
+        # Stall force-shutdown (reference: HOROVOD_STALL_SHUTDOWN_TIME_SECONDS
+        # — the reference defaults this to 0/disabled, which left the
+        # escalation dead code; here the default is AUTO (-1): 10x the
+        # warning threshold, so a wedged world always breaks eventually.
+        # An explicit 0 still disables).
         self._lib.hvdtpu_set_stall_shutdown(
             self._core,
-            ev.get_float(ev.HVDTPU_STALL_SHUTDOWN_TIME_SECONDS, 0.0))
+            ev.get_float(ev.HVDTPU_STALL_SHUTDOWN_TIME_SECONDS, -1.0))
+        # Fast failure detection (docs/fault-tolerance.md): how quickly a
+        # dead/hung peer breaks in-flight transport ops, and how long mesh
+        # form-up may take before failing over to re-rendezvous.
+        self._lib.hvdtpu_set_failure_detection(
+            self._core,
+            ev.get_int(ev.HVDTPU_FAILURE_DETECT_MS, 500),
+            ev.get_float(ev.HVDTPU_READ_DEADLINE_SECONDS, 10.0),
+            ev.get_float(ev.HVDTPU_FORMUP_TIMEOUT_SECONDS, 60.0))
+        # Fault injection (HVDTPU_CHAOS; horovod_tpu/chaos.py owns the
+        # grammar, including rank targeting and the elastic one-shot
+        # marker). A malformed spec fails init loudly on every rank.
+        from .chaos import armed_chaos
+        chaos = armed_chaos(rank)
+        if chaos is not None:
+            self._lib.hvdtpu_set_chaos(
+                self._core, chaos.action, chaos.op_index, chaos.hop_index,
+                chaos.delay_ms, chaos.peer)
         # Allreduce algorithm menu (reference fork: ring/scatter-allgather/
         # tree selection). auto = size-adaptive: recursive doubling at or
         # below the (autotuned) crossover, pipelined ring above it.
@@ -339,6 +368,16 @@ class NativeCore:
         :func:`horovod_tpu.observability.parse_prometheus_text` for the shape."""
         from .observability import parse_prometheus_text
         return parse_prometheus_text(self.metrics_dump())
+
+    def observe_recovery(self, seconds: float) -> None:
+        """Record one completed elastic recovery: failure detection to
+        successful re-initialization took ``seconds``. Observed against
+        THIS (post-recovery) core's registry — ``hvdtpu_recovery_seconds``
+        plus a ``hvdtpu_failures_detected_total`` increment — so
+        ``hvd.metrics()`` after a recovery shows the whole episode
+        (docs/fault-tolerance.md)."""
+        if self._core:
+            self._lib.hvdtpu_observe_recovery(self._core, float(seconds))
 
     # -- collectives -------------------------------------------------------
 

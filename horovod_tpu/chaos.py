@@ -1,0 +1,163 @@
+"""Fault-injection spec parsing for the chaos harness.
+
+``HVDTPU_CHAOS`` arms AT MOST ONE one-shot fault inside the native data
+plane (``hvdtpu_set_chaos``; fired by ``DataPlane::MaybeChaos*`` in
+``native/data_plane.cpp``). The grammar lives here — the native side only
+sees resolved integers — and is deliberately tiny::
+
+    [rank<R>:]<action>[=<arg>]@<trigger>
+
+    action   kill            raise(SIGKILL): abrupt rank death
+             hang            wedge the collective thread forever (live
+                             but silent — only PEER deadlines catch it)
+             delay=<ms>      one-shot sleep (must NOT trip detection)
+             drop[=<peer>]   blackhole one lane: silent partition, no
+                             EOF (default: the triggering hop's peer,
+                             or the ring neighbor on an op trigger)
+    trigger  op=<N>          the N-th allreduce this rank STARTS (1-based)
+             hop=<N>         the N-th pairwise exchange this rank runs
+                             (1-based, counted across every phase —
+                             segmented ring hops, recursive-doubling
+                             rounds, tree edges, hier leader phases and
+                             compressed hops alike, so a randomized hop
+                             index lands anywhere in the schedule)
+
+    rank<R>: arms the fault only on the process whose global rank is R
+             (no prefix = every process arms it — sensible only with
+             ``delay``).
+
+Examples::
+
+    HVDTPU_CHAOS="rank1:kill@op=3"       # SIGKILL rank 1 at its 3rd allreduce
+    HVDTPU_CHAOS="rank2:hang@hop=7"      # wedge rank 2 mid-schedule
+    HVDTPU_CHAOS="rank1:drop@hop=4"      # partition one lane of rank 1
+    HVDTPU_CHAOS="delay=200@hop=5"       # 200 ms hiccup on every rank
+
+One-shot across elastic restarts: when ``HVDTPU_CHAOS_MARKER`` names a
+file (the launcher/test harness sets it), the spec arms only if the file
+does not exist yet and creates it at arm time — so the replacement worker
+that inherits the dead worker's rank after re-rendezvous does not re-arm
+the same fault and kill the world forever (docs/fault-tolerance.md).
+
+Reference analog: none — the reference's elastic tests inject failures at
+the Python loop boundary (``test/integration/elastic_common.py``); nothing
+there can kill a rank *mid-collective*, which is exactly the hard case.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional
+
+from .utils import envvars as ev
+
+# Mirrors hvdtpu::ChaosSpec::Action (native/data_plane.h); byte-for-byte
+# parity is enforced by scripts/check_invariants.py (ENUM-MIRROR).
+CHAOS_ACTIONS = {"none": 0, "kill": 1, "hang": 2, "delay": 3, "drop": 4}
+
+_SPEC_RE = re.compile(
+    r"^(?:rank(?P<rank>\d+):)?"
+    r"(?P<action>kill|hang|delay|drop)"
+    r"(?:=(?P<arg>\d+))?"
+    r"@(?P<trigger>op|hop)=(?P<index>\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """One resolved fault, ready for ``hvdtpu_set_chaos``."""
+    action: int          # CHAOS_ACTIONS code (never "none")
+    op_index: int = 0    # 0 = not gated on the allreduce counter
+    hop_index: int = 0   # 0 = not gated on the exchange counter
+    delay_ms: int = 0
+    peer: int = -1       # drop: lane to blackhole (-1 = triggering hop's)
+
+
+def parse_chaos(spec: str, rank: int) -> Optional[ChaosSpec]:
+    """Parse an ``HVDTPU_CHAOS`` value for the process with global ``rank``.
+
+    Returns None when the spec targets a different rank (or is empty);
+    raises ValueError, naming the knob, on anything malformed.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    m = _SPEC_RE.match(spec)
+    if m is None:
+        raise ValueError(
+            f"{ev.HVDTPU_CHAOS} must match "
+            f"'[rankR:]kill|hang|delay=<ms>|drop[=<peer>]@op=N|hop=N', "
+            f"got {spec!r}")
+    action = m.group("action")
+    arg = m.group("arg")
+    if action == "delay" and arg is None:
+        raise ValueError(
+            f"{ev.HVDTPU_CHAOS}: delay needs a duration, e.g. "
+            f"'delay=200@hop=5' (milliseconds)")
+    if action in ("kill", "hang") and arg is not None:
+        raise ValueError(
+            f"{ev.HVDTPU_CHAOS}: {action} takes no '=<arg>' (got {spec!r})")
+    index = int(m.group("index"))
+    if index <= 0:
+        raise ValueError(
+            f"{ev.HVDTPU_CHAOS}: op/hop indices are 1-based, got {index}")
+    if m.group("rank") is not None and int(m.group("rank")) != rank:
+        return None
+    return ChaosSpec(
+        action=CHAOS_ACTIONS[action],
+        op_index=index if m.group("trigger") == "op" else 0,
+        hop_index=index if m.group("trigger") == "hop" else 0,
+        delay_ms=int(arg) if action == "delay" else 0,
+        peer=int(arg) if (action == "drop" and arg is not None) else -1)
+
+
+def _claim_marker_kv(marker: str, rank: int) -> Optional[bool]:
+    """Claim the one-shot through the rendezvous KV when this is an elastic
+    worker: the marker must be visible on EVERY host — after re-rendezvous
+    the replacement worker can land on a different machine, where a
+    launcher-local marker file does not exist and a file-based one-shot
+    would re-arm the fault each epoch. Get-then-put suffices: armings are
+    separated by a full detection + re-rendezvous round, never concurrent.
+    Returns None when no KV is reachable (fall back to the file marker)."""
+    addr = ev.get_str(ev.HVDTPU_RENDEZVOUS_ADDR)
+    if not addr:
+        return None
+    try:
+        from .runner.http_kv import KVStoreClient
+        client = KVStoreClient(addr, ev.get_int(ev.HVDTPU_RENDEZVOUS_PORT, 0),
+                               secret=ev.get_str(ev.HVDTPU_SECRET) or None)
+        key = "/chaos/marker/" + os.path.basename(marker)
+        if client.get(key):
+            return False
+        client.put(key, f"armed rank={rank} "
+                        f"spec={ev.get_str(ev.HVDTPU_CHAOS)}\n".encode())
+        return True
+    except Exception:
+        return None
+
+
+def armed_chaos(rank: int) -> Optional[ChaosSpec]:
+    """The fault this process should arm at init, honoring the one-shot
+    marker: with ``HVDTPU_CHAOS_MARKER`` set, the first process to arm the
+    spec claims the marker — through the rendezvous KV under elastic (so
+    the claim spans hosts), else a local marker file — and every later
+    init (the respawned worker inheriting the dead rank after elastic
+    re-rendezvous) sees it and stays clean."""
+    spec = parse_chaos(ev.get_str(ev.HVDTPU_CHAOS, "") or "", rank)
+    if spec is None:
+        return None
+    marker = ev.get_str(ev.HVDTPU_CHAOS_MARKER)
+    if marker:
+        claimed = _claim_marker_kv(marker, rank)
+        if claimed is not None:
+            return spec if claimed else None
+        try:
+            # O_CREAT|O_EXCL: exactly one arming per marker, race-free even
+            # when two ranks match (no-prefix specs).
+            fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            return None
+        with os.fdopen(fd, "w") as f:
+            f.write(f"armed rank={rank} spec={ev.get_str(ev.HVDTPU_CHAOS)}\n")
+    return spec

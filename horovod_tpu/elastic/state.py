@@ -317,13 +317,33 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
                     return func(state, *args, **kwargs)
                 except HvdTpuInternalError:
                     log.warning("elastic: internal error — restoring last commit")
+                    # Stamp the detection for recovery-latency accounting
+                    # (hvdtpu_recovery_seconds, observed after re-init) and
+                    # hint the driver so re-rendezvous starts NOW instead of
+                    # at the next discovery poll.
+                    runtime.note_failure_detected()
                     notification_manager.post_failure_hint()
                     state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:
                     log.info("elastic: hosts updated — resetting")
                     skip_sync = e.skip_sync
-                reset()
+                # Re-initialization can itself fail over: a peer dying DURING
+                # re-rendezvous severs form-up (native Start fails with
+                # HvdTpuInternalError). That is a new failure episode, not a
+                # fatal error — hint the driver and retry with the next
+                # epoch; a wedged rendezvous is bounded by the elastic
+                # timeout inside the assignment poll (TimeoutError aborts).
+                while True:
+                    try:
+                        reset()
+                        break
+                    except HvdTpuInternalError as exc:
+                        log.warning("elastic: re-initialization failed (%s); "
+                                    "retrying rendezvous", exc)
+                        runtime.note_failure_detected()
+                        notification_manager.post_failure_hint()
+                        skip_sync = False
                 state.on_reset()
         finally:
             notification_manager.remove_listener(state)

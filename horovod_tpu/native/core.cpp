@@ -244,8 +244,24 @@ struct CoreConfig {
   // rejected. Empty = auth disabled (un-launched / single-host debugging).
   std::string secret;
   // Reference HOROVOD_STALL_SHUTDOWN_TIME: after this long stalled, break
-  // the world instead of hanging forever. 0 disables (reference default).
-  double stall_shutdown_secs = 0.0;
+  // the world instead of hanging forever. The reference defaults this to 0
+  // (disabled), which left the escalation dead code in practice; here the
+  // default is AUTO (< 0): 10x the warning threshold, so a wedged world
+  // always breaks eventually. 0 still disables explicitly.
+  double stall_shutdown_secs = -1.0;
+  // Failure detection (docs/fault-tolerance.md): HVDTPU_FAILURE_DETECT_MS
+  // bounds how long a peer death can go unnoticed on a blocked transport
+  // op, HVDTPU_FORMUP_TIMEOUT_SECONDS bounds rendezvous/mesh form-up.
+  int64_t failure_detect_ms = 500;
+  double formup_timeout_secs = 60.0;
+  // Transport-level no-progress deadline (HVDTPU_READ_DEADLINE_SECONDS):
+  // a lane that is open but moves ZERO bytes for this long mid-collective
+  // is declared dead — the only way to catch a hung-but-alive peer or a
+  // silent partition (no EOF ever arrives). 0 disables. Progress resets
+  // the clock, so long transfers on slow links are safe.
+  double read_deadline_secs = 10.0;
+  // Armed fault injection (HVDTPU_CHAOS -> hvdtpu_set_chaos), NONE normally.
+  ChaosSpec chaos;
   int64_t cache_capacity = 1024;  // reference HOROVOD_CACHE_CAPACITY
   // Autotune (reference HOROVOD_AUTOTUNE_* knobs, operations.cc:474-532).
   bool autotune = false;
@@ -346,6 +362,29 @@ class Core {
                              WireCompression comp) EXCLUDES(mu_);
   void CompleteEntry(TensorEntry* e, const Status& st) EXCLUDES(mu_);
   void CheckStalls();
+  // Effective stall-shutdown window: AUTO (< 0) resolves to 10x the warning
+  // threshold so the escalation is never silently dead; 0 disables.
+  double EffectiveStallShutdownSecs() const {
+    return cfg_.stall_shutdown_secs < 0 ? 10.0 * cfg_.stall_warn_secs
+                                        : cfg_.stall_shutdown_secs;
+  }
+  // A data-plane op failed with the plane aborted: a peer died (or tripped
+  // its liveness deadline) mid-collective. Count it, make sure every lane
+  // is broken (the cascade that unblocks the rest of the world), and fail
+  // over so elastic mode can catch HvdTpuInternalError and re-rendezvous.
+  void HandleDataPlaneFailure(const Status& st) EXCLUDES(mu_);
+
+ public:
+  // Elastic recovery accounting (C API hvdtpu_observe_recovery): the Python
+  // runtime measures detection -> successful re-initialization and records
+  // it against the NEW core's registry, so hvd.metrics() after a recovery
+  // shows both the failure count and the recovery latency.
+  void ObserveRecovery(double secs) {
+    if (m_recovery_seconds_ != nullptr) m_recovery_seconds_->Observe(secs);
+    if (m_failures_detected_ != nullptr) m_failures_detected_->Inc();
+  }
+
+ private:
   // Effective wire compression for one negotiated allreduce: the configured
   // (or autotuned) mode, gated on dtype fp32, op SUM/AVERAGE, total payload
   // >= compression_min_bytes, and no tensor name matching the skip regex.
@@ -433,6 +472,9 @@ class Core {
   std::thread background_;
   std::atomic<bool> shutdown_{false};
   std::atomic<bool> world_broken_{false};
+  // Worker-side failover latch (set by HandleDataPlaneFailure, consumed at
+  // the top of the next background cycle — see the deferral note there).
+  std::atomic<bool> worker_failover_pending_{false};
   bool started_ = false;
 
   // Response cache (see RequestCache above). Worker role uses req/enabled;
@@ -477,6 +519,12 @@ class Core {
   Histogram* m_fusion_utilization_ = nullptr;
   Counter* m_fused_tensors_ = nullptr;
   Counter* m_op_errors_ = nullptr;
+  Counter* m_failures_detected_ = nullptr;
+  Histogram* m_recovery_seconds_ = nullptr;
+  // One failure-cascade count per core incarnation: after the plane aborts,
+  // every queued op fails with the same coherent status — only the first
+  // detection is a new failure (background thread only).
+  bool failure_counted_ = false;
 };
 
 void Core::RequestTimeline(bool start, const std::string& path,
@@ -624,6 +672,24 @@ Status Core::Start() {
       "Tensors that rode a multi-tensor fused allreduce batch");
   m_op_errors_ = metrics_.GetCounter(
       "hvdtpu_op_errors_total", "Collectives that completed with an error");
+  m_failures_detected_ = metrics_.GetCounter(
+      "hvdtpu_failures_detected_total",
+      "Peer failures detected by this rank (data-plane lane death, "
+      "liveness-deadline trips, worker disconnects with ops pending)");
+  m_recovery_seconds_ = metrics_.GetHistogram(
+      "hvdtpu_recovery_seconds",
+      "Failure-detection to successful re-initialization latency, observed "
+      "by the elastic runtime after each recovery", LatencyBuckets());
+
+  // Failure detection + fault injection (docs/fault-tolerance.md): slices
+  // bound abort-propagation latency on every lane, the read deadline
+  // catches hung-but-alive peers, the form-up timeout bounds mesh
+  // establishment, and any armed chaos spec rides into the data plane.
+  data_plane_.set_failure_detect_ms(cfg_.failure_detect_ms);
+  data_plane_.set_read_deadline_secs(cfg_.read_deadline_secs);
+  data_plane_.set_formup_timeout_ms(
+      static_cast<int64_t>(cfg_.formup_timeout_secs * 1000.0));
+  data_plane_.set_chaos(cfg_.chaos);
 
   data_plane_.set_allreduce_algo(
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
@@ -693,10 +759,21 @@ Status Core::Start() {
         CloseFd(fd);
         return ++rejects <= 1000;
       };
+      const int formup_ms =
+          std::max(1, static_cast<int>(cfg_.formup_timeout_secs * 1000.0));
       while (pending > 0) {
-        int fd = TcpAccept(coord_listen_fd_);
+        // Form-up deadline: a worker that died (or never launched) between
+        // spawn and HELLO must not wedge rendezvous forever (the elastic
+        // driver retries with a fresh epoch on this failure).
+        int fd = TcpAcceptTimeout(coord_listen_fd_, formup_ms);
         if (fd < 0) {
-          return Status::Error(StatusCode::ABORTED, "coordinator: accept failed");
+          return Status::Error(
+              StatusCode::ABORTED,
+              errno == ETIMEDOUT
+                  ? "coordinator: rendezvous timed out waiting for " +
+                        std::to_string(pending) +
+                        " worker(s) (HVDTPU_FORMUP_TIMEOUT_SECONDS)"
+                  : "coordinator: accept failed");
         }
         if (authed && !Readable(fd, 10000)) {
           if (reject(fd, "no HELLO within 10s")) continue;
@@ -755,7 +832,9 @@ Status Core::Start() {
         }
       }
     } else {
-      control_fd_ = TcpConnectRetry(cfg_.coord_host, cfg_.coord_port, 60000);
+      control_fd_ = TcpConnectRetry(
+          cfg_.coord_host, cfg_.coord_port,
+          std::max(1, static_cast<int>(cfg_.formup_timeout_secs * 1000.0)));
       if (control_fd_ < 0) {
         return Status::Error(StatusCode::ABORTED,
                              "worker: cannot reach coordinator at " +
@@ -1004,6 +1083,18 @@ void Core::WaitForWork() {
 
 void Core::BackgroundLoop() {
   while (!shutdown_) {
+    if (worker_failover_pending_.exchange(false)) {
+      // A data-plane failure was detected last cycle; the entry walk that
+      // detected it has fully unwound, so failing every outstanding handle
+      // (and waking user threads) is safe now.
+      FailAllOutstanding("a peer process failed during a collective");
+      {
+        MutexLock lk(mu_);
+        shutdown_ = true;
+      }
+      cv_.NotifyAll();
+      break;
+    }
     WaitForWork();
     if (shutdown_) break;
     ApplyTimelineRequest();
@@ -1227,6 +1318,7 @@ void Core::CoordinatorIngest() {
           }
           if (!message_table_.empty() || have_outstanding) {
             LogWarn(0, "worker rank %d disconnected with ops pending", rank);
+            m_failures_detected_->Inc();
             world_broken_ = true;
           }
           // Even with nothing in flight, the rank is gone for good (unless it
@@ -1840,6 +1932,7 @@ void Core::ExecuteResponse(const Response& resp) {
               data_plane_.transport_label(), false, "none", resp.dtype,
               st.ok());
   }
+  if (!st.ok() && data_plane_.aborted()) HandleDataPlaneFailure(st);
 
   for (auto* e : entries) {
     timeline_.ActivityEnd(e->name);
@@ -2025,6 +2118,7 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
               data_plane_.last_algo_label(), data_plane_.transport_label(),
               data_plane_.hier_active(), WireCompressionName(comp),
               resp.dtype, st.ok());
+    if (!st.ok() && data_plane_.aborted()) HandleDataPlaneFailure(st);
     if (st.ok()) {
       ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->postscale);
     }
@@ -2064,6 +2158,7 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
             data_plane_.last_algo_label(), data_plane_.transport_label(),
             data_plane_.hier_active(), WireCompressionName(comp), resp.dtype,
             st.ok());
+  if (!st.ok() && data_plane_.aborted()) HandleDataPlaneFailure(st);
 
   off = 0;
   for (size_t i = 0; i < entries.size(); ++i) {
@@ -2080,6 +2175,40 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     timeline_.ActivityEnd(e->name);
     timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason, op_raw, op_wire);
     if (e->handle >= 0) CompleteEntry(e, st);
+  }
+}
+
+void Core::HandleDataPlaneFailure(const Status& st) {
+  if (!failure_counted_) {
+    failure_counted_ = true;
+    m_failures_detected_->Inc();
+    const int peer = data_plane_.failed_peer();
+    LogWarn(cfg_.rank,
+            "data-plane failure detected%s: %s",
+            peer >= 0 ? (" (suspect rank " + std::to_string(peer) + ")")
+                            .c_str()
+                      : "",
+            st.reason.c_str());
+    if (peer >= 0 && cfg_.rank == 0) dead_ranks_.insert(peer);
+  }
+  // Make sure EVERY lane is broken (idempotent): the half-closed sockets
+  // and woken futex waiters are how detection cascades rank-to-rank within
+  // one detect slice per hop, even to ranks idling between collectives.
+  data_plane_.Abort();
+  if (cfg_.rank == 0) {
+    // Consumed by the next CoordinatorEmitResponses: broadcast SHUTDOWN to
+    // every surviving worker, fail local handles, stop the loop.
+    world_broken_ = true;
+  } else {
+    // Worker: fail over so the user thread raises HvdTpuInternalError and
+    // elastic mode can re-rendezvous. DEFERRED to the top of the next
+    // background cycle (like rank 0's world_broken_): failing the
+    // outstanding handles HERE would wake the user thread while the caller
+    // (ExecuteResponse) is still walking this response's entries — and a
+    // woken waiter may CopyResult and free them mid-walk. The coordinator
+    // learns of the failure through its own data plane (it participates in
+    // the same collective) or the control-plane EOF.
+    worker_failover_pending_ = true;
   }
 }
 
@@ -2100,14 +2229,18 @@ void Core::CheckStalls() {
     }
   }
   m_stalled_->Set(any_stalled ? 1 : 0);
+  // AUTO (< 0) resolves to 10x the warning threshold so the escalation is
+  // never dead code: a wedged world always breaks eventually instead of
+  // hanging until an operator notices (the reference defaults this OFF).
+  const double shutdown_secs = EffectiveStallShutdownSecs();
   for (auto& kv : message_table_) {
     auto& slot = kv.second;
-    if (cfg_.stall_shutdown_secs > 0 &&
-        now - slot.first_seen > cfg_.stall_shutdown_secs) {
+    if (shutdown_secs > 0 && now - slot.first_seen > shutdown_secs) {
       LogWarn(0,
               "tensor '%s' stalled for over %.0f s "
               "(HVDTPU_STALL_SHUTDOWN_TIME_SECONDS); aborting the job",
-              kv.first.c_str(), cfg_.stall_shutdown_secs);
+              kv.first.c_str(), shutdown_secs);
+      m_failures_detected_->Inc();
       world_broken_ = true;
       return;
     }
@@ -2292,6 +2425,53 @@ int hvdtpu_set_transport(void* core, int shm_enabled,
 
 int hvdtpu_set_stall_shutdown(void* core, double secs) {
   static_cast<Core*>(core)->mutable_config()->stall_shutdown_secs = secs;
+  return 0;
+}
+
+// Failure-detection knobs (docs/fault-tolerance.md). detect_ms bounds how
+// long peer death can go unnoticed on a blocked transport op (poll slice =
+// detect_ms/5, clamped); read_deadline_secs declares an open-but-silent
+// lane dead after that long with zero progress (0 disables — the only way
+// to catch a hung-but-alive peer or a blackholed route); formup_secs
+// bounds rendezvous + data-plane mesh establishment. Values <= 0 keep the
+// defaults (except read_deadline_secs, where 0 disables). Pre-Start() only.
+int hvdtpu_set_failure_detection(void* core, long long detect_ms,
+                                 double read_deadline_secs,
+                                 double formup_secs) {
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  if (detect_ms > 0) cfg->failure_detect_ms = detect_ms;
+  if (read_deadline_secs >= 0) cfg->read_deadline_secs = read_deadline_secs;
+  if (formup_secs > 0) cfg->formup_timeout_secs = formup_secs;
+  return 0;
+}
+
+// Arm one fault injection (HVDTPU_CHAOS -> horovod_tpu/chaos.py; the spec
+// grammar lives in Python, the native side sees resolved integers). action:
+// 0 none, 1 kill, 2 hang, 3 delay, 4 drop. Fires once, at the op_index-th
+// allreduce this rank starts or the hop_index-th pairwise exchange it runs
+// (1-based; 0 = not gated on that counter). Pre-Start() only.
+int hvdtpu_set_chaos(void* core, int action, long long op_index,
+                     long long hop_index, long long delay_ms, int peer) {
+  if (action < 0 || action > 4) return -1;
+  if (action != 0 && op_index <= 0 && hop_index <= 0) return -1;
+  hvdtpu::ChaosSpec spec;
+  spec.action = static_cast<hvdtpu::ChaosSpec::Action>(action);
+  spec.op_index = op_index;
+  spec.hop_index = hop_index;
+  spec.delay_ms = delay_ms;
+  spec.peer = peer;
+  static_cast<Core*>(core)->mutable_config()->chaos = spec;
+  return 0;
+}
+
+// Elastic recovery accounting: the Python runtime measures failure
+// detection -> successful re-init and records it against the NEW core
+// (hvdtpu_recovery_seconds + hvdtpu_failures_detected_total), so a
+// post-recovery hvd.metrics() shows the whole episode. Post-Start() only
+// (the registry handles resolve in Start).
+int hvdtpu_observe_recovery(void* core, double secs) {
+  if (secs < 0) return -1;
+  static_cast<Core*>(core)->ObserveRecovery(secs);
   return 0;
 }
 

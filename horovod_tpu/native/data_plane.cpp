@@ -5,7 +5,9 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <cmath>
+#include <csignal>
 #include <cstdio>
 #include <cstring>
 #include <thread>
@@ -393,26 +395,37 @@ Status DataPlane::Connect(const std::vector<PeerAddr>& peers) {
   // are already listening), accept from higher ranks. Rank is identified by a
   // 4-byte hello.
   for (int peer = 0; peer < rank_; ++peer) {
-    int fd = TcpConnectRetry(peers[peer].host, peers[peer].port, 30000);
+    int fd = TcpConnectRetry(peers[peer].host, peers[peer].port,
+                             static_cast<int>(formup_timeout_ms_));
     if (fd < 0) {
       return Status::Error(StatusCode::ABORTED,
                            "data plane: connect to rank " +
                                std::to_string(peer) + " failed");
     }
     int32_t me = rank_;
-    if (SendAll(fd, &me, sizeof(me)) != 0) {
+    if (SendAll(fd, &me, sizeof(me), &io_ctl_) != 0) {
       CloseFd(fd);
       return Status::Error(StatusCode::ABORTED, "data plane: hello failed");
     }
     fds_[peer] = fd;
   }
   for (int expected = 0; expected < size_ - rank_ - 1; ++expected) {
-    int fd = TcpAccept(listen_fd_);
+    // Deadline-bounded: a higher rank that died between rendezvous and its
+    // data-plane connect must not wedge form-up forever.
+    int fd = TcpAcceptTimeout(listen_fd_,
+                              static_cast<int>(formup_timeout_ms_));
     if (fd < 0) {
-      return Status::Error(StatusCode::ABORTED, "data plane: accept failed");
+      return Status::Error(StatusCode::ABORTED,
+                           "data plane: accept failed (peer missing within "
+                           "the form-up timeout?)");
     }
+    // Interruptible: a peer whose route blackholes between its connect and
+    // its 4-byte hello must trip the no-progress deadline, not wedge
+    // form-up forever (HVDTPU_FORMUP_TIMEOUT_SECONDS bounds the accept
+    // above; the IoControl deadline bounds the read here).
     int32_t who = -1;
-    if (RecvAll(fd, &who, sizeof(who)) != 0 || who <= rank_ || who >= size_) {
+    if (RecvAll(fd, &who, sizeof(who), &io_ctl_) != 0 ||
+        who <= rank_ || who >= size_) {
       CloseFd(fd);
       return Status::Error(StatusCode::ABORTED, "data plane: bad hello");
     }
@@ -463,7 +476,7 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
     if (peer == rank_) continue;
     if (peers[peer].host != peers[rank_].host) {
       transports_[peer].reset(
-          new TcpTransport(fds_[peer], inline_max_bytes_));
+          new TcpTransport(fds_[peer], inline_max_bytes_, &io_ctl_));
       continue;
     }
     // Same host: negotiate a shared-memory lane over the pair's socket so
@@ -486,14 +499,14 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
                                       : 0);
       }
       ok = shm != nullptr ? 1 : 0;
-      if (SendAll(fds_[peer], &ok, 1) != 0 ||
-          RecvAll(fds_[peer], &peer_ok, 1) != 0) {
+      if (SendAll(fds_[peer], &ok, 1, &io_ctl_) != 0 ||
+          RecvAll(fds_[peer], &peer_ok, 1, &io_ctl_) != 0) {
         return Status::Error(StatusCode::ABORTED,
                              "data plane: shm handshake with rank " +
                                  std::to_string(peer) + " failed");
       }
     } else {
-      if (RecvAll(fds_[peer], &peer_ok, 1) != 0) {
+      if (RecvAll(fds_[peer], &peer_ok, 1, &io_ctl_) != 0) {
         return Status::Error(StatusCode::ABORTED,
                              "data plane: shm handshake with rank " +
                                  std::to_string(peer) + " failed");
@@ -502,7 +515,7 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
         shm = ShmTransport::Open(name, /*timeout_ms=*/10000);
       }
       ok = shm != nullptr ? 1 : 0;
-      if (SendAll(fds_[peer], &ok, 1) != 0) {
+      if (SendAll(fds_[peer], &ok, 1, &io_ctl_) != 0) {
         return Status::Error(StatusCode::ABORTED,
                              "data plane: shm handshake with rank " +
                                  std::to_string(peer) + " failed");
@@ -515,6 +528,7 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
       // A SIGKILLed peer can't flip the shared abort flag; the lane polls
       // the pair's (otherwise idle) socket for EOF while waiting instead.
       shm->set_liveness_fd(fds_[peer]);
+      shm->set_control(&io_ctl_);
       transports_[peer] = std::move(shm);
     } else {
       shm.reset();  // creator side aborts + unlinks in the destructor
@@ -525,7 +539,7 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
                 rank_, peer);
       }
       transports_[peer].reset(
-          new TcpTransport(fds_[peer], inline_max_bytes_));
+          new TcpTransport(fds_[peer], inline_max_bytes_, &io_ctl_));
     }
   }
   // Cache the lane summary: the mix is invariant from here on, and the
@@ -559,12 +573,157 @@ void DataPlane::Shutdown() {
   listen_fd_ = -1;
 }
 
+void DataPlane::Abort() {
+  io_ctl_.aborted.store(1, std::memory_order_release);
+  for (auto& t : transports_) {
+    if (t != nullptr) t->Abort();  // shm: flag + futex wake; tcp: no-op
+  }
+  // Half-close (not close: fds stay owned until Shutdown) so a peer blocked
+  // mid-transfer sees EOF at once and cascades its own abort.
+  for (int fd : fds_) {
+    if (fd >= 0) shutdown(fd, SHUT_RDWR);
+  }
+}
+
+Status DataPlane::FailLane(int peer, const char* what) {
+  if (failed_peer_ < 0) failed_peer_ = peer;
+  io_ctl_.MarkPeerFailed();
+  Abort();
+  return Status::Error(StatusCode::ABORTED,
+                       "data plane: " + std::string(what) + " with rank " +
+                           std::to_string(peer) +
+                           " failed (peer death or liveness deadline)");
+}
+
+void DataPlane::MaybeChaosOp() {
+  if (chaos_.action == ChaosSpec::Action::NONE || chaos_.op_index <= 0) {
+    return;
+  }
+  if (++chaos_ops_ == chaos_.op_index) FireChaos(/*peer_hint=*/-1);
+}
+
+void DataPlane::MaybeChaosHop(int send_peer, int recv_peer) {
+  if (chaos_.action == ChaosSpec::Action::NONE || chaos_.hop_index <= 0) {
+    return;
+  }
+  if (++chaos_hops_ == chaos_.hop_index) {
+    FireChaos(recv_peer >= 0 ? recv_peer : send_peer);
+  }
+}
+
+void DataPlane::FireChaos(int peer_hint) {
+  const ChaosSpec::Action action = chaos_.action;
+  chaos_.action = ChaosSpec::Action::NONE;  // one-shot
+  switch (action) {
+    case ChaosSpec::Action::KILL:
+      fprintf(stderr, "[hvdtpu %d] CHAOS: SIGKILL (op %lld, hop %lld)\n",
+              rank_, static_cast<long long>(chaos_ops_),
+              static_cast<long long>(chaos_hops_));
+      raise(SIGKILL);
+      return;  // unreachable
+    case ChaosSpec::Action::HANG:
+      fprintf(stderr, "[hvdtpu %d] CHAOS: hanging the collective thread "
+                      "(op %lld, hop %lld)\n",
+              rank_, static_cast<long long>(chaos_ops_),
+              static_cast<long long>(chaos_hops_));
+      // Wedged on purpose, ignoring every abort signal: this simulates a
+      // livelocked rank, which only the PEERS' deadlines can detect.
+      for (;;) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+    case ChaosSpec::Action::DELAY:
+      fprintf(stderr, "[hvdtpu %d] CHAOS: delaying %lld ms\n", rank_,
+              static_cast<long long>(chaos_.delay_ms));
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(chaos_.delay_ms));
+      return;
+    case ChaosSpec::Action::DROP: {
+      // An op trigger has no hop peer yet (peer_hint == -1): blackhole the
+      // ring neighbor so `drop@op=N` injects a real partition instead of
+      // consuming the one-shot as a silent no-op.
+      int victim = chaos_.peer >= 0 ? chaos_.peer : peer_hint;
+      if (victim < 0 || victim == rank_ || victim >= size_) {
+        victim = (rank_ + 1) % size_;
+      }
+      blackholed_peer_ = victim;
+      fprintf(stderr, "[hvdtpu %d] CHAOS: blackholing lane to rank %d\n",
+              rank_, blackholed_peer_);
+      return;
+    }
+    case ChaosSpec::Action::NONE:
+      return;
+  }
+}
+
+Status DataPlane::BlackholeWait(int peer) {
+  // A dropped lane is SILENT: no bytes move and no EOF ever arrives, like a
+  // switch eating the flow. The op parks here until the plane aborts (a
+  // peer detected the partition) or our own read deadline declares the
+  // lane dead.
+  const double t0 = MonoSeconds();
+  for (;;) {
+    if (io_ctl_.is_aborted()) {
+      return Status::Error(StatusCode::ABORTED,
+                           "data plane: aborted during a blackholed "
+                           "exchange with rank " + std::to_string(peer));
+    }
+    const double now = MonoSeconds();
+    if (io_ctl_.read_deadline_secs > 0 &&
+        now - t0 > io_ctl_.read_deadline_secs) {
+      return FailLane(peer, "blackholed exchange");
+    }
+    std::this_thread::sleep_for(
+        std::chrono::milliseconds(io_ctl_.detect_slice_ms));
+  }
+}
+
+Status DataPlane::SendTo(int peer, const void* buf, int64_t bytes,
+                         const char* what) {
+  MaybeChaosHop(peer, -1);
+  if (io_ctl_.is_aborted()) {
+    return Status::Error(StatusCode::ABORTED,
+                         "data plane: aborted after a peer failure");
+  }
+  if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
+    return BlackholeWait(peer);
+  }
+  if (bytes > 0 &&
+      transports_[peer]->Send(buf, static_cast<size_t>(bytes)) != 0) {
+    return FailLane(peer, what);
+  }
+  return Status::OK();
+}
+
+Status DataPlane::RecvFrom(int peer, void* buf, int64_t bytes,
+                           const char* what) {
+  MaybeChaosHop(-1, peer);
+  if (io_ctl_.is_aborted()) {
+    return Status::Error(StatusCode::ABORTED,
+                         "data plane: aborted after a peer failure");
+  }
+  if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
+    return BlackholeWait(peer);
+  }
+  if (bytes > 0 &&
+      transports_[peer]->Recv(buf, static_cast<size_t>(bytes)) != 0) {
+    return FailLane(peer, what);
+  }
+  return Status::OK();
+}
+
 Status DataPlane::Exchange(int send_peer, const void* send_buf,
                            int64_t send_bytes, int recv_peer, void* recv_buf,
                            int64_t recv_bytes, int64_t segment_bytes,
                            const SegmentFn& on_segment) {
-  const Status fail =
-      Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
+  MaybeChaosHop(send_peer, recv_peer);
+  if (io_ctl_.is_aborted()) {
+    return Status::Error(StatusCode::ABORTED,
+                         "data plane: aborted after a peer failure");
+  }
+  if (blackholed_peer_ >= 0 && (send_peer == blackholed_peer_ ||
+                                recv_peer == blackholed_peer_)) {
+    return BlackholeWait(blackholed_peer_);
+  }
   const size_t seg =
       segment_bytes > 0 ? static_cast<size_t>(segment_bytes) : 0;
   if (send_peer == recv_peer) {
@@ -573,7 +732,7 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
     if (transports_[send_peer]->SendRecv(
             send_buf, static_cast<size_t>(send_bytes), recv_buf,
             static_cast<size_t>(recv_bytes), seg, on_segment) != 0) {
-      return fail;
+      return FailLane(send_peer, "exchange");
     }
     return Status::OK();
   }
@@ -593,9 +752,9 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
     // inline send-then-recv skips the per-call sender thread.
     if (send_bytes > 0 &&
         ts->Send(send_buf, static_cast<size_t>(send_bytes)) != 0) {
-      return fail;
+      return FailLane(send_peer, "send");
     }
-    if (recv_side() != 0) return fail;
+    if (recv_side() != 0) return FailLane(recv_peer, "receive");
     return Status::OK();
   }
   int send_rc = 0;
@@ -603,7 +762,8 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
       [&] { send_rc = ts->Send(send_buf, static_cast<size_t>(send_bytes)); });
   int recv_rc = recv_side();
   sender.join();
-  if (send_rc != 0 || recv_rc != 0) return fail;
+  if (send_rc != 0) return FailLane(send_peer, "send");
+  if (recv_rc != 0) return FailLane(recv_peer, "receive");
   return Status::OK();
 }
 
@@ -635,6 +795,7 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   op_wire_bytes_ = 0;
   last_algo_label_ = "none";
   if (size_ == 1 || count == 0) return Status::OK();
+  MaybeChaosOp();
   Status st;
   if (hier_active()) {
     st = HierarchicalAllreduce(data, count, dtype, op);
@@ -789,15 +950,11 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
   if (gi >= p) {
     WireCompress(c, data, count, send_wire.data(), op_residual_, nullptr);
     AddOpBytes(raw_bytes, wb);
-    if (transports_[group[gi - p]]->Send(send_wire.data(),
-                                         static_cast<size_t>(wb)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "rd fold send failed");
-    }
+    Status st = SendTo(group[gi - p], send_wire.data(), wb, "rd fold send");
+    if (!st.ok()) return st;
   } else if (gi < r) {
-    if (transports_[group[gi + p]]->Recv(recv_wire.data(),
-                                         static_cast<size_t>(wb)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "rd fold recv failed");
-    }
+    Status st = RecvFrom(group[gi + p], recv_wire.data(), wb, "rd fold recv");
+    if (!st.ok()) return st;
     WireDecompressAdd(c, recv_wire.data(), count, data);
   }
 
@@ -819,17 +976,11 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
   // main group's bytes (one uncompressed hop, non-power-of-two worlds only).
   if (gi < r) {
     AddOpBytes(raw_bytes, raw_bytes);
-    if (transports_[group[gi + p]]->Send(data,
-                                         static_cast<size_t>(raw_bytes)) !=
-        0) {
-      return Status::Error(StatusCode::ABORTED, "rd unfold send failed");
-    }
+    Status st = SendTo(group[gi + p], data, raw_bytes, "rd unfold send");
+    if (!st.ok()) return st;
   } else if (gi >= p) {
-    if (transports_[group[gi - p]]->Recv(data,
-                                         static_cast<size_t>(raw_bytes)) !=
-        0) {
-      return Status::Error(StatusCode::ABORTED, "rd unfold recv failed");
-    }
+    Status st = RecvFrom(group[gi - p], data, raw_bytes, "rd unfold recv");
+    if (!st.ok()) return st;
   }
   return Status::OK();
 }
@@ -940,15 +1091,11 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
 
   if (gi >= p) {
     AddOpBytes(bytes, bytes);
-    if (transports_[group[gi - p]]->Send(data, static_cast<size_t>(bytes)) !=
-        0) {
-      return Status::Error(StatusCode::ABORTED, "rd fold send failed");
-    }
+    Status st = SendTo(group[gi - p], data, bytes, "rd fold send");
+    if (!st.ok()) return st;
   } else if (gi < r) {
-    if (transports_[group[gi + p]]->Recv(other.data(),
-                                         static_cast<size_t>(bytes)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "rd fold recv failed");
-    }
+    Status st = RecvFrom(group[gi + p], other.data(), bytes, "rd fold recv");
+    if (!st.ok()) return st;
     ReduceBuffer(data, other.data(), count, dtype, op);
   }
 
@@ -964,15 +1111,11 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
 
   if (gi < r) {
     AddOpBytes(bytes, bytes);
-    if (transports_[group[gi + p]]->Send(data, static_cast<size_t>(bytes)) !=
-        0) {
-      return Status::Error(StatusCode::ABORTED, "rd unfold send failed");
-    }
+    Status st = SendTo(group[gi + p], data, bytes, "rd unfold send");
+    if (!st.ok()) return st;
   } else if (gi >= p) {
-    if (transports_[group[gi - p]]->Recv(data, static_cast<size_t>(bytes)) !=
-        0) {
-      return Status::Error(StatusCode::ABORTED, "rd unfold recv failed");
-    }
+    Status st = RecvFrom(group[gi - p], data, bytes, "rd unfold recv");
+    if (!st.ok()) return st;
   }
   return Status::OK();
 }
@@ -991,17 +1134,14 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
   for (int d = 1; d < gs; d <<= 1) {
     if (gi & d) {
       AddOpBytes(bytes, bytes);
-      if (transports_[group[gi - d]]->Send(data, static_cast<size_t>(bytes)) !=
-          0) {
-        return Status::Error(StatusCode::ABORTED, "tree reduce send failed");
-      }
+      Status st = SendTo(group[gi - d], data, bytes, "tree reduce send");
+      if (!st.ok()) return st;
       break;
     }
     if (gi + d < gs) {
-      if (transports_[group[gi + d]]->Recv(other.data(),
-                                           static_cast<size_t>(bytes)) != 0) {
-        return Status::Error(StatusCode::ABORTED, "tree reduce recv failed");
-      }
+      Status st =
+          RecvFrom(group[gi + d], other.data(), bytes, "tree reduce recv");
+      if (!st.ok()) return st;
       ReduceBuffer(data, other.data(), count, dtype, op);
     }
   }
@@ -1013,18 +1153,14 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
   while (top < gs) top <<= 1;
   int lsb = gi == 0 ? top : (gi & -gi);
   if (gi != 0) {
-    if (transports_[group[gi - lsb]]->Recv(data, static_cast<size_t>(bytes)) !=
-        0) {
-      return Status::Error(StatusCode::ABORTED, "tree bcast recv failed");
-    }
+    Status st = RecvFrom(group[gi - lsb], data, bytes, "tree bcast recv");
+    if (!st.ok()) return st;
   }
   for (int d = lsb >> 1; d >= 1; d >>= 1) {
     if (gi + d < gs) {
       AddOpBytes(bytes, bytes);
-      if (transports_[group[gi + d]]->Send(data, static_cast<size_t>(bytes)) !=
-          0) {
-        return Status::Error(StatusCode::ABORTED, "tree bcast send failed");
-      }
+      Status st = SendTo(group[gi + d], data, bytes, "tree bcast send");
+      if (!st.ok()) return st;
     }
   }
   return Status::OK();
@@ -1047,8 +1183,6 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
   const size_t elem = DataTypeSize(dtype);
   uint8_t* buf = static_cast<uint8_t*>(data);
   const bool cross = leaders_.size() > 1;
-  const Status fail =
-      Status::Error(StatusCode::ABORTED, "data plane: transfer failed");
 
   std::vector<int64_t> starts = ChunkStarts(count, L);
   auto chunk_ptr = [&](int c) { return buf + starts[c] * elem; };
@@ -1067,20 +1201,16 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
       if (li == 0) {
         for (int j = 1; j < L; ++j) {
           int c = owned(j);
-          if (chunk_bytes(c) > 0 &&
-              transports_[local[j]]->Recv(
-                  chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
-            return fail;
-          }
+          Status st = RecvFrom(local[j], chunk_ptr(c), chunk_bytes(c),
+                               "hier leader gather");
+          if (!st.ok()) return st;
         }
       } else {
         int c = owned(li);
         AddOpBytes(chunk_bytes(c), chunk_bytes(c));
-        if (chunk_bytes(c) > 0 &&
-            transports_[local[0]]->Send(
-                chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
-          return fail;
-        }
+        Status st = SendTo(local[0], chunk_ptr(c), chunk_bytes(c),
+                           "hier leader gather");
+        if (!st.ok()) return st;
       }
     }
     if (li == 0) {
@@ -1095,19 +1225,15 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
         for (int j = 1; j < L; ++j) {
           int c = owned(j);
           AddOpBytes(chunk_bytes(c), chunk_bytes(c));
-          if (chunk_bytes(c) > 0 &&
-              transports_[local[j]]->Send(
-                  chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
-            return fail;
-          }
+          Status st = SendTo(local[j], chunk_ptr(c), chunk_bytes(c),
+                             "hier leader scatter");
+          if (!st.ok()) return st;
         }
       } else {
         int c = owned(li);
-        if (chunk_bytes(c) > 0 &&
-            transports_[local[0]]->Recv(
-                chunk_ptr(c), static_cast<size_t>(chunk_bytes(c))) != 0) {
-          return fail;
-        }
+        Status st = RecvFrom(local[0], chunk_ptr(c), chunk_bytes(c),
+                             "hier leader scatter");
+        if (!st.ok()) return st;
       }
     }
   }
@@ -1143,14 +1269,12 @@ Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
   if (rank_ == root) {
     for (int r = 0; r < size_; ++r) {
       if (r == rank_) continue;
-      if (transports_[r]->Send(data, static_cast<size_t>(bytes)) != 0) {
-        return Status::Error(StatusCode::ABORTED, "broadcast send failed");
-      }
+      Status st = SendTo(r, data, bytes, "broadcast send");
+      if (!st.ok()) return st;
     }
   } else {
-    if (transports_[root]->Recv(data, static_cast<size_t>(bytes)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "broadcast recv failed");
-    }
+    Status st = RecvFrom(root, data, bytes, "broadcast recv");
+    if (!st.ok()) return st;
   }
   return Status::OK();
 }
@@ -1217,6 +1341,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
                              std::string(DataTypeName(dtype)));
   }
   if (size_ == 1 || count == 0) return Status::OK();
+  MaybeChaosOp();
   const size_t elem = DataTypeSize(dtype);
   const int64_t bytes = count * static_cast<int64_t>(elem);
   std::vector<uint8_t> other(static_cast<size_t>(bytes));
@@ -1243,14 +1368,11 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   // Fold extra ranks (>= p) into their partner by plain addition.
   if (rank_ >= p) {
     AddOpBytes(bytes, bytes);
-    if (transports_[rank_ - p]->Send(data, static_cast<size_t>(bytes)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "adasum fold send failed");
-    }
+    Status st = SendTo(rank_ - p, data, bytes, "adasum fold send");
+    if (!st.ok()) return st;
   } else if (rank_ < r) {
-    if (transports_[rank_ + p]->Recv(other.data(),
-                                     static_cast<size_t>(bytes)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "adasum fold recv failed");
-    }
+    Status st = RecvFrom(rank_ + p, other.data(), bytes, "adasum fold recv");
+    if (!st.ok()) return st;
     if (dtype == DataType::FLOAT32) {
       AddInto(static_cast<float*>(data),
               reinterpret_cast<const float*>(other.data()), count);
@@ -1272,13 +1394,11 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   // Broadcast the result to the folded ranks.
   if (rank_ < r) {
     AddOpBytes(bytes, bytes);
-    if (transports_[rank_ + p]->Send(data, static_cast<size_t>(bytes)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "adasum unfold send failed");
-    }
+    Status st = SendTo(rank_ + p, data, bytes, "adasum unfold send");
+    if (!st.ok()) return st;
   } else if (rank_ >= p) {
-    if (transports_[rank_ - p]->Recv(data, static_cast<size_t>(bytes)) != 0) {
-      return Status::Error(StatusCode::ABORTED, "adasum unfold recv failed");
-    }
+    Status st = RecvFrom(rank_ - p, data, bytes, "adasum unfold recv");
+    if (!st.ok()) return st;
   }
   raw_bytes_total_->Add(op_raw_bytes_);
   wire_bytes_total_->Add(op_wire_bytes_);

@@ -60,6 +60,29 @@ enum class HierMode : int32_t {
   AUTO = 2,
 };
 
+// Fault injection (chaos harness; docs/fault-tolerance.md): at most one
+// action armed per process via HVDTPU_CHAOS -> hvdtpu_set_chaos. Fires once,
+// at the op_index-th allreduce this rank starts or the hop_index-th pairwise
+// exchange it runs (1-based; exchanges count across every phase — segmented
+// ring hops, recursive-doubling rounds, tree edges, hier leader phases and
+// compressed hops alike, so a randomized hop lands anywhere in the
+// schedule). Python owns the spec grammar (horovod_tpu/chaos.py); the native
+// side only sees resolved integers.
+struct ChaosSpec {
+  enum class Action : int32_t {
+    NONE = 0,
+    KILL = 1,   // raise(SIGKILL): abrupt rank death mid-schedule
+    HANG = 2,   // wedge the collective thread forever (live but silent)
+    DELAY = 3,  // one-shot sleep of delay_ms (must NOT trip detection)
+    DROP = 4,   // blackhole one peer lane (partition: silent, no EOF)
+  };
+  Action action = Action::NONE;
+  int64_t op_index = 0;   // 0 = not op-gated
+  int64_t hop_index = 0;  // 0 = not hop-gated
+  int64_t delay_ms = 0;
+  int peer = -1;  // DROP: lane to blackhole (-1 = the triggering hop's peer)
+};
+
 // Concurrency contract (checked indirectly by `make analyze`: this type
 // holds no mutex on purpose): a DataPlane is driven by exactly ONE thread —
 // the core's background loop (plus the Python host thread during
@@ -83,6 +106,37 @@ class DataPlane {
   Status Connect(const std::vector<PeerAddr>& peers);
 
   void Shutdown();
+
+  // Break every lane NOW: flips the shared IoControl abort flag (sliced
+  // reads observe it within one detect slice), aborts the shm segments
+  // (futex waiters wake), and half-closes every TCP lane so blocked peers
+  // see EOF — which is how failure detection cascades rank-to-rank across
+  // the world within ~one detect slice per hop. Idempotent. Must run on the
+  // collective-driving thread (same single-driver rule as the collectives;
+  // cross-thread callers have the IoControl flags).
+  void Abort();
+  bool aborted() const { return io_ctl_.is_aborted(); }
+  // First peer a lane failure was pinned on (-1 when none): names the
+  // suspect in logs and the coordinator's dead-ranks accounting.
+  int failed_peer() const { return failed_peer_; }
+
+  // Fault-detection knobs (docs/fault-tolerance.md), set before Start's
+  // Connect. detect_ms bounds abort-propagation latency (poll slice =
+  // detect_ms/5, clamped to [5, 100] ms); read_deadline_secs > 0 declares a
+  // silent-but-open lane dead after that long with zero progress (0 = off);
+  // formup_timeout_ms bounds Connect's accept phase.
+  void set_failure_detect_ms(int64_t ms) {
+    if (ms <= 0) return;
+    int64_t slice = ms / 5;
+    io_ctl_.detect_slice_ms = slice < 5 ? 5 : (slice > 100 ? 100 : slice);
+  }
+  void set_read_deadline_secs(double s) {
+    io_ctl_.read_deadline_secs = s > 0 ? s : 0;
+  }
+  void set_formup_timeout_ms(int64_t ms) {
+    if (ms > 0) formup_timeout_ms_ = ms;
+  }
+  void set_chaos(const ChaosSpec& spec) { chaos_ = spec; }
 
   // In-place allreduce over `count` elements (SUM/MIN/MAX/PRODUCT; AVERAGE
   // is SUM + caller-side postscale, reference operations.cc:928). Dispatches
@@ -204,6 +258,25 @@ class DataPlane {
                   int64_t segment_bytes = 0,
                   const SegmentFn& on_segment = nullptr);
 
+  // Record a lane failure against `peer`, abort the plane, and return the
+  // coherent "peer failure" status every subsequent op also gets.
+  Status FailLane(int peer, const char* what);
+  // One-directional hops with the same fault machinery as Exchange (chaos
+  // hop counting, abort fast-fail, blackhole, FailLane attribution): the
+  // tree edges, recursive-doubling fold/unfold links, hier leader
+  // gather/scatter and broadcast fan-out all ride these, so the abort path
+  // threads through EVERY schedule shape, not just the ring.
+  Status SendTo(int peer, const void* buf, int64_t bytes, const char* what);
+  Status RecvFrom(int peer, void* buf, int64_t bytes, const char* what);
+  // Chaos triggers: counted at allreduce entry / every Exchange. MaybeChaos*
+  // fire the armed action when its index is reached (FireChaos may not
+  // return: KILL/HANG). BlackholeWait parks an exchange against a dropped
+  // lane until the plane aborts or the read deadline declares it dead.
+  void MaybeChaosOp();
+  void MaybeChaosHop(int send_peer, int recv_peer);
+  void FireChaos(int peer_hint);
+  Status BlackholeWait(int peer);
+
   // Negotiate the per-pair lane (shm for same-host peers when both sides
   // set it up, TCP otherwise) over the freshly established socket mesh.
   Status SetupTransports(const std::vector<PeerAddr>& peers);
@@ -299,6 +372,17 @@ class DataPlane {
   // without a deadlock risk; measured against the mesh's socket buffer
   // sizes in Connect(). 0 (pre-Connect) = always use the concurrent path.
   int64_t inline_max_bytes_ = 0;
+
+  // Fault detection + injection state. io_ctl_ is shared with every lane
+  // (its atomics are the only cross-thread members here); the rest is
+  // driven by the collective thread only, like the members above.
+  IoControl io_ctl_;
+  int64_t formup_timeout_ms_ = 60000;
+  int failed_peer_ = -1;
+  ChaosSpec chaos_;
+  int64_t chaos_ops_ = 0;
+  int64_t chaos_hops_ = 0;
+  int blackholed_peer_ = -1;
 
   // Per-op wire compression state (background thread only) + payload
   // accounting (cumulative totals live in the metrics registry, readable

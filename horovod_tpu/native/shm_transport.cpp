@@ -240,7 +240,30 @@ bool ShmTransport::PeerDead() {
       return false;
     }
   }
+  if (ctl_ != nullptr) ctl_->MarkPeerFailed();  // break the WHOLE plane
   Abort();  // wake our own other-direction waiters too
+  return true;
+}
+
+bool ShmTransport::AbortedNow() const {
+  return seg_->aborted.load(std::memory_order_acquire) != 0 ||
+         (ctl_ != nullptr && ctl_->is_aborted());
+}
+
+int ShmTransport::WaitSliceMs() const {
+  if (ctl_ == nullptr) return kWaitSliceMs;
+  int64_t s = ctl_->detect_slice_ms;
+  return static_cast<int>(s < 1 ? 1 : (s > kWaitSliceMs ? kWaitSliceMs : s));
+}
+
+bool ShmTransport::DeadlineExpired(double last_progress) {
+  if (ctl_ == nullptr || ctl_->read_deadline_secs <= 0) return false;
+  if (MonoSeconds() - last_progress <= ctl_->read_deadline_secs) return false;
+  // Peer alive (no EOF on the liveness socket) but the ring hasn't moved
+  // past the deadline: a hung peer. Fail the plane instead of waiting out
+  // the coordinator's (possibly never-running) stall inspector.
+  ctl_->MarkPeerFailed();
+  Abort();
   return true;
 }
 
@@ -249,7 +272,7 @@ void ShmTransport::WaitOutboundSpace() {
   uint64_t head = r.head.load(std::memory_order_relaxed);
   for (int i = 0; i < kSpinIters; ++i) {
     if (r.tail.load(std::memory_order_acquire) + ring_bytes_ != head ||
-        seg_->aborted.load(std::memory_order_acquire) != 0) {
+        AbortedNow()) {
       return;
     }
   }
@@ -257,8 +280,8 @@ void ShmTransport::WaitOutboundSpace() {
   uint32_t seq = r.tail_seq.load(std::memory_order_seq_cst);
   r.tail_waiters.fetch_add(1, std::memory_order_seq_cst);
   if (r.tail.load(std::memory_order_seq_cst) + ring_bytes_ == head &&
-      seg_->aborted.load(std::memory_order_acquire) == 0) {
-    FutexWait(&r.tail_seq, seq, kWaitSliceMs);
+      !AbortedNow()) {
+    FutexWait(&r.tail_seq, seq, WaitSliceMs());
   }
   r.tail_waiters.fetch_sub(1, std::memory_order_seq_cst);
 }
@@ -268,7 +291,7 @@ void ShmTransport::WaitInboundData() {
   uint64_t tail = r.tail.load(std::memory_order_relaxed);
   for (int i = 0; i < kSpinIters; ++i) {
     if (r.head.load(std::memory_order_acquire) != tail ||
-        seg_->aborted.load(std::memory_order_acquire) != 0) {
+        AbortedNow()) {
       return;
     }
   }
@@ -276,8 +299,8 @@ void ShmTransport::WaitInboundData() {
   uint32_t seq = r.head_seq.load(std::memory_order_seq_cst);
   r.head_waiters.fetch_add(1, std::memory_order_seq_cst);
   if (r.head.load(std::memory_order_seq_cst) == tail &&
-      seg_->aborted.load(std::memory_order_acquire) == 0) {
-    FutexWait(&r.head_seq, seq, kWaitSliceMs);
+      !AbortedNow()) {
+    FutexWait(&r.head_seq, seq, WaitSliceMs());
   }
   r.head_waiters.fetch_sub(1, std::memory_order_seq_cst);
 }
@@ -285,13 +308,16 @@ void ShmTransport::WaitInboundData() {
 int ShmTransport::Send(const void* buf, size_t len) {
   const uint8_t* p = static_cast<const uint8_t*>(buf);
   size_t done = 0;
+  double last_progress = MonoSeconds();
   while (done < len) {
-    if (seg_->aborted.load(std::memory_order_acquire) != 0) return -1;
+    if (AbortedNow()) return -1;
     size_t n = TrySend(p + done, len - done);
     if (n == 0) {
+      if (DeadlineExpired(last_progress)) return -1;
       WaitOutboundSpace();
     } else {
       done += n;
+      last_progress = MonoSeconds();
     }
   }
   return 0;
@@ -306,14 +332,17 @@ int ShmTransport::RecvSegmented(void* buf, size_t len, size_t segment_bytes,
   uint8_t* p = static_cast<uint8_t*>(buf);
   if (segment_bytes == 0 || segment_bytes > len) segment_bytes = len;
   size_t done = 0, cb_done = 0;
+  double last_progress = MonoSeconds();
   while (done < len) {
-    if (seg_->aborted.load(std::memory_order_acquire) != 0) return -1;
+    if (AbortedNow()) return -1;
     size_t n = TryRecv(p + done, len - done);
     if (n == 0) {
+      if (DeadlineExpired(last_progress)) return -1;
       WaitInboundData();
       continue;
     }
     done += n;
+    last_progress = MonoSeconds();
     // Fire full segments as they complete; the producer keeps filling the
     // ring while the callback (reduction) runs — the overlap is inherent.
     while (on_segment && done - cb_done >= segment_bytes && cb_done < len) {
@@ -335,8 +364,9 @@ int ShmTransport::SendRecv(const void* send_buf, size_t send_bytes,
     segment_bytes = recv_bytes;
   }
   size_t sent = 0, rcvd = 0, cb_done = 0;
+  double last_progress = MonoSeconds();
   while (sent < send_bytes || rcvd < recv_bytes) {
-    if (seg_->aborted.load(std::memory_order_acquire) != 0) return -1;
+    if (AbortedNow()) return -1;
     bool progress = false;
     if (sent < send_bytes) {
       size_t n = TrySend(sp + sent, send_bytes - sent);
@@ -356,6 +386,7 @@ int ShmTransport::SendRecv(const void* send_buf, size_t send_bytes,
       progress = true;
     }
     if (!progress) {
+      if (DeadlineExpired(last_progress)) return -1;
       // Both directions stuck: park on whichever cursor unblocks us
       // (inbound data if we still expect bytes, else outbound space). The
       // peer's pump advances the other direction independently.
@@ -364,6 +395,8 @@ int ShmTransport::SendRecv(const void* send_buf, size_t send_bytes,
       } else {
         WaitOutboundSpace();
       }
+    } else {
+      last_progress = MonoSeconds();
     }
   }
   if (on_segment && cb_done < recv_bytes) {

@@ -71,12 +71,20 @@ class ShmTransport : public Transport {
   // Mark the segment aborted and wake both sides; any blocked ring op
   // (either process) returns -1. Called from DataPlane::Shutdown so a dying
   // rank releases its same-host peers.
-  void Abort();
+  void Abort() override;
   // Peer-liveness probe: a SIGKILLed peer can never flip the abort flag, so
   // the wait loops also watch this (otherwise idle) socket to the peer and
-  // abort on EOF. Optional; without it a dead peer blocks until the caller
-  // tears the plane down.
+  // abort on EOF — checked every wait slice, so a killed peer wakes a
+  // blocked waiter within one slice. Optional; without it a dead peer
+  // blocks until the caller tears the plane down.
   void set_liveness_fd(int fd) { liveness_fd_ = fd; }
+  // Shared fault-detection block (socket_util.h IoControl): wait slices
+  // shrink to its detect_slice_ms, a plane-wide abort breaks blocked ring
+  // ops within one slice, peer death (liveness EOF) marks the whole plane
+  // failed, and read_deadline_secs bounds a zero-progress op against a
+  // hung-but-alive peer. Optional (standalone/unit-test use keeps the
+  // segment-local abort flag only).
+  void set_control(IoControl* ctl) { ctl_ = ctl; }
   // Drop the name from the shm namespace (creator side, once the opener
   // confirmed attach over the socket handshake): an abnormal death after
   // this point leaks nothing. Idempotent.
@@ -98,6 +106,14 @@ class ShmTransport : public Transport {
   void WaitInboundData();
   // True (and segment aborted) when the liveness socket reports EOF.
   bool PeerDead();
+  // True when any abort source fired (segment flag or plane-wide control).
+  bool AbortedNow() const;
+  // Wait slice in ms (control's detect slice, else the built-in default).
+  int WaitSliceMs() const;
+  // No-progress deadline check for a blocked op; marks the peer failed and
+  // aborts the segment when breached. `last_progress` is a monotonic-seconds
+  // stamp of the op's latest byte movement.
+  bool DeadlineExpired(double last_progress);
 
   std::string name_;
   Segment* seg_ = nullptr;
@@ -106,6 +122,7 @@ class ShmTransport : public Transport {
   bool creator_ = false;
   bool unlinked_ = false;
   int liveness_fd_ = -1;
+  IoControl* ctl_ = nullptr;
   int out_ring_ = 0;  // rings[out_ring_] is my producer side
   uint8_t* out_data_ = nullptr;
   uint8_t* in_data_ = nullptr;

@@ -52,6 +52,14 @@ int TcpAccept(int listen_fd) {
   return fd;
 }
 
+int TcpAcceptTimeout(int listen_fd, int timeout_ms) {
+  if (!Readable(listen_fd, timeout_ms)) {
+    errno = ETIMEDOUT;
+    return -1;
+  }
+  return TcpAccept(listen_fd);
+}
+
 int TcpConnectRetry(const std::string& host, int port, int timeout_ms) {
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -84,34 +92,95 @@ int TcpConnectRetry(const std::string& host, int port, int timeout_ms) {
   }
 }
 
-int SendAll(int fd, const void* buf, size_t len) {
-  const char* p = static_cast<const char*>(buf);
-  while (len > 0) {
-    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
-    if (n < 0) {
-      if (errno == EINTR) continue;
-      return -1;
-    }
-    p += n;
-    len -= static_cast<size_t>(n);
+namespace {
+
+int CtlSliceMs(const IoControl* ctl) {
+  int64_t s = ctl->detect_slice_ms;
+  return static_cast<int>(s < 1 ? 1 : (s > 1000 ? 1000 : s));
+}
+
+// One sliced poll while a controlled transfer makes no progress. Returns -1
+// (transfer must fail) on plane abort, observed peer death (POLLERR/POLLHUP
+// with nothing left to read / POLLOUT side errors), or the no-progress
+// deadline; 0 to retry the I/O.
+int CtlWait(int fd, short events, IoControl* ctl, double last_progress) {
+  if (ctl->is_aborted()) {
+    errno = ECANCELED;
+    return -1;
+  }
+  pollfd pfd{fd, events, 0};
+  int rc = poll(&pfd, 1, CtlSliceMs(ctl));
+  if (rc > 0 && (pfd.revents & (POLLERR | POLLNVAL)) != 0) {
+    ctl->MarkPeerFailed();
+    errno = ECONNRESET;
+    return -1;
+  }
+  if (rc > 0 && (pfd.revents & POLLHUP) != 0 &&
+      (pfd.revents & POLLIN) == 0) {
+    // Hangup with no readable residue: the peer is gone. (POLLIN|POLLHUP
+    // still drains buffered bytes; recv() == 0 catches the EOF after.)
+    ctl->MarkPeerFailed();
+    errno = ECONNRESET;
+    return -1;
+  }
+  if (ctl->read_deadline_secs > 0 &&
+      MonoSeconds() - last_progress > ctl->read_deadline_secs) {
+    // Socket alive but silent past the deadline: a hung peer. Declare it
+    // dead rather than blocking the world forever (the transport-level
+    // analog of the coordinator's stall shutdown).
+    ctl->MarkPeerFailed();
+    errno = ETIMEDOUT;
+    return -1;
   }
   return 0;
 }
 
-int RecvAll(int fd, void* buf, size_t len) {
-  char* p = static_cast<char*>(buf);
+}  // namespace
+
+int SendAll(int fd, const void* buf, size_t len, IoControl* ctl) {
+  const char* p = static_cast<const char*>(buf);
+  double last_progress = ctl != nullptr ? MonoSeconds() : 0;
   while (len > 0) {
-    ssize_t n = recv(fd, p, len, 0);
+    ssize_t n = send(fd, p, len,
+                     MSG_NOSIGNAL | (ctl != nullptr ? MSG_DONTWAIT : 0));
     if (n < 0) {
       if (errno == EINTR) continue;
+      if (ctl != nullptr && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (CtlWait(fd, POLLOUT, ctl, last_progress) != 0) return -1;
+        continue;
+      }
+      if (ctl != nullptr) ctl->MarkPeerFailed();  // EPIPE/ECONNRESET/...
+      return -1;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+    if (ctl != nullptr && n > 0) last_progress = MonoSeconds();
+  }
+  return 0;
+}
+
+int RecvAll(int fd, void* buf, size_t len, IoControl* ctl) {
+  char* p = static_cast<char*>(buf);
+  double last_progress = ctl != nullptr ? MonoSeconds() : 0;
+  while (len > 0) {
+    ssize_t n = recv(fd, p, len, ctl != nullptr ? MSG_DONTWAIT : 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (ctl != nullptr && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (CtlWait(fd, POLLIN, ctl, last_progress) != 0) return -1;
+        continue;
+      }
+      if (ctl != nullptr) ctl->MarkPeerFailed();
       return -1;
     }
     if (n == 0) {
+      if (ctl != nullptr) ctl->MarkPeerFailed();
       errno = ECONNRESET;
       return -1;  // peer closed
     }
     p += n;
     len -= static_cast<size_t>(n);
+    if (ctl != nullptr) last_progress = MonoSeconds();
   }
   return 0;
 }
@@ -119,18 +188,19 @@ int RecvAll(int fd, void* buf, size_t len) {
 int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
                       int recv_fd, void* recv_buf, size_t recv_bytes,
                       size_t segment_bytes,
-                      const std::function<void(size_t, size_t)>& on_segment) {
+                      const std::function<void(size_t, size_t)>& on_segment,
+                      IoControl* ctl) {
   if (segment_bytes == 0 || segment_bytes > recv_bytes) {
     segment_bytes = recv_bytes;
   }
   int send_rc = 0;
   std::thread sender([&] {
-    if (send_bytes > 0) send_rc = SendAll(send_fd, send_buf, send_bytes);
+    if (send_bytes > 0) send_rc = SendAll(send_fd, send_buf, send_bytes, ctl);
   });
   int recv_rc = 0;
   if (recv_bytes > 0) {
     if (!on_segment) {
-      recv_rc = RecvAll(recv_fd, recv_buf, recv_bytes);
+      recv_rc = RecvAll(recv_fd, recv_buf, recv_bytes, ctl);
     } else {
       // Receiver thread lands segments and publishes a high-water mark; the
       // calling thread consumes them (runs on_segment) as they arrive.
@@ -146,7 +216,7 @@ int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
         int rc = 0;
         while (off < recv_bytes) {
           size_t len = std::min(segment_bytes, recv_bytes - off);
-          rc = RecvAll(recv_fd, p + off, len);
+          rc = RecvAll(recv_fd, p + off, len, ctl);
           if (rc != 0) break;
           off += len;
           {

@@ -5,12 +5,46 @@
 // loopback/CPU data plane both ride these.
 #pragma once
 
+#include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <functional>
 #include <string>
 #include <vector>
 
 namespace hvdtpu {
+
+// Monotonic clock as seconds (progress/deadline bookkeeping across the
+// transports and the data plane).
+inline double MonoSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Shared fault-detection control block for one data plane's transports
+// (docs/fault-tolerance.md). Every blocking transport read/write that gets a
+// pointer to one becomes interruptible: it polls in `detect_slice_ms` slices
+// so a plane-wide abort is observed within one slice, fails fast on peer
+// death (EOF/RST/POLLHUP), and — when `read_deadline_secs` > 0 — declares a
+// peer dead after that long with zero progress (the transport-level stall
+// escalation; a hung-but-alive rank produces no EOF). The flags are relaxed
+// atomics any thread may read; writers use MarkPeerFailed/store-release.
+// The plain-int tuning fields are written before Connect only.
+struct IoControl {
+  std::atomic<uint32_t> aborted{0};      // plane-wide: fail every lane op
+  std::atomic<uint32_t> peer_failed{0};  // a lane observed peer death
+  int64_t detect_slice_ms = 100;         // poll slice (abort latency bound)
+  double read_deadline_secs = 0;         // 0 = no no-progress deadline
+
+  bool is_aborted() const {
+    return aborted.load(std::memory_order_acquire) != 0;
+  }
+  void MarkPeerFailed() {
+    peer_failed.store(1, std::memory_order_release);
+    aborted.store(1, std::memory_order_release);
+  }
+};
 
 // All functions return >= 0 on success, -1 on error (errno preserved).
 
@@ -21,13 +55,22 @@ int TcpListen(int port, int backlog, int* out_port);
 // Accept one connection (blocking). Returns connected fd.
 int TcpAccept(int listen_fd);
 
+// Accept with a deadline: -1 with errno ETIMEDOUT when no connection lands
+// within timeout_ms (bounds world form-up so a vanished peer cannot wedge
+// rendezvous forever; docs/fault-tolerance.md).
+int TcpAcceptTimeout(int listen_fd, int timeout_ms);
+
 // Connect to host:port, retrying for up to timeout_ms (covers peer startup
 // races during rendezvous). Returns connected fd.
 int TcpConnectRetry(const std::string& host, int port, int timeout_ms);
 
 // Exact-length send/recv (loop over partial transfers). 0 on success.
-int SendAll(int fd, const void* buf, size_t len);
-int RecvAll(int fd, void* buf, size_t len);
+// With a non-null `ctl` the loop becomes interruptible (see IoControl): the
+// hot path still issues one recv/send syscall per chunk (MSG_DONTWAIT), and
+// only an empty/full socket buffer drops to a sliced poll that watches the
+// abort flag, peer death, and the no-progress deadline.
+int SendAll(int fd, const void* buf, size_t len, IoControl* ctl = nullptr);
+int RecvAll(int fd, void* buf, size_t len, IoControl* ctl = nullptr);
 
 // Full-duplex segmented transfer: streams send_bytes out of send_fd while
 // receiving recv_bytes into recv_buf, invoking on_segment(offset, length) on
@@ -39,7 +82,8 @@ int RecvAll(int fd, void* buf, size_t len);
 int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
                       int recv_fd, void* recv_buf, size_t recv_bytes,
                       size_t segment_bytes,
-                      const std::function<void(size_t, size_t)>& on_segment);
+                      const std::function<void(size_t, size_t)>& on_segment,
+                      IoControl* ctl = nullptr);
 
 // Length-prefixed frame: [u64 length][payload].
 int SendFrame(int fd, const std::vector<uint8_t>& payload);

@@ -8,12 +8,12 @@ namespace hvdtpu {
 
 int TcpTransport::Send(const void* buf, size_t len) {
   if (len == 0) return 0;
-  return SendAll(fd_, buf, len);
+  return SendAll(fd_, buf, len, ctl_);
 }
 
 int TcpTransport::Recv(void* buf, size_t len) {
   if (len == 0) return 0;
-  return RecvAll(fd_, buf, len);
+  return RecvAll(fd_, buf, len, ctl_);
 }
 
 int TcpTransport::RecvSegmented(void* buf, size_t len, size_t segment_bytes,
@@ -21,18 +21,18 @@ int TcpTransport::RecvSegmented(void* buf, size_t len, size_t segment_bytes,
   if (len == 0) {
     return 0;
   }
-  if (!on_segment) return RecvAll(fd_, buf, len);
+  if (!on_segment) return RecvAll(fd_, buf, len, ctl_);
   if (segment_bytes == 0 || len < 2 * segment_bytes) {
     // One (or barely two) segments: background-receiver machinery buys
     // nothing; land the payload and run the callback once.
-    int rc = RecvAll(fd_, buf, len);
+    int rc = RecvAll(fd_, buf, len, ctl_);
     if (rc == 0) on_segment(0, len);
     return rc;
   }
   // Reuse the pipelined receiver (background thread lands segments, the
   // calling thread consumes them) with a zero-byte send side.
   return SendRecvSegmented(-1, nullptr, 0, fd_, buf, len, segment_bytes,
-                           on_segment);
+                           on_segment, ctl_);
 }
 
 int TcpTransport::SendRecv(const void* send_buf, size_t send_bytes,
@@ -40,22 +40,22 @@ int TcpTransport::SendRecv(const void* send_buf, size_t send_bytes,
                            size_t segment_bytes, const SegmentFn& on_segment) {
   if (on_segment && segment_bytes > 0 && recv_bytes >= 2 * segment_bytes) {
     return SendRecvSegmented(fd_, send_buf, send_bytes, fd_, recv_buf,
-                             recv_bytes, segment_bytes, on_segment);
+                             recv_bytes, segment_bytes, on_segment, ctl_);
   }
   int rc = 0;
   if (InlineSendSafe(send_bytes)) {
     // Payload fits the kernel socket buffers: blocking send then receive on
     // the calling thread — both peers sending first cannot deadlock, and
     // skipping the sender thread is the bulk of the small-message win.
-    if (send_bytes > 0) rc = SendAll(fd_, send_buf, send_bytes);
-    if (rc == 0 && recv_bytes > 0) rc = RecvAll(fd_, recv_buf, recv_bytes);
+    if (send_bytes > 0) rc = SendAll(fd_, send_buf, send_bytes, ctl_);
+    if (rc == 0 && recv_bytes > 0) rc = RecvAll(fd_, recv_buf, recv_bytes, ctl_);
   } else {
     int send_rc = 0;
     std::thread sender([&] {
-      if (send_bytes > 0) send_rc = SendAll(fd_, send_buf, send_bytes);
+      if (send_bytes > 0) send_rc = SendAll(fd_, send_buf, send_bytes, ctl_);
     });
     int recv_rc = 0;
-    if (recv_bytes > 0) recv_rc = RecvAll(fd_, recv_buf, recv_bytes);
+    if (recv_bytes > 0) recv_rc = RecvAll(fd_, recv_buf, recv_bytes, ctl_);
     sender.join();
     rc = (send_rc != 0 || recv_rc != 0) ? -1 : 0;
   }

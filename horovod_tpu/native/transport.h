@@ -14,6 +14,8 @@
 #include <cstdint>
 #include <functional>
 
+#include "socket_util.h"
+
 namespace hvdtpu {
 
 // In-order, disjoint completion callback for segmented receives:
@@ -48,14 +50,23 @@ class Transport {
   // blocking receive with no deadlock risk, skipping the sender thread that
   // dominates small-message latency.
   virtual bool InlineSendSafe(size_t bytes) const = 0;
+
+  // Break any blocked op on this lane (world abort / peer failure). The TCP
+  // lane needs nothing here — DataPlane::Abort shuts the socket down and the
+  // sliced reads observe the shared IoControl; the shm lane overrides to
+  // flip its cross-process abort flag and wake futex waiters.
+  virtual void Abort() {}
 };
 
 // The PR-1 socket path behind the interface. Does NOT own the fd (the
-// DataPlane's mesh teardown closes it).
+// DataPlane's mesh teardown closes it). With a non-null `ctl` every
+// blocking read/write is interruptible: sliced polls observe the plane
+// abort flag, peer death fails within one slice, and a silent-but-open
+// socket trips the no-progress deadline (docs/fault-tolerance.md).
 class TcpTransport : public Transport {
  public:
-  TcpTransport(int fd, int64_t inline_max_bytes)
-      : fd_(fd), inline_max_(inline_max_bytes) {}
+  TcpTransport(int fd, int64_t inline_max_bytes, IoControl* ctl = nullptr)
+      : fd_(fd), inline_max_(inline_max_bytes), ctl_(ctl) {}
 
   const char* kind() const override { return "tcp"; }
   int Send(const void* buf, size_t len) override;
@@ -72,6 +83,7 @@ class TcpTransport : public Transport {
  private:
   int fd_;
   int64_t inline_max_;
+  IoControl* ctl_;  // nullable; shared with the owning DataPlane
 };
 
 }  // namespace hvdtpu

@@ -8,7 +8,9 @@
 // Built and run by `make check` (tests/test_sanitizers.py-style integration
 // lives in tests/test_native_features.py; this binary needs no Python).
 
+#include <signal.h>
 #include <sys/socket.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -451,6 +453,162 @@ void TestShmAbortCleanup() {
     close(fd);
     shm_unlink(name.c_str());
   }
+}
+
+void TestShmKilledPeerWakesWaiter() {
+  // The killed-peer fixture (docs/fault-tolerance.md): a SIGKILLed peer can
+  // never flip the shared abort flag, so a blocked ring op must be woken by
+  // the liveness probe — the (otherwise idle) pair socket EOFs when the
+  // peer process dies, checked every wait slice. Fork a REAL peer process,
+  // kill it -9 mid-wait, and require the waiter to fail over within a few
+  // slices instead of hanging until teardown.
+  const std::string name = "/hvdtpu_test_kill_" + std::to_string(getpid());
+  int live[2];  // stands in for the pair's TCP socket (liveness probe)
+  int sync[2];  // child -> parent "attached" signal, NOT the liveness lane
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, live) == 0);
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sync) == 0);
+  auto a = ShmTransport::Create(name, 4096);
+  CHECK_TRUE(a != nullptr);
+  if (a == nullptr) return;
+  pid_t child = fork();
+  if (child == 0) {
+    // Child: attach as the peer, confirm, then wedge until SIGKILLed. No
+    // CHECKs here — the parent judges us by waitpid status. Keep live[1]
+    // OPEN: its kernel-side close at SIGKILL is the death signal.
+    close(live[0]);
+    close(sync[0]);
+    auto b = ShmTransport::Open(name, 2000);
+    if (b == nullptr) _exit(1);
+    char ok = 'k';
+    if (write(sync[1], &ok, 1) != 1) _exit(2);
+    for (;;) pause();
+  }
+  close(live[1]);
+  close(sync[1]);
+  CHECK_TRUE(child > 0);
+  char attached = 0;
+  CHECK_TRUE(read(sync[0], &attached, 1) == 1 && attached == 'k');
+  close(sync[0]);
+  // Small detect slice via the shared control block, like the data plane.
+  IoControl ctl;
+  ctl.detect_slice_ms = 50;
+  a->set_liveness_fd(live[0]);
+  a->set_control(&ctl);
+  uint8_t byte;
+  std::atomic<int> recv_rc{0};
+  std::thread consumer([&] { recv_rc = a->Recv(&byte, 1); });
+  // Let the waiter pass the spin phase into the sliced futex wait.
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  auto t0 = std::chrono::steady_clock::now();
+  CHECK_TRUE(kill(child, SIGKILL) == 0);
+  consumer.join();
+  double waited = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  CHECK_TRUE(recv_rc == -1);  // woke with an error, did not hang
+  // "Within one timeout slice" + generous CI scheduling slack.
+  CHECK_TRUE(waited < 2.0);
+  // Peer death must break the WHOLE plane, not just this lane.
+  CHECK_TRUE(ctl.peer_failed.load() != 0 && ctl.is_aborted());
+  int status = 0;
+  CHECK_TRUE(waitpid(child, &status, 0) == child);
+  CHECK_TRUE(WIFSIGNALED(status) && WTERMSIG(status) == SIGKILL);
+  close(live[0]);
+}
+
+// --- interruptible socket I/O (IoControl) -----------------------------------
+
+void TestIoControlRecvFailsFastOnPeerClose() {
+  // A controlled RecvAll against a peer that closes mid-wait fails within a
+  // poll slice (EOF/POLLHUP), marking the whole plane failed.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  std::atomic<int> rc{0};
+  std::thread reader([&] {
+    uint8_t buf[16];
+    rc = RecvAll(sv[0], buf, sizeof(buf), &ctl);
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  auto t0 = std::chrono::steady_clock::now();
+  close(sv[1]);
+  reader.join();
+  double waited = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  CHECK_TRUE(rc == -1);
+  CHECK_TRUE(waited < 2.0);
+  CHECK_TRUE(ctl.peer_failed.load() != 0 && ctl.is_aborted());
+  close(sv[0]);
+}
+
+void TestIoControlAbortBreaksBlockedRecv() {
+  // A plane-wide abort (flag flip by ANY thread) breaks a blocked read
+  // within one slice — this is how one lane's failure cascades to ops
+  // blocked on perfectly healthy lanes.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  std::atomic<int> rc{0};
+  std::thread reader([&] {
+    uint8_t buf[4];
+    rc = RecvAll(sv[0], buf, sizeof(buf), &ctl);
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  ctl.aborted.store(1);
+  reader.join();
+  CHECK_TRUE(rc == -1);
+  CHECK_TRUE(ctl.peer_failed.load() == 0);  // abort, not a peer verdict
+  close(sv[0]);
+  close(sv[1]);
+}
+
+void TestIoControlReadDeadlineTripsOnSilentPeer() {
+  // An open-but-silent lane (hung peer / blackholed route: no bytes, no
+  // EOF) trips the no-progress deadline instead of blocking forever.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  ctl.read_deadline_secs = 0.15;
+  uint8_t buf[4];
+  auto t0 = std::chrono::steady_clock::now();
+  int rc = RecvAll(sv[0], buf, sizeof(buf), &ctl);
+  double waited = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  CHECK_TRUE(rc == -1);
+  CHECK_TRUE(waited >= 0.15 && waited < 2.0);
+  CHECK_TRUE(ctl.peer_failed.load() != 0);  // declared dead, plane broken
+  close(sv[0]);
+  close(sv[1]);
+}
+
+void TestShmReadDeadlineTripsOnSilentPeer() {
+  // Same contract on the shm lane: a live segment whose ring never moves
+  // past the deadline fails over (the peer is attached but wedged — only a
+  // deadline can catch it; there is no EOF).
+  const std::string name = "/hvdtpu_test_dl_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, 4096);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(a != nullptr && b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  ctl.read_deadline_secs = 0.15;
+  a->set_control(&ctl);
+  uint8_t byte;
+  auto t0 = std::chrono::steady_clock::now();
+  int rc = a->Recv(&byte, 1);  // b never sends
+  double waited = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  CHECK_TRUE(rc == -1);
+  CHECK_TRUE(waited >= 0.15 && waited < 2.0);
+  CHECK_TRUE(ctl.peer_failed.load() != 0 && ctl.is_aborted());
 }
 
 // --- data-plane worlds ------------------------------------------------------
@@ -1238,6 +1396,11 @@ int main() {
   TestShmRingWraparound();
   TestShmDoorbellWakeup();
   TestShmAbortCleanup();
+  TestShmKilledPeerWakesWaiter();
+  TestIoControlRecvFailsFastOnPeerClose();
+  TestIoControlAbortBreaksBlockedRecv();
+  TestIoControlReadDeadlineTripsOnSilentPeer();
+  TestShmReadDeadlineTripsOnSilentPeer();
   TestDataPlaneAllreduceAlgos();
   TestDataPlaneHierarchicalAllreduce();
   TestWireQuantizerRoundTrip();

@@ -56,13 +56,35 @@ class ElasticSettings:
     # static launcher's --remote-python; local slots always use
     # sys.executable).
     remote_python: str = "python3"
+    # Failure-hint poll cadence (docs/fault-tolerance.md): workers post
+    # /rendezvous/hint the moment they detect a peer failure (sub-second
+    # native detection), so the driver polls it much faster than full host
+    # discovery — this is what makes re-formation sub-second end to end.
+    hint_poll_interval_s: float = 0.2
+    # Epoch-settle watchdog: once ANY worker has claimed the new epoch, a
+    # carried-over worker that stays unclaimed for this much longer is
+    # wedged inside the old world (healthy peers sit at the same commit
+    # boundary and claim together; a hung collective thread never will) —
+    # it is terminated and respawned. Without this, one hung rank holds
+    # its slot and livelocks every subsequent epoch; without the
+    # first-claim gate, a slow-committing but healthy world would get shot
+    # after a scale-up. Freshly spawned workers are exempt (interpreter +
+    # jax import can dwarf any sane window).
+    settle_timeout_s: float = 10.0
+    # Flap control: a worker identity respawned more than max_respawns times
+    # gets its host blacklisted instead of another retry, and each respawn
+    # backs off exponentially (base * 2^(n-1), capped at 8 s) so a
+    # crash-looping host cannot livelock the world.
+    max_respawns: int = 3
+    respawn_backoff_s: float = 0.5
 
 
 class ElasticDriver:
     """Supervises an elastic job (reference: ElasticDriver, driver.py:68)."""
 
     def __init__(self, discovery: HostDiscovery, settings: ElasticSettings,
-                 command: List[str], env: Dict[str, str], verbose: bool = False):
+                 command: List[str], env: Dict[str, str], verbose: bool = False,
+                 metrics_base: Optional[int] = None):
         self._host_manager = HostManager(discovery)
         self._settings = settings
         self._command = command
@@ -84,6 +106,21 @@ class ElasticDriver:
         self._shutdown = threading.Event()
         self._result: Optional[int] = None
         self._result_event = threading.Event()
+        # Fault-tolerance state (docs/fault-tolerance.md): per-identity
+        # respawn counts (flap control), the rank-0 metrics endpoint to
+        # watch for dead-rank signals, and the controller host of the
+        # current epoch (where rank 0's /metrics lives).
+        self._metrics_base = metrics_base
+        self._respawns: Dict[str, int] = {}
+        self._controller_host: Optional[str] = None
+        self._metrics_epoch_triggered = 0
+        self._last_rendezvous = 0.0
+        # Host set the LAST rendezvous was computed from: the discovery
+        # loop triggers only on a difference against this, so a blacklist
+        # applied by _watch (which re-rendezvouses itself) cannot ALSO look
+        # like a change to the loop — back-to-back epochs would split the
+        # workers across two controller ports and stall re-formation.
+        self._last_hosts: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -93,6 +130,9 @@ class ElasticDriver:
         self._discovery_thread = threading.Thread(target=self._discovery_loop,
                                                   daemon=True)
         self._discovery_thread.start()
+        if self._metrics_base:
+            threading.Thread(target=self._metrics_monitor_loop,
+                             daemon=True).start()
 
     def wait_for_completion(self) -> int:
         self._result_event.wait()
@@ -119,21 +159,74 @@ class ElasticDriver:
             f"timed out waiting for at least {self._settings.min_np} slots")
 
     def _discovery_loop(self) -> None:
-        while not self._shutdown.is_set():
-            time.sleep(self._settings.discovery_interval_s)
-            try:
-                changed = self._host_manager.update_available_hosts()
-            except Exception as e:  # discovery script hiccup
-                log.warning("elastic: discovery failed: %s", e)
-                continue
+        # Two cadences in one loop: failure hints are polled every
+        # hint_poll_interval_s (workers post them the moment native
+        # detection fires, so this bounds re-formation latency), full host
+        # discovery only every discovery_interval_s (it may exec a script).
+        hint_tick = max(self._settings.hint_poll_interval_s, 0.05)
+        next_discovery = time.monotonic()
+        while not self._shutdown.wait(hint_tick):
+            changed = False
+            reason = "host set changed"
+            if time.monotonic() >= next_discovery:
+                next_discovery = (time.monotonic() +
+                                  self._settings.discovery_interval_s)
+                try:
+                    self._host_manager.update_available_hosts()
+                except Exception as e:  # discovery script hiccup
+                    log.warning("elastic: discovery failed: %s", e)
+                    continue
+                with self._lock:
+                    changed = (dict(self._host_manager.current_hosts) !=
+                               self._last_hosts)
             hint = self._kv.get("/rendezvous/hint")
             if hint:
                 self._kv.put("/rendezvous/hint", b"")
-                changed = True
+                # Coalesce: every survivor of one failure posts a hint, and
+                # the dead worker's exit usually re-forms the world first
+                # (_watch) — hints landing right after a rendezvous describe
+                # the failure that rendezvous already handled.
+                if time.monotonic() - self._last_rendezvous > 1.0:
+                    changed = True
+                    reason = ("failure hint from "
+                              f"{hint.decode(errors='replace')}")
             if changed:
                 with self._lock:
                     if not self._shutdown.is_set():
-                        self._rendezvous("host set changed")
+                        self._rendezvous(reason)
+
+    def _metrics_monitor_loop(self) -> None:
+        """Dead-rank signals from the observability subsystem: scrape rank
+        0's /metrics (the coordinator owns the ``hvdtpu_dead_ranks`` gauge)
+        and re-rendezvous as soon as it reports a dead member — catches
+        failures even when no worker manages to post a hint (e.g. every
+        survivor is wedged inside a blocked collective shorter than its
+        read deadline)."""
+        from ...observability import parse_prometheus_text, sample_value, \
+            scrape
+        interval = max(self._settings.hint_poll_interval_s * 2, 0.5)
+        while not self._shutdown.wait(interval):
+            with self._lock:
+                host = self._controller_host
+                epoch = self._epoch
+            if not host or epoch <= self._metrics_epoch_triggered:
+                continue
+            try:
+                text = scrape(host, self._metrics_base, secret=self._secret,
+                              timeout=2.0)
+            except Exception:
+                continue  # rank 0 not up yet / mid-restart
+            dead = sample_value(parse_prometheus_text(text),
+                                "hvdtpu_dead_ranks") or 0
+            if dead > 0:
+                log.warning("elastic: metrics report %d dead rank(s); "
+                            "re-forming", int(dead))
+                with self._lock:
+                    if self._shutdown.is_set() or \
+                            epoch != self._epoch:  # already re-formed
+                        continue
+                    self._metrics_epoch_triggered = epoch
+                    self._rendezvous("dead rank reported by metrics")
 
     def _rendezvous(self, reason: str) -> None:
         """Start a new epoch: assign ranks, publish, (re)spawn workers
@@ -147,6 +240,7 @@ class ElasticDriver:
                 self._result_event.set()
                 return
             hosts = self._host_manager.current_hosts
+            self._last_hosts = dict(hosts)
             total = sum(hosts.values())
             if total < self._settings.min_np:
                 log.warning("elastic: only %d slots (< min_np %d); waiting",
@@ -174,6 +268,14 @@ class ElasticDriver:
                 self._kv.put(f"/rendezvous/{epoch}/assignment/{worker_id}",
                              json.dumps(assignment).encode())
             self._expected = expected
+            self._controller_host = controller_host
+            self._last_rendezvous = time.monotonic()
+            # Workers already running when this epoch lands must claim their
+            # assignment (runtime._elastic_assignment posts
+            # /rendezvous/{epoch}/ready/{id}) — snapshot them BEFORE the
+            # publish so the settle watchdog knows who it may terminate.
+            carried = {wid: p for wid, p in self._procs.items()
+                       if wid in expected and p.poll() is None}
             self._kv.put("/rendezvous/epoch", str(epoch).encode())
             self._kv.put("/rendezvous/updates", str(epoch).encode())
             log.info("elastic: rendezvous epoch %d (%s): %d workers on %s",
@@ -183,6 +285,107 @@ class ElasticDriver:
                 proc = self._procs.get(worker_id)
                 if proc is None or proc.poll() is not None:
                     self._spawn(worker_id, s.hostname)
+            if carried:
+                threading.Thread(target=self._settle_watchdog,
+                                 args=(epoch, carried), daemon=True).start()
+
+    def _settle_watchdog(self, epoch: int, carried: Dict[str, object]) -> None:
+        """Terminate + respawn carried-over workers that never claimed their
+        epoch assignment: a healthy worker polls the KV at every commit and
+        claims within the hint-poll latency class, so an unclaimed one is
+        wedged inside the previous world (hung collective thread, blocked
+        syscall). Without this a single hung rank keeps its slot forever
+        and every new epoch waits on a HELLO that can never come. Respawns
+        are capped + exponentially backed off per identity; past the cap
+        the host is blacklisted (flap control; docs/fault-tolerance.md).
+
+        Termination is gated on EVIDENCE, not wall-clock alone: workers
+        only poll for new epochs at commit boundaries, so after a pure
+        scale-up every healthy carried-over worker may sit mid-step for a
+        full commit interval before claiming. Only once ONE worker of the
+        epoch has claimed (collectives keep peers at the same boundary, so
+        healthy ranks claim together) does a further settle_timeout_s of
+        silence mean wedged. Failure-triggered epochs claim sub-second —
+        survivors re-enter rendezvous straight from the abort path — so
+        the hung-rank respawn latency stays ~settle_timeout_s."""
+        slice_s = max(0.05, min(0.5, self._settings.settle_timeout_s / 4))
+        first_claim = None
+        while True:
+            if self._shutdown.wait(slice_s):
+                return
+            with self._lock:
+                if self._shutdown.is_set() or self._epoch != epoch:
+                    return  # a newer epoch owns the watchdog duty now
+                expected = set(self._expected)
+                unsettled = [
+                    wid for wid, p in carried.items()
+                    if self._procs.get(wid) is p and p.poll() is None and
+                    not self._kv.get(f"/rendezvous/{epoch}/ready/{wid}")]
+            if not unsettled:
+                return  # everyone claimed or exited (_watch owns exits)
+            if first_claim is None and any(
+                    self._kv.get(f"/rendezvous/{epoch}/ready/{wid}")
+                    for wid in expected):
+                first_claim = time.monotonic()
+            if first_claim is not None and (
+                    time.monotonic() - first_claim >=
+                    self._settings.settle_timeout_s):
+                break
+        for worker_id, proc in carried.items():
+            blacklist_host = None
+            with self._lock:
+                if self._shutdown.is_set() or self._epoch != epoch:
+                    return  # a newer epoch owns the watchdog duty now
+                if self._kv.get(f"/rendezvous/{epoch}/ready/{worker_id}"):
+                    continue
+                if self._procs.get(worker_id) is not proc or \
+                        proc.poll() is not None:
+                    continue  # already replaced / exited (_watch handles it)
+                count = self._respawns.get(worker_id, 0) + 1
+                self._respawns[worker_id] = count
+                # Detach the proc first so its _watch thread stands down
+                # (a terminate would otherwise look like a worker failure
+                # and trigger blacklist + an extra rendezvous round).
+                self._procs.pop(worker_id, None)
+                host = worker_id.rsplit(":", 1)[0]
+                if count > self._settings.max_respawns:
+                    blacklist_host = host
+                else:
+                    log.warning(
+                        "elastic: worker %s never claimed epoch %d "
+                        "(wedged?); terminating and respawning (%d/%d)",
+                        worker_id, epoch, count, self._settings.max_respawns)
+            proc.terminate()
+            if blacklist_host is not None:
+                log.warning("elastic: worker %s exceeded %d respawns; "
+                            "blacklisting host %s", worker_id,
+                            self._settings.max_respawns, blacklist_host)
+                with self._lock:
+                    self._host_manager.blacklist(blacklist_host)
+                    self._host_manager.update_available_hosts()
+                    total = sum(self._host_manager.current_hosts.values())
+                    if total < self._settings.min_np:
+                        log.warning("elastic: below min_np after blacklist; "
+                                    "aborting")
+                        self._result = 1
+                        self._result_event.set()
+                    else:
+                        self._rendezvous(f"worker {worker_id} wedged past "
+                                         "the respawn cap")
+                continue
+            # Exponential backoff outside the lock: a crash-looping worker
+            # must not spin the spawn path.
+            count = self._respawns.get(worker_id, 1)
+            backoff = min(self._settings.respawn_backoff_s *
+                          (2 ** (count - 1)), 8.0)
+            if self._shutdown.wait(backoff):
+                return
+            with self._lock:
+                if self._shutdown.is_set() or self._epoch != epoch:
+                    return
+                if worker_id in self._expected and \
+                        worker_id not in self._procs:
+                    self._spawn(worker_id, worker_id.rsplit(":", 1)[0])
 
     def _spawn(self, worker_id: str, hostname: str) -> None:
         env = dict(self._base_env)
@@ -256,7 +459,9 @@ class ElasticDriver:
 
 def run_elastic(discovery: HostDiscovery, settings: ElasticSettings,
                 command: List[str], env: Dict[str, str],
-                verbose: bool = False) -> int:
-    driver = ElasticDriver(discovery, settings, command, env, verbose)
+                verbose: bool = False,
+                metrics_base: Optional[int] = None) -> int:
+    driver = ElasticDriver(discovery, settings, command, env, verbose,
+                           metrics_base=metrics_base)
     driver.start()
     return driver.wait_for_completion()

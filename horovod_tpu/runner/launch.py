@@ -99,14 +99,24 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--metrics-interval", type=float, default=None,
                    help="driver scrape/summary period in seconds "
                         "(HVDTPU_METRICS_INTERVAL; default 10)")
+    p.add_argument("--chaos", default=None,
+                   help="fault-injection spec for manual game-days "
+                        "(HVDTPU_CHAOS; docs/fault-tolerance.md): "
+                        "'kill|hang|delay=<ms>|drop[=<peer>]@op=N|hop=N'. "
+                        "Without a 'rankR:' prefix the launcher targets one "
+                        "randomly chosen worker; elastic jobs get a one-shot "
+                        "marker so the fault does not re-arm after recovery")
     p.add_argument("--stall-check-disable", action="store_true")
     p.add_argument("--stall-check-warning-time-seconds", type=float,
                    default=60.0)
     p.add_argument("--stall-check-shutdown-time-seconds", type=float,
-                   default=0.0,
+                   default=None,
                    help="abort the job after a collective stalls this long; "
-                        "0 disables (reference: "
-                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)")
+                        "0 disables. Default: AUTO — 10x the warning "
+                        "threshold, so a wedged world always breaks "
+                        "eventually (reference: "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, which "
+                        "defaults to off and leaves the escalation dead)")
     p.add_argument("--check-build", action="store_true",
                    help="print available features and exit "
                         "(reference: horovodrun --check-build)")
@@ -285,11 +295,15 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_TIMELINE] = args.timeline
     if args.timeline_mark_cycles:
         env[ev.HVDTPU_TIMELINE_MARK_CYCLES] = "1"
+    if getattr(args, "_chaos_spec", None):
+        env[ev.HVDTPU_CHAOS] = args._chaos_spec
+        if getattr(args, "_chaos_marker", None):
+            env[ev.HVDTPU_CHAOS_MARKER] = args._chaos_marker
     if args.stall_check_disable:
         env[ev.HVDTPU_STALL_CHECK_DISABLE] = "1"
     env[ev.HVDTPU_STALL_CHECK_TIME_SECONDS] = str(
         args.stall_check_warning_time_seconds)
-    if args.stall_check_shutdown_time_seconds:
+    if args.stall_check_shutdown_time_seconds is not None:
         env[ev.HVDTPU_STALL_SHUTDOWN_TIME_SECONDS] = str(
             args.stall_check_shutdown_time_seconds)
     env[ev.HVDTPU_CACHE_CAPACITY] = str(args.cache_capacity)
@@ -333,10 +347,43 @@ _is_local = safe_exec.is_local_host
 _ssh_wrap = safe_exec.ssh_wrap
 
 
+def _resolve_chaos(args, np_: int) -> None:
+    """Validate --chaos and pick its target (docs/fault-tolerance.md): a
+    spec without a 'rankR:' prefix is aimed at ONE randomly chosen worker —
+    a game-day kills a rank, not the world. Elastic jobs also get a
+    one-shot marker file so the replacement worker that inherits the dead
+    rank does not re-arm the same fault forever."""
+    if not args.chaos:
+        return
+    import random
+
+    from .. import chaos as chaos_mod
+    spec = args.chaos.strip()
+    target = None
+    if not spec.startswith("rank"):
+        target = random.randrange(np_)
+        spec = f"rank{target}:{spec}"
+    try:
+        chaos_mod.parse_chaos(spec, target if target is not None else 0)
+    except ValueError as exc:
+        raise SystemExit(f"hvdrun: {exc}")
+    if target is not None:
+        print(f"hvdrun: chaos: targeting rank {target} with {args.chaos!r}",
+              file=sys.stderr)
+    args._chaos_spec = spec
+    if args.host_discovery_script:
+        import tempfile
+        fd, marker = tempfile.mkstemp(prefix="hvdtpu_chaos_")
+        os.close(fd)
+        os.unlink(marker)  # chaos.py creates it O_EXCL at arm time
+        args._chaos_marker = marker
+
+
 def run_elastic_launcher(args: argparse.Namespace) -> int:
     """Elastic path (reference: _run_elastic, launch.py:624)."""
     from .elastic import ElasticSettings, HostDiscoveryScript, run_elastic
 
+    _resolve_chaos(args, args.min_np or args.num_proc)
     settings = ElasticSettings(
         min_np=args.min_np or args.num_proc,
         max_np=args.max_np or args.num_proc,
@@ -350,8 +397,13 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
     # per worker, since ranks change across rendezvous rounds).
     env = _apply_tuning_env(dict(os.environ), args)
     env[ev.HVDTPU_ELASTIC_TIMEOUT] = str(args.elastic_timeout)
+    # Dead-rank signals from the observability subsystem reach the driver
+    # when the metrics endpoints are on (docs/fault-tolerance.md).
+    metrics_base = args.metrics_port if args.metrics_port is not None else \
+        ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
     return run_elastic(discovery, settings, list(args.command), env,
-                       verbose=args.verbose)
+                       verbose=args.verbose,
+                       metrics_base=metrics_base or None)
 
 
 def _preflight_spawn(args):
@@ -378,6 +430,7 @@ def _preflight_spawn(args):
 def run_launcher(args: argparse.Namespace) -> int:
     if args.host_discovery_script:
         return run_elastic_launcher(args)
+    _resolve_chaos(args, args.num_proc)
     host_list = (hosts_mod.parse_hostfile(args.hostfile) if args.hostfile
                  else hosts_mod.parse_hosts(args.hosts or
                                             f"localhost:{args.num_proc}"))

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import subprocess
 import threading
+import time
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -145,6 +146,21 @@ def _detect_mode() -> str:
 # only accepts a NEWER epoch, which removes the failed-peer/stale-epoch race.
 _elastic_last_epoch = 0
 
+# When the elastic retry loop detected a peer failure (monotonic seconds):
+# consumed by the next successful process-mode init, which records the
+# detection-to-reformation latency against the NEW core's registry
+# (hvdtpu_recovery_seconds; docs/fault-tolerance.md).
+_failure_detected_at: Optional[float] = None
+
+
+def note_failure_detected() -> None:
+    """Mark the moment a peer failure was detected (called by the elastic
+    retry loop on HvdTpuInternalError). The FIRST detection of an episode
+    wins — repeated failures before a successful re-init are one outage."""
+    global _failure_detected_at
+    if _failure_detected_at is None:
+        _failure_detected_at = time.monotonic()
+
 
 def _elastic_assignment() -> Optional[dict]:
     """Poll the elastic driver's KV store for this worker's assignment
@@ -184,6 +200,16 @@ def _elastic_assignment() -> Optional[dict]:
                     a = None
                 if a:
                     _elastic_last_epoch = epoch
+                    try:
+                        # Claim the assignment: the driver's settle watchdog
+                        # terminates+respawns workers that never post this
+                        # (a rank wedged inside the PREVIOUS world cannot
+                        # re-enter rendezvous — without the claim it would
+                        # hold its slot and livelock every new epoch).
+                        client.put(f"/rendezvous/{epoch}/ready/{worker_id}",
+                                   b"1")
+                    except Exception:
+                        pass  # claim is advisory; the watchdog respawns us
                     return json.loads(a)
                 # Epoch advanced without us: scaled away. Give the driver a
                 # short grace window in case a newer epoch re-adds us.
@@ -373,7 +399,23 @@ def init(comm: Optional[Sequence[int]] = None,
                     "process mode requires the native core binding "
                     "(horovod_tpu/basics.py + horovod_tpu/native); build "
                     "it with `make -C horovod_tpu/native`") from e
-            st.core.start()
+            try:
+                st.core.start()
+            except Exception:
+                # A failed form-up (peer died mid-rendezvous) must release
+                # the half-joined core — its listen socket and controller
+                # connection would otherwise leak into the retry.
+                st.core.shutdown()
+                raise
+            # Elastic recovery accounting: the world re-formed after a
+            # detected failure — record detection -> re-init latency in the
+            # new core so hvd.metrics() shows the episode.
+            global _failure_detected_at
+            if _failure_detected_at is not None:
+                if hasattr(st.core, "observe_recovery"):
+                    st.core.observe_recovery(
+                        time.monotonic() - _failure_detected_at)
+                _failure_detected_at = None
             # Per-worker live-metrics endpoint: rank r serves /metrics +
             # /healthz on HVDTPU_METRICS_PORT + r (0 = off), secret-gated
             # like the rendezvous KV server. Started after the core so a

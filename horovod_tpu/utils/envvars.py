@@ -178,6 +178,26 @@ def get_wire_compression(name: str, bits: int = 4) -> int:
 # Elastic (reference: HOROVOD_ELASTIC_TIMEOUT, HOROVOD_GLOO_TIMEOUT_SECONDS)
 HVDTPU_ELASTIC_TIMEOUT = "HVDTPU_ELASTIC_TIMEOUT"
 
+# Fault tolerance (docs/fault-tolerance.md; no reference analog — the
+# reference's only escalation is the 60 s stall inspector).
+# FAILURE_DETECT_MS bounds how long a peer death can go unnoticed on a
+# blocked transport op (the data plane polls in detect_ms/5 slices, so an
+# abort or EOF breaks every in-flight segmented send within one slice).
+HVDTPU_FAILURE_DETECT_MS = "HVDTPU_FAILURE_DETECT_MS"
+# Transport-level no-progress deadline in seconds: a lane that is open but
+# moves ZERO bytes for this long mid-collective is declared dead — the only
+# way to catch a hung-but-alive peer or a silently blackholed route (no EOF
+# ever arrives). Progress resets the clock; 0 disables.
+HVDTPU_READ_DEADLINE_SECONDS = "HVDTPU_READ_DEADLINE_SECONDS"
+# Bounds rendezvous + data-plane mesh establishment: a rank that died
+# between spawn and HELLO (or between rendezvous and its data-plane
+# connect) fails form-up within this window instead of wedging it forever.
+HVDTPU_FORMUP_TIMEOUT_SECONDS = "HVDTPU_FORMUP_TIMEOUT_SECONDS"
+# Fault injection (horovod_tpu/chaos.py grammar -> hvdtpu_set_chaos): arm
+# one one-shot kill/hang/delay/drop at an op or hop index, e.g.
+# "rank1:kill@op=3". Forwarded to one random worker by `hvdrun --chaos`.
+HVDTPU_CHAOS = "HVDTPU_CHAOS"
+
 # Mesh / SPMD-mode knobs (TPU-native, no reference analog: control how the
 # single-process device mesh is laid out).
 HVDTPU_MESH_SHAPE = "HVDTPU_MESH_SHAPE"
@@ -208,6 +228,10 @@ HVDTPU_COMPILATION_CACHE_DIR = "HVDTPU_COMPILATION_CACHE_DIR"
 # Elastic worker identity token, injected per-attempt by the elastic driver
 # (runner/elastic/driver.py) and echoed in state-sync commits.
 HVDTPU_WORKER_ID = "HVDTPU_WORKER_ID"
+# One-shot marker file for HVDTPU_CHAOS under elastic restarts: the first
+# process to arm the spec creates it, so a respawned worker inheriting the
+# dead worker's rank does not re-arm the same fault (horovod_tpu/chaos.py).
+HVDTPU_CHAOS_MARKER = "HVDTPU_CHAOS_MARKER"
 # runner.run()'s function-shipping KV store address, injected into workers.
 HVDTPU_RUN_KV_ADDR = "HVDTPU_RUN_KV_ADDR"
 HVDTPU_RUN_KV_PORT = "HVDTPU_RUN_KV_PORT"
@@ -225,6 +249,7 @@ HVDTPU_PREFLIGHT_TIMEOUT = "HVDTPU_PREFLIGHT_TIMEOUT"
 # (ENV-DOC in scripts/check_invariants.py).
 INTERNAL_ENV_VARS = frozenset({
     HVDTPU_WORKER_ID,
+    HVDTPU_CHAOS_MARKER,
     HVDTPU_RUN_KV_ADDR,
     HVDTPU_RUN_KV_PORT,
     HVDTPU_PREFLIGHT_KV_ADDR,

@@ -526,6 +526,9 @@ def check_enum_mirrors(root: Path, findings, ran):
               "horovod_tpu/basics.py", "_RESPONSE_TYPES")
     dict_pair("WireCompression", f"{NATIVE_DIR}/compressed.h",
               "WireCompression", ENVVARS_PY, "WIRE_COMPRESSION_MODES")
+    # ChaosSpec::Action is nested, but the enum-class regex doesn't care.
+    dict_pair("ChaosAction", f"{NATIVE_DIR}/data_plane.h", "Action",
+              "horovod_tpu/chaos.py", "CHAOS_ACTIONS")
 
     # ReduceOp: IntEnum mirror, names compared verbatim.
     cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/common.h", "ReduceOp")

@@ -34,6 +34,11 @@ run_check "check"       make check
 run_check "check-tsan"  make check-tsan
 run_check "check-asan"  make check-asan
 run_check "check-ubsan" make check-ubsan
+# Fast chaos smoke (docs/fault-tolerance.md): one SIGKILL + one hang on the
+# tcp ring, through the real elastic driver — proves detection + recovery
+# end to end. The full {algo x transport x hier x compression} matrix lives
+# in tests/test_chaos.py (slow marker) / `python3 scripts/chaos_harness.py`.
+run_check "chaos-smoke" env JAX_PLATFORMS=cpu python3 scripts/chaos_harness.py --smoke
 
 echo
 echo "============ CI summary ============"

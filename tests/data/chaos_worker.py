@@ -1,0 +1,95 @@
+"""Chaos-matrix elastic worker (docs/fault-tolerance.md): loops verified
+allreduces with commits while HVDTPU_CHAOS kills/hangs/partitions one rank
+mid-collective; survivors must detect fast, re-form, and keep producing
+CORRECT results. Writes one result line per finishing worker plus a
+``detected`` line at the moment a failure surfaces (sampling the dying
+core's dead-ranks gauge before re-init replaces it)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HvdTpuInternalError
+
+RESULT_FILE = os.environ["CHAOS_RESULT_FILE"]
+TARGET = int(os.environ.get("CHAOS_TARGET_BATCHES", "10"))
+BATCH_SLEEP = float(os.environ.get("CHAOS_BATCH_SLEEP", "0"))
+# Elements per allreduce: default clears the compression min-bytes gate
+# (1024 B) so int8/int4 wire modes actually engage on the faulted op.
+ELEMS = int(os.environ.get("CHAOS_ELEMS", "4096"))
+
+hvd.init()
+
+# A real (tiny) training loop: fit w -> 3.0 by allreduced "gradients" so the
+# loss curve must keep descending, NaN-free, across recoveries.
+state = hvd.elastic.ObjectState(batches=0, w=0.0, losses=[])
+
+
+def _metric_total(metrics, family, suffix=""):
+    return sum(v for (suf, _l, v) in
+               metrics.get(family, {}).get("samples", []) if suf == suffix)
+
+
+def _append(line):
+    with open(RESULT_FILE, "a") as f:
+        f.write(line + "\n")
+
+
+@hvd.elastic.run
+def train(state):
+    while state.batches < TARGET:
+        grad = float(state.w) - 3.0  # d/dw (w - 3)^2 / 2, same on all ranks
+        x = np.full(ELEMS, grad, np.float32)
+        try:
+            out = hvd.allreduce(x, name=f"step{state.batches}", op=hvd.Sum)
+            arr = np.asarray(out)
+            # Correctness THROUGH the failure: every surviving rank must see
+            # exactly size * grad (all-equal payloads quantize exactly, so
+            # this holds for every wire-compression mode too).
+            expect = grad * hvd.size()
+            if not np.allclose(arr, expect, rtol=1e-3, atol=1e-3):
+                _append(f"WRONG worker={os.environ.get('HVDTPU_WORKER_ID')} "
+                        f"batch={state.batches} got={arr[:4]} want={expect}")
+                os._exit(5)
+            state.w = float(state.w) - 0.5 * float(arr.mean()) / hvd.size()
+            loss = (float(state.w) - 3.0) ** 2
+            if not np.isfinite(loss):
+                _append(f"NAN worker={os.environ.get('HVDTPU_WORKER_ID')} "
+                        f"batch={state.batches} w={state.w}")
+                os._exit(6)
+            state.losses = list(state.losses) + [loss]
+            state.batches += 1
+            state.commit()  # failures surface here too (sync collectives)
+        except HvdTpuInternalError:
+            # The dying core is still attached: snapshot its view of the
+            # failure before the elastic retry loop replaces it (the
+            # dead-ranks gauge lives on the coordinator).
+            m = hvd.metrics()
+            _append(f"detected worker={os.environ.get('HVDTPU_WORKER_ID')} "
+                    f"rank={hvd.rank()} t={time.monotonic():.3f} "
+                    f"dead_ranks={_metric_total(m, 'hvdtpu_dead_ranks'):.0f} "
+                    f"failures="
+                    f"{_metric_total(m, 'hvdtpu_failures_detected_total'):.0f}")
+            raise
+        if BATCH_SLEEP:
+            time.sleep(BATCH_SLEEP)
+    return hvd.size()
+
+
+final_size = train(state)
+m = hvd.metrics()
+losses = list(state.losses)
+loss_ok = (len(losses) == TARGET and
+           all(np.isfinite(v) for v in losses) and
+           losses[-1] < losses[0])
+_append(f"done worker={os.environ.get('HVDTPU_WORKER_ID')} "
+        f"rank={hvd.rank()} final_size={final_size} "
+        f"batches={state.batches} loss_ok={int(loss_ok)} "
+        f"final_loss={losses[-1] if losses else float('nan'):.6f} "
+        f"recovery_count={_metric_total(m, 'hvdtpu_recovery_seconds', 'count'):.0f} "
+        f"recovery_sum={_metric_total(m, 'hvdtpu_recovery_seconds', 'sum'):.4f} "
+        f"failures={_metric_total(m, 'hvdtpu_failures_detected_total'):.0f}")
+hvd.shutdown()
