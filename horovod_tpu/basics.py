@@ -130,6 +130,9 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_transport.restype = ctypes.c_int
     lib.hvdtpu_set_transport.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
+    lib.hvdtpu_set_transport_ext.restype = ctypes.c_int
+    lib.hvdtpu_set_transport_ext.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong]
     lib.hvdtpu_set_autotune.restype = ctypes.c_int
     lib.hvdtpu_set_autotune.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -270,6 +273,30 @@ class NativeCore:
             self._core, int(ev.get_bool(ev.HVDTPU_SHM, default=True)),
             ev.get_int(ev.HVDTPU_SHM_RING_BYTES, 0),
             ev.ALLREDUCE_HIER_MODES[hier])
+        # Zero-copy transport lane (docs/collectives.md "Zero-copy TCP
+        # lane"): MSG_ZEROCOPY/io_uring TCP sends (runtime-probed per lane,
+        # copy-path fallback), NUMA placement of the shm rings, and the
+        # futex-doorbell coalescing window.
+        zc = (ev.get_str(ev.HVDTPU_TCP_ZEROCOPY, "auto") or
+              "auto").strip().lower()
+        if zc not in ev.TCP_ZEROCOPY_MODES:
+            raise ValueError(
+                f"{ev.HVDTPU_TCP_ZEROCOPY} must be one of "
+                f"{sorted(ev.TCP_ZEROCOPY_MODES)}, got {zc!r}")
+        numa = (ev.get_str(ev.HVDTPU_SHM_NUMA, "auto") or
+                "auto").strip().lower()
+        if numa not in ev.SHM_NUMA_MODES:
+            raise ValueError(
+                f"{ev.HVDTPU_SHM_NUMA} must be one of "
+                f"{sorted(ev.SHM_NUMA_MODES)}, got {numa!r}")
+        doorbell = ev.get_int(ev.HVDTPU_DOORBELL_BATCH, 0)
+        if doorbell < 0:
+            raise ValueError(
+                f"{ev.HVDTPU_DOORBELL_BATCH} must be >= 0 bytes, got "
+                f"{doorbell}")
+        self._lib.hvdtpu_set_transport_ext(
+            self._core, ev.TCP_ZEROCOPY_MODES[zc], ev.SHM_NUMA_MODES[numa],
+            doorbell)
         # Wire compression (native/compressed.{h,cpp}): quantize allreduce
         # payloads on the process-mode wire. HVDTPU_COMPRESSION doubles as
         # the selector (wire modes none/fp16/int8/int4/auto; "maxmin" rides

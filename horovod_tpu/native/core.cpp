@@ -282,6 +282,14 @@ struct CoreConfig {
   int32_t shm_enabled = 1;
   int64_t shm_ring_bytes = 0;
   int32_t allreduce_hier = 2;
+  // Zero-copy transport lane (HVDTPU_TCP_ZEROCOPY / HVDTPU_SHM_NUMA /
+  // HVDTPU_DOORBELL_BATCH; transport.h ZeroCopyMode, shm_transport.h
+  // ShmNumaMode). tcp_zerocopy: 0 auto, 1 on, 2 off, 3 uring; shm_numa:
+  // 0 auto, 1 on, 2 off; doorbell_batch: futex-wake coalescing window in
+  // bytes (0 = lane default, 1 = wake per cursor advance).
+  int32_t tcp_zerocopy = 0;
+  int32_t shm_numa = 0;
+  int64_t doorbell_batch = 0;
   // Wire compression (HVDTPU_COMPRESSION; compressed.h WireCompression:
   // 0 none, 1 fp16, 2 int8, 3 int4, 4 auto/autotuned). Applies to fp32
   // SUM/AVERAGE allreduces at or above min_bytes whose tensor names all
@@ -698,6 +706,9 @@ Status Core::Start() {
   data_plane_.set_shm_enabled(cfg_.shm_enabled != 0);
   data_plane_.set_shm_ring_bytes(cfg_.shm_ring_bytes);
   data_plane_.set_hier_mode(static_cast<HierMode>(cfg_.allreduce_hier));
+  data_plane_.set_tcp_zerocopy(static_cast<ZeroCopyMode>(cfg_.tcp_zerocopy));
+  data_plane_.set_shm_numa(static_cast<ShmNumaMode>(cfg_.shm_numa));
+  data_plane_.set_doorbell_batch(cfg_.doorbell_batch);
   // Wire-compression skip list (Python validates the pattern too; a bad
   // regex smuggled past it must fail loudly, not silently compress biases).
   comp_skip_set_ = false;
@@ -2420,6 +2431,25 @@ int hvdtpu_set_transport(void* core, int shm_enabled,
   cfg->shm_enabled = shm_enabled;
   cfg->shm_ring_bytes = shm_ring_bytes;
   cfg->allreduce_hier = hier_mode;
+  return 0;
+}
+
+// Zero-copy transport lane knobs (docs/collectives.md "Zero-copy TCP
+// lane"): tcp_zerocopy = transport.h ZeroCopyMode (0 auto, 1 on, 2 off,
+// 3 uring — the lane is runtime-probed either way and falls back to the
+// copy path); shm_numa = shm_transport.h ShmNumaMode (0 auto, 1 on,
+// 2 off); doorbell_batch = futex-doorbell coalescing window in bytes
+// (<= 0 keeps the lane default, 1 restores wake-per-advance). Pre-Start()
+// only: the TCP lanes probe at Connect, the shm lanes take their policy at
+// negotiation.
+int hvdtpu_set_transport_ext(void* core, int tcp_zerocopy, int shm_numa,
+                             long long doorbell_batch) {
+  if (tcp_zerocopy < 0 || tcp_zerocopy > 3) return -1;
+  if (shm_numa < 0 || shm_numa > 2) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->tcp_zerocopy = tcp_zerocopy;
+  cfg->shm_numa = shm_numa;
+  cfg->doorbell_batch = doorbell_batch;
   return 0;
 }
 

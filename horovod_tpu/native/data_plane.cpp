@@ -378,6 +378,33 @@ void DataPlane::set_metrics(Metrics* m) {
   wire_bytes_total_ = metrics_->GetCounter(
       "hvdtpu_allreduce_wire_bytes_total",
       "Allreduce payload bytes this rank actually sent on the wire");
+  zc_sends_total_ = metrics_->GetCounter(
+      "hvdtpu_zerocopy_sends_total",
+      "Large TCP sends completed through the zero-copy lane "
+      "(MSG_ZEROCOPY/io_uring), completions drained");
+  zc_fallbacks_total_ = metrics_->GetCounter(
+      "hvdtpu_zerocopy_fallbacks_total",
+      "Large TCP sends that wanted the zero-copy lane but took the copy "
+      "path (failed probe, kernel-copied auto-disable, runtime decline)");
+  zc_sends_published_ = 0;
+  zc_fallbacks_published_ = 0;
+}
+
+void DataPlane::PublishZeroCopyCounters() {
+  if (tcp_lanes_.empty()) return;
+  int64_t sends = 0, fallbacks = 0;
+  for (TcpTransport* t : tcp_lanes_) {
+    sends += t->zerocopy_sends();
+    fallbacks += t->zerocopy_fallbacks();
+  }
+  if (sends > zc_sends_published_) {
+    zc_sends_total_->Add(sends - zc_sends_published_);
+    zc_sends_published_ = sends;
+  }
+  if (fallbacks > zc_fallbacks_published_) {
+    zc_fallbacks_total_->Add(fallbacks - zc_fallbacks_published_);
+    zc_fallbacks_published_ = fallbacks;
+  }
 }
 
 DataPlane::~DataPlane() { Shutdown(); }
@@ -472,11 +499,17 @@ Status DataPlane::Connect(const std::vector<PeerAddr>& peers) {
 }
 
 Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
+  tcp_lanes_.clear();
+  auto make_tcp = [&](int peer) {
+    auto* t = new TcpTransport(fds_[peer], inline_max_bytes_, &io_ctl_,
+                               tcp_zerocopy_);
+    tcp_lanes_.push_back(t);
+    transports_[peer].reset(t);
+  };
   for (int peer = 0; peer < size_; ++peer) {
     if (peer == rank_) continue;
     if (peers[peer].host != peers[rank_].host) {
-      transports_[peer].reset(
-          new TcpTransport(fds_[peer], inline_max_bytes_, &io_ctl_));
+      make_tcp(peer);
       continue;
     }
     // Same host: negotiate a shared-memory lane over the pair's socket so
@@ -529,6 +562,10 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
       // the pair's (otherwise idle) socket for EOF while waiting instead.
       shm->set_liveness_fd(fds_[peer]);
       shm->set_control(&io_ctl_);
+      shm->set_doorbell_batch(doorbell_batch_);
+      // NUMA placement (HVDTPU_SHM_NUMA): each side pins its inbound ring
+      // to its own node — probed no-op on single-node hosts.
+      shm->ApplyNumaPolicy(shm_numa_);
       transports_[peer] = std::move(shm);
     } else {
       shm.reset();  // creator side aborts + unlinks in the destructor
@@ -538,18 +575,9 @@ Status DataPlane::SetupTransports(const std::vector<PeerAddr>& peers) {
                 "unavailable; falling back to TCP\n",
                 rank_, peer);
       }
-      transports_[peer].reset(
-          new TcpTransport(fds_[peer], inline_max_bytes_, &io_ctl_));
+      make_tcp(peer);
     }
   }
-  // Cache the lane summary: the mix is invariant from here on, and the
-  // timeline tags every executed op with it (no per-op rescan).
-  const int shm = shm_lane_count();
-  const int tcp = size_ - 1 - shm;
-  transport_label_ = shm > 0 && tcp > 0 ? "shm+tcp"
-                     : shm > 0          ? "shm"
-                     : tcp > 0          ? "tcp"
-                                        : "local";
   return Status::OK();
 }
 
@@ -561,9 +589,45 @@ int DataPlane::shm_lane_count() const {
   return shm;
 }
 
+bool DataPlane::zerocopy_active() const {
+  for (TcpTransport* t : tcp_lanes_) {
+    if (t->zerocopy_enabled()) return true;
+  }
+  return false;
+}
+
+const std::string& DataPlane::transport_label() {
+  // Rebuilt per call (a handful of times per op): the tcp-zc tag is live —
+  // an AUTO lane that found the kernel copying anyway has downgraded
+  // itself, and the per-op histogram/timeline labels must say so.
+  int shm = 0, tcp = 0;
+  bool zc = false;
+  for (const auto& t : transports_) {
+    if (t == nullptr) continue;
+    if (std::strcmp(t->kind(), "shm") == 0) {
+      ++shm;
+    } else {
+      ++tcp;
+      if (std::strcmp(t->kind(), "tcp-zc") == 0) zc = true;
+    }
+  }
+  const char* tcp_tag = zc ? "tcp-zc" : "tcp";
+  if (shm > 0 && tcp > 0) {
+    transport_label_ = std::string("shm+") + tcp_tag;
+  } else if (shm > 0) {
+    transport_label_ = "shm";
+  } else if (tcp > 0) {
+    transport_label_ = tcp_tag;
+  } else {
+    transport_label_ = "local";
+  }
+  return transport_label_;
+}
+
 void DataPlane::Shutdown() {
   // Transports first: the shm lanes flip their shared abort flag and wake
   // any same-host peer still blocked in a ring op before the name goes.
+  tcp_lanes_.clear();  // raw views into transports_: drop before the owners
   for (auto& t : transports_) t.reset();
   for (int& fd : fds_) {
     CloseFd(fd);
@@ -714,7 +778,7 @@ Status DataPlane::RecvFrom(int peer, void* buf, int64_t bytes,
 Status DataPlane::Exchange(int send_peer, const void* send_buf,
                            int64_t send_bytes, int recv_peer, void* recv_buf,
                            int64_t recv_bytes, int64_t segment_bytes,
-                           const SegmentFn& on_segment) {
+                           const SegmentFn& on_segment, size_t view_align) {
   MaybeChaosHop(send_peer, recv_peer);
   if (io_ctl_.is_aborted()) {
     return Status::Error(StatusCode::ABORTED,
@@ -731,18 +795,39 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
     // pump for shm; inline/concurrent/segmented socket path for TCP).
     if (transports_[send_peer]->SendRecv(
             send_buf, static_cast<size_t>(send_bytes), recv_buf,
-            static_cast<size_t>(recv_bytes), seg, on_segment) != 0) {
+            static_cast<size_t>(recv_bytes), seg, view_align,
+            on_segment) != 0) {
       return FailLane(send_peer, "exchange");
     }
     return Status::OK();
   }
   Transport* ts = transports_[send_peer].get();
   Transport* tr = transports_[recv_peer].get();
+  if (std::strcmp(ts->kind(), "shm") == 0 &&
+      std::strcmp(tr->kind(), "shm") == 0) {
+    // Both lanes shared memory (ring-neighbor exchange on one host): one
+    // thread pumps both rings — no sender thread, in-place receive views.
+    auto* stx = static_cast<ShmTransport*>(ts);
+    auto* srx = static_cast<ShmTransport*>(tr);
+    if (ShmTransport::DuplexPump(stx, send_buf,
+                                 static_cast<size_t>(send_bytes), srx,
+                                 recv_buf, static_cast<size_t>(recv_bytes),
+                                 view_align, on_segment) != 0) {
+      // Blame the lane whose liveness probe / deadline actually tripped,
+      // not reflexively the receive side: dead_ranks_ and the PR-6
+      // re-rendezvous trigger act on this attribution.
+      const int suspect = stx->peer_died() && !srx->peer_died()
+                              ? send_peer
+                              : recv_peer;
+      return FailLane(suspect, "exchange");
+    }
+    return Status::OK();
+  }
   auto recv_side = [&]() -> int {
     if (recv_bytes <= 0) return 0;
     if (on_segment) {
       return tr->RecvSegmented(recv_buf, static_cast<size_t>(recv_bytes), seg,
-                               on_segment);
+                               view_align, on_segment);
     }
     return tr->Recv(recv_buf, static_cast<size_t>(recv_bytes));
   };
@@ -807,6 +892,7 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   }
   raw_bytes_total_->Add(op_raw_bytes_);
   wire_bytes_total_->Add(op_wire_bytes_);
+  PublishZeroCopyCounters();
   return st;
 }
 
@@ -998,7 +1084,15 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
   auto chunk_count = [&](int c) { return starts[c + 1] - starts[c]; };
   int64_t max_chunk = 0;
   for (int c = 0; c < gs; ++c) max_chunk = std::max(max_chunk, chunk_count(c));
-  std::vector<uint8_t> recv_tmp(static_cast<size_t>(max_chunk) * elem);
+  // Receive scratch: the shm lane consumes segments in place (zero-copy
+  // views), so a shm left-neighbor needs NO landing buffer at all — and
+  // the TCP lane gets an uninitialized one (the old value-initialized
+  // vector memset a full chunk per op for bytes about to be overwritten).
+  const bool recv_lands =
+      std::strcmp(transports_[left]->kind(), "shm") != 0;
+  std::unique_ptr<uint8_t[]> recv_tmp(
+      recv_lands ? new uint8_t[static_cast<size_t>(max_chunk) * elem]
+                 : nullptr);
 
   // Element-aligned pipeline segment.
   int64_t seg = segment_bytes_ - segment_bytes_ % static_cast<int64_t>(elem);
@@ -1016,21 +1110,28 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
     int64_t send_bytes = chunk_count(send_c) * static_cast<int64_t>(elem);
     int64_t recv_bytes = chunk_count(recv_c) * static_cast<int64_t>(elem);
     AddOpBytes(send_bytes, send_bytes);
-    if (recv_bytes >= 2 * seg) {
+    if (recv_bytes > 0) {
+      // Segment views reduce straight from the transport's storage: the
+      // TCP lane hands recv_tmp-backed views, the shm lane hands in-ring
+      // views and skips the staging copy entirely (transport.h SegmentFn).
+      // Every non-empty chunk takes this path — chunk sizes are whole
+      // element multiples, and sub-segment chunks simply arrive as one
+      // view.
       uint8_t* dst = chunk_ptr(recv_c);
       Status st = Exchange(
-          right, chunk_ptr(send_c), send_bytes, left, recv_tmp.data(),
-          recv_bytes, seg, [&](size_t off, size_t len) {
-            ReduceBuffer(dst + off, recv_tmp.data() + off,
-                         static_cast<int64_t>(len / elem), dtype, op);
-          });
+          right, chunk_ptr(send_c), send_bytes, left, recv_tmp.get(),
+          recv_bytes, seg,
+          [&](const uint8_t* data, size_t off, size_t len) {
+            ReduceBuffer(dst + off, data, static_cast<int64_t>(len / elem),
+                         dtype, op);
+          },
+          elem);
       if (!st.ok()) return st;
     } else {
+      // Empty chunk (count < group size): send-only hop.
       Status st = Exchange(right, chunk_ptr(send_c), send_bytes, left,
-                           recv_tmp.data(), recv_bytes);
+                           nullptr, 0);
       if (!st.ok()) return st;
-      ReduceBuffer(chunk_ptr(recv_c), recv_tmp.data(), chunk_count(recv_c),
-                   dtype, op);
     }
   }
   return Status::OK();
@@ -1402,6 +1503,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   }
   raw_bytes_total_->Add(op_raw_bytes_);
   wire_bytes_total_->Add(op_wire_bytes_);
+  PublishZeroCopyCounters();
   return Status::OK();
 }
 

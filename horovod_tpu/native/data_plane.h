@@ -19,6 +19,7 @@
 #include "common.h"
 #include "compressed.h"
 #include "metrics.h"
+#include "shm_transport.h"
 #include "transport.h"
 
 namespace hvdtpu {
@@ -163,6 +164,13 @@ class DataPlane {
   void set_shm_ring_bytes(int64_t b) { if (b > 0) shm_ring_bytes_ = b; }
   void set_hier_mode(HierMode m) { hier_mode_ = m; }
   void set_hier_auto(bool on) { hier_auto_ = on; }
+  // Zero-copy lane knobs (PR 9; docs/collectives.md "Zero-copy TCP lane").
+  // Must be set before Connect: the TCP lanes probe at construction, the
+  // shm lanes take their doorbell/NUMA policy at negotiation.
+  void set_tcp_zerocopy(ZeroCopyMode m) { tcp_zerocopy_ = m; }
+  void set_shm_numa(ShmNumaMode m) { shm_numa_ = m; }
+  void set_doorbell_batch(int64_t b) { if (b > 0) doorbell_batch_ = b; }
+  ZeroCopyMode tcp_zerocopy() const { return tcp_zerocopy_; }
   HierMode hier_mode() const { return hier_mode_; }
   // True when Allreduce will take the two-level path: hier requested (or
   // autotuned on) and at least one host holds 2+ ranks. The predicate must
@@ -176,10 +184,16 @@ class DataPlane {
     return hier_mode_ == HierMode::ON ||
            (hier_mode_ == HierMode::AUTO && hier_auto_);
   }
-  // Lane summary for the timeline / introspection: "tcp", "shm", "shm+tcp"
-  // ("local" before Connect / at size 1). Cached by SetupTransports.
-  const std::string& transport_label() const { return transport_label_; }
+  // Lane summary for the timeline / introspection: "tcp", "tcp-zc", "shm",
+  // "shm+tcp", "shm+tcp-zc" ("local" before Connect / at size 1). Rebuilt
+  // per call because the zero-copy tag is LIVE: an AUTO lane that detects
+  // kernel-copied completions downgrades itself mid-run and the per-op
+  // metric/timeline labels must follow. Collective-driving thread only.
+  const std::string& transport_label();
   int shm_lane_count() const;  // peers reached over shared memory
+  // Any TCP lane currently riding the zero-copy engine? (introspection +
+  // tests; background thread only, like the label.)
+  bool zerocopy_active() const;
   int num_hosts() const { return static_cast<int>(leaders_.size()); }
 
   // Per-op wire compression (compressed.h). The core calls
@@ -253,10 +267,15 @@ class DataPlane {
   // Send to one peer while receiving from another (possibly the same), with
   // optional segment callbacks on the receive side. The building block every
   // algorithm rides; routes through the per-peer transports.
+  // view_align: element size the receive-side callback views are aligned
+  // to (the shm lane consumes segments IN PLACE from its ring — see
+  // SegmentFn in transport.h — and must never hand the reducer a torn
+  // element).
   Status Exchange(int send_peer, const void* send_buf, int64_t send_bytes,
                   int recv_peer, void* recv_buf, int64_t recv_bytes,
                   int64_t segment_bytes = 0,
-                  const SegmentFn& on_segment = nullptr);
+                  const SegmentFn& on_segment = nullptr,
+                  size_t view_align = 1);
 
   // Record a lane failure against `peer`, abort the plane, and return the
   // coherent "peer failure" status every subsequent op also gets.
@@ -368,6 +387,16 @@ class DataPlane {
   std::string transport_label_ = "local";
   HierMode hier_mode_ = HierMode::AUTO;
   bool hier_auto_ = false;
+  // Zero-copy lane configuration (PR 9): TCP MSG_ZEROCOPY/io_uring mode,
+  // shm NUMA placement, futex-doorbell coalescing window (0 = lane
+  // default). Applied at Connect/SetupTransports.
+  ZeroCopyMode tcp_zerocopy_ = ZeroCopyMode::AUTO;
+  ShmNumaMode shm_numa_ = ShmNumaMode::AUTO;
+  int64_t doorbell_batch_ = 0;
+  // TCP lanes (downcast cache) for zero-copy counter publication.
+  std::vector<TcpTransport*> tcp_lanes_;
+  int64_t zc_sends_published_ = 0;
+  int64_t zc_fallbacks_published_ = 0;
   // Largest payload a TCP lane may send inline (blocking send, then recv)
   // without a deadlock risk; measured against the mesh's socket buffer
   // sizes in Connect(). 0 (pre-Connect) = always use the concurrent path.
@@ -400,6 +429,12 @@ class DataPlane {
   Metrics* metrics_ = nullptr;
   Counter* raw_bytes_total_ = nullptr;
   Counter* wire_bytes_total_ = nullptr;
+  Counter* zc_sends_total_ = nullptr;
+  Counter* zc_fallbacks_total_ = nullptr;
+
+  // Publish the TCP lanes' zero-copy send/fallback totals into the metrics
+  // registry (delta-based; called at op boundaries on the driving thread).
+  void PublishZeroCopyCounters();
 };
 
 // dst[i] = dst[i] OP src[i], accumulating fp16/bf16 in float.

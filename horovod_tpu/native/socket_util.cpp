@@ -92,12 +92,13 @@ int TcpConnectRetry(const std::string& host, int port, int timeout_ms) {
   }
 }
 
-namespace {
-
-int CtlSliceMs(const IoControl* ctl) {
+int IoSliceMs(const IoControl* ctl) {
+  if (ctl == nullptr) return 100;
   int64_t s = ctl->detect_slice_ms;
   return static_cast<int>(s < 1 ? 1 : (s > 1000 ? 1000 : s));
 }
+
+namespace {
 
 // One sliced poll while a controlled transfer makes no progress. Returns -1
 // (transfer must fail) on plane abort, observed peer death (POLLERR/POLLHUP
@@ -109,11 +110,32 @@ int CtlWait(int fd, short events, IoControl* ctl, double last_progress) {
     return -1;
   }
   pollfd pfd{fd, events, 0};
-  int rc = poll(&pfd, 1, CtlSliceMs(ctl));
-  if (rc > 0 && (pfd.revents & (POLLERR | POLLNVAL)) != 0) {
+  int rc = poll(&pfd, 1, IoSliceMs(ctl));
+  if (rc > 0 && (pfd.revents & POLLNVAL) != 0) {
     ctl->MarkPeerFailed();
     errno = ECONNRESET;
     return -1;
+  }
+  if (rc > 0 && (pfd.revents & POLLERR) != 0) {
+    // POLLERR is ambiguous on a socket with the zero-copy lane armed:
+    // pending MSG_ZEROCOPY completion notifications sit on the error queue
+    // and raise it without any real failure. SO_ERROR tells them apart —
+    // zero means "errqueue data only" (the sending thread reaps it), so
+    // the transfer just retries; nonzero is a genuine socket error.
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 ||
+        soerr != 0) {
+      ctl->MarkPeerFailed();
+      errno = soerr != 0 ? soerr : ECONNRESET;
+      return -1;
+    }
+    if ((pfd.revents & (events | POLLHUP)) == 0) {
+      // Nothing but the errqueue flag: avoid a hard spin while the sender
+      // thread drains its completions.
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      return 0;
+    }
   }
   if (rc > 0 && (pfd.revents & POLLHUP) != 0 &&
       (pfd.revents & POLLIN) == 0) {
@@ -185,18 +207,60 @@ int RecvAll(int fd, void* buf, size_t len, IoControl* ctl) {
   return 0;
 }
 
-int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
-                      int recv_fd, void* recv_buf, size_t recv_bytes,
-                      size_t segment_bytes,
-                      const std::function<void(size_t, size_t)>& on_segment,
-                      IoControl* ctl) {
+int SendAllVec(int fd, struct iovec* iov, int iovcnt, IoControl* ctl) {
+  double last_progress = ctl != nullptr ? MonoSeconds() : 0;
+  int i = 0;
+  while (i < iovcnt) {
+    if (iov[i].iov_len == 0) {
+      ++i;
+      continue;
+    }
+    msghdr mh;
+    memset(&mh, 0, sizeof(mh));
+    mh.msg_iov = iov + i;
+    mh.msg_iovlen = static_cast<size_t>(iovcnt - i);
+    ssize_t n = sendmsg(fd, &mh,
+                        MSG_NOSIGNAL | (ctl != nullptr ? MSG_DONTWAIT : 0));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (ctl != nullptr && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (CtlWait(fd, POLLOUT, ctl, last_progress) != 0) return -1;
+        continue;
+      }
+      if (ctl != nullptr) ctl->MarkPeerFailed();
+      return -1;
+    }
+    if (ctl != nullptr && n > 0) last_progress = MonoSeconds();
+    // Advance past fully sent iovecs, then trim the partial head.
+    size_t left = static_cast<size_t>(n);
+    while (i < iovcnt && left >= iov[i].iov_len) {
+      left -= iov[i].iov_len;
+      ++i;
+    }
+    if (i < iovcnt && left > 0) {
+      iov[i].iov_base = static_cast<char*>(iov[i].iov_base) + left;
+      iov[i].iov_len -= left;
+    }
+  }
+  return 0;
+}
+
+int SendRecvSegmented(
+    int send_fd, const void* send_buf, size_t send_bytes, int recv_fd,
+    void* recv_buf, size_t recv_bytes, size_t segment_bytes,
+    const std::function<void(const uint8_t*, size_t, size_t)>& on_segment,
+    IoControl* ctl) {
   if (segment_bytes == 0 || segment_bytes > recv_bytes) {
     segment_bytes = recv_bytes;
   }
   int send_rc = 0;
-  std::thread sender([&] {
-    if (send_bytes > 0) send_rc = SendAll(send_fd, send_buf, send_bytes, ctl);
-  });
+  // No sender thread for receive-only calls (TcpTransport::RecvSegmented
+  // delegates here with send_bytes == 0 on every segmented ring hop).
+  std::thread sender;
+  if (send_bytes > 0) {
+    sender = std::thread(
+        [&] { send_rc = SendAll(send_fd, send_buf, send_bytes, ctl); });
+  }
   int recv_rc = 0;
   if (recv_bytes > 0) {
     if (!on_segment) {
@@ -243,7 +307,8 @@ int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
           finished = done;
         }
         if (avail > consumed) {
-          on_segment(consumed, avail - consumed);
+          on_segment(static_cast<const uint8_t*>(recv_buf) + consumed,
+                     consumed, avail - consumed);
           consumed = avail;
         } else if (finished) {
           break;  // receive error: recv_rc is set
@@ -252,15 +317,17 @@ int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
       receiver.join();
     }
   }
-  sender.join();
+  if (sender.joinable()) sender.join();
   return (send_rc != 0 || recv_rc != 0) ? -1 : 0;
 }
 
 int SendFrame(int fd, const std::vector<uint8_t>& payload) {
+  // One vectored syscall for [u64 length][payload]: the header no longer
+  // rides its own send (and, under TCP_NODELAY, its own packet).
   uint64_t len = payload.size();
-  if (SendAll(fd, &len, sizeof(len)) != 0) return -1;
-  if (len > 0 && SendAll(fd, payload.data(), payload.size()) != 0) return -1;
-  return 0;
+  iovec iov[2] = {{&len, sizeof(len)},
+                  {const_cast<uint8_t*>(payload.data()), payload.size()}};
+  return SendAllVec(fd, iov, len > 0 ? 2 : 1);
 }
 
 int RecvFrame(int fd, std::vector<uint8_t>* payload) {

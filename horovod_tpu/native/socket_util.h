@@ -5,6 +5,8 @@
 // loopback/CPU data plane both ride these.
 #pragma once
 
+#include <sys/uio.h>
+
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -46,6 +48,12 @@ struct IoControl {
   }
 };
 
+// Poll-slice length in ms for a controlled blocking op: the control's
+// detect_slice_ms clamped to [1, 1000] (100 with no control block). One
+// clamp policy for every sliced wait — SendAll/RecvAll here, the
+// zero-copy completion drains in transport.cpp.
+int IoSliceMs(const IoControl* ctl);
+
 // All functions return >= 0 on success, -1 on error (errno preserved).
 
 // Create a listening socket bound to 0.0.0.0:port (port 0 = ephemeral).
@@ -72,18 +80,29 @@ int TcpConnectRetry(const std::string& host, int port, int timeout_ms);
 int SendAll(int fd, const void* buf, size_t len, IoControl* ctl = nullptr);
 int RecvAll(int fd, void* buf, size_t len, IoControl* ctl = nullptr);
 
+// Vectored exact-length send (sendmsg scatter-gather): every byte of every
+// iovec is shipped, partial transfers advance the (caller-owned, mutated)
+// iovec array in place — one syscall per kernel-buffer-ful instead of one
+// per iovec, so header+payload pairs (length-prefixed frames, quantized
+// header+codes) leave without a staging copy or a second syscall. Same
+// IoControl semantics as SendAll. 0 on success.
+int SendAllVec(int fd, struct iovec* iov, int iovcnt,
+               IoControl* ctl = nullptr);
+
 // Full-duplex segmented transfer: streams send_bytes out of send_fd while
-// receiving recv_bytes into recv_buf, invoking on_segment(offset, length) on
-// the CALLING thread as each received segment lands — later segments keep
-// streaming in a background thread, so per-segment work (e.g. reduction)
-// overlaps the wire time. Offsets/lengths are multiples of segment_bytes
-// except the final segment. segment_bytes == 0 means one segment; a null
-// on_segment degrades to a plain concurrent send+recv. 0 on success.
-int SendRecvSegmented(int send_fd, const void* send_buf, size_t send_bytes,
-                      int recv_fd, void* recv_buf, size_t recv_bytes,
-                      size_t segment_bytes,
-                      const std::function<void(size_t, size_t)>& on_segment,
-                      IoControl* ctl = nullptr);
+// receiving recv_bytes into recv_buf, invoking on_segment(data, offset,
+// length) on the CALLING thread as each received segment lands (data ==
+// recv_buf + offset here; the shm transport's zero-copy override passes
+// in-ring views instead) — later segments keep streaming in a background
+// thread, so per-segment work (e.g. reduction) overlaps the wire time.
+// Offsets/lengths are multiples of segment_bytes except the final segment.
+// segment_bytes == 0 means one segment; a null on_segment degrades to a
+// plain concurrent send+recv. 0 on success.
+int SendRecvSegmented(
+    int send_fd, const void* send_buf, size_t send_bytes, int recv_fd,
+    void* recv_buf, size_t recv_bytes, size_t segment_bytes,
+    const std::function<void(const uint8_t*, size_t, size_t)>& on_segment,
+    IoControl* ctl = nullptr);
 
 // Length-prefixed frame: [u64 length][payload].
 int SendFrame(int fd, const std::vector<uint8_t>& payload);

@@ -29,6 +29,9 @@
 
 #include <fcntl.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
+
+#include <cstdlib>
 
 #include "autotune.h"
 #include "compressed.h"
@@ -319,12 +322,14 @@ void TestSendRecvSegmented() {
   size_t calls = 0;
   int rc = SendRecvSegmented(
       sv[0], a_send.data(), kBytes, sv[0], a_recv.data(), kBytes,
-      /*segment_bytes=*/100000, [&](size_t off, size_t len) {
-        // Segments arrive in order, disjoint, and fully landed.
+      /*segment_bytes=*/100000,
+      [&](const uint8_t* data, size_t off, size_t len) {
+        // Segments arrive in order, disjoint, and fully landed; the view
+        // pointer is buf-backed on the socket path.
         CHECK_TRUE(off == callback_bytes);
+        CHECK_TRUE(data == a_recv.data() + off);
         for (size_t i = 0; i < len; i += 9973) {
-          CHECK_TRUE(a_recv[off + i] == static_cast<uint8_t>((off + i) * 13
-                                                             + 1));
+          CHECK_TRUE(data[i] == static_cast<uint8_t>((off + i) * 13 + 1));
         }
         callback_bytes += len;
         ++calls;
@@ -346,9 +351,11 @@ void TestSendRecvSegmented() {
 // (threads) exercise exactly the cross-process protocol — and TSan/ASan see
 // every access (make check-tsan / check-asan).
 
-void TestShmRingWraparound() {
+void TestShmRingWraparoundWithBatch(int64_t doorbell_batch) {
   // Push far more than the ring capacity through in odd-sized pieces so the
-  // cursors wrap the ring many times mid-message; verify every byte.
+  // cursors wrap the ring many times mid-message; verify every byte. Runs
+  // under both doorbell protocols: 1 = legacy wake-per-advance, other =
+  // coalesced batching (the default).
   const std::string name = "/hvdtpu_test_wrap_" + std::to_string(getpid());
   auto a = ShmTransport::Create(name, /*ring_bytes=*/4096);
   CHECK_TRUE(a != nullptr);
@@ -356,6 +363,8 @@ void TestShmRingWraparound() {
   CHECK_TRUE(b != nullptr);
   if (a == nullptr || b == nullptr) return;
   a->Unlink();
+  a->set_doorbell_batch(doorbell_batch);
+  b->set_doorbell_batch(doorbell_batch);
   CHECK_TRUE(a->ring_bytes() == 4096 && b->ring_bytes() == 4096);
   const size_t kBytes = 1 << 20;  // 256 ring-fulls
   std::vector<uint8_t> sent(kBytes), got(kBytes, 0);
@@ -375,9 +384,12 @@ void TestShmRingWraparound() {
     send_rc = rc;
   });
   size_t calls = 0, cb_bytes = 0;
-  int rc = b->RecvSegmented(got.data(), kBytes, 100000,
-                            [&](size_t off, size_t len) {
+  // Zero-copy views: the payload is delivered THROUGH the callback (in-ring
+  // pointers; `got` stays scratch) — copy it out here to verify every byte.
+  int rc = b->RecvSegmented(got.data(), kBytes, 100000, /*view_align=*/1,
+                            [&](const uint8_t* data, size_t off, size_t len) {
                               CHECK_TRUE(off == cb_bytes);
+                              memcpy(got.data() + off, data, len);
                               cb_bytes += len;
                               ++calls;
                             });
@@ -390,13 +402,181 @@ void TestShmRingWraparound() {
   for (size_t i = 0; i < b2a.size(); ++i) b2a[i] = static_cast<uint8_t>(i * 13);
   std::atomic<int> b_rc{-1};
   std::thread side_b([&] {
-    b_rc = b->SendRecv(b2a.data(), b2a.size(), got.data(), kBytes, 0, nullptr);
+    b_rc = b->SendRecv(b2a.data(), b2a.size(), got.data(), kBytes, 0, 1,
+                       nullptr);
   });
-  rc = a->SendRecv(sent.data(), kBytes, a_got.data(), a_got.size(), 0,
+  rc = a->SendRecv(sent.data(), kBytes, a_got.data(), a_got.size(), 0, 1,
                    nullptr);
   side_b.join();
   CHECK_TRUE(rc == 0 && b_rc == 0);
   CHECK_TRUE(got == sent && a_got == b2a);
+}
+
+void TestShmRingWraparound() {
+  TestShmRingWraparoundWithBatch(1);  // legacy: doorbell per cursor advance
+  TestShmRingWraparoundWithBatch(0);  // 0 -> default coalescing window
+}
+
+void TestShmDoorbellBatchingCoalesces() {
+  // Deterministic single-threaded fill/drain: with no waiter registered and
+  // the batch window larger than the traffic, NO futex syscalls may happen
+  // — the whole point of coalescing is that a running peer costs zero
+  // doorbells. (The edge-wake latency contract is covered by
+  // TestShmDoorbellWakeup, which runs under the default batching.)
+  const std::string name = "/hvdtpu_test_batch_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, 4096);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(a != nullptr && b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  // Window 2048 < op 4096: the adaptive gate engages coalescing (an op
+  // smaller than the window would keep the legacy per-advance protocol).
+  a->set_doorbell_batch(2048);
+  b->set_doorbell_batch(2048);
+  std::vector<uint8_t> buf(4096), sink(4096);
+  for (size_t i = 0; i < buf.size(); ++i) buf[i] = static_cast<uint8_t>(i);
+  CHECK_TRUE(a->Send(buf.data(), buf.size()) == 0);   // exactly one ring-full
+  CHECK_TRUE(b->Recv(sink.data(), sink.size()) == 0);
+  CHECK_TRUE(sink == buf);
+  CHECK_TRUE(a->futex_wakes() == 0);
+  CHECK_TRUE(b->futex_wakes() == 0);
+  // Same traffic under the legacy protocol still works (and may wake).
+  a->set_doorbell_batch(1);
+  b->set_doorbell_batch(1);
+  CHECK_TRUE(a->Send(buf.data(), buf.size()) == 0);
+  CHECK_TRUE(b->Recv(sink.data(), sink.size()) == 0);
+  CHECK_TRUE(sink == buf);
+}
+
+void TestShmInPlaceViewsAlignedAcrossWrap() {
+  // The zero-copy view consumer: payloads are handed out as in-ring views,
+  // elem-aligned in op space even when the ring's wrap point slices an
+  // element (the 3-byte prologue skews every later wrap mid-element, so the
+  // staging path runs on every lap of the 64-byte ring).
+  const std::string name = "/hvdtpu_test_views_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, 64);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(a != nullptr && b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  uint8_t skew[3] = {9, 9, 9}, skew_got[3] = {0, 0, 0};
+  std::thread pre([&] { CHECK_TRUE(a->Send(skew, 3) == 0); });
+  CHECK_TRUE(b->Recv(skew_got, 3) == 0);
+  pre.join();
+  const size_t kWords = 1024;
+  std::vector<uint32_t> sent(kWords), got(kWords, 0);
+  for (size_t i = 0; i < kWords; ++i) {
+    sent[i] = static_cast<uint32_t>(i * 2654435761u);
+  }
+  std::atomic<int> send_rc{-1};
+  std::thread producer([&] {
+    // Odd-size pieces so element bytes trickle in across view attempts.
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(sent.data());
+    size_t off = 0, total = kWords * 4;
+    int rc = 0;
+    while (off < total && rc == 0) {
+      size_t n = std::min<size_t>(37, total - off);
+      rc = a->Send(p + off, n);
+      off += n;
+    }
+    send_rc = rc;
+  });
+  size_t cb_bytes = 0;
+  bool aligned_ok = true;
+  std::vector<uint8_t> scratch(kWords * 4);  // untouched by the views
+  int rc = b->RecvSegmented(
+      scratch.data(), kWords * 4, 0, /*view_align=*/4,
+      [&](const uint8_t* data, size_t off, size_t len) {
+        aligned_ok = aligned_ok && off % 4 == 0 && len % 4 == 0;
+        memcpy(reinterpret_cast<uint8_t*>(got.data()) + off, data, len);
+        cb_bytes += len;
+      });
+  producer.join();
+  CHECK_TRUE(rc == 0 && send_rc == 0);
+  CHECK_TRUE(aligned_ok);
+  CHECK_TRUE(cb_bytes == kWords * 4);
+  CHECK_TRUE(got == sent);
+}
+
+void TestShmViewsNeverMisaligned() {
+  // An odd-sized prologue knocks the ring cursor off the 8-byte grid; the
+  // consumer must then hand out ALIGNED view pointers anyway (the bounce
+  // path) — a typed fp64 reducer reading a misaligned view is UB that the
+  // UBSan gate aborts on (caught there first, pinned here).
+  const std::string name = "/hvdtpu_test_align_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, 4096);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(a != nullptr && b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  uint8_t skew = 0x5a, skew_got = 0;
+  std::thread pre([&] { CHECK_TRUE(a->Send(&skew, 1) == 0); });
+  CHECK_TRUE(b->Recv(&skew_got, 1) == 0);
+  pre.join();
+  const size_t kDoubles = 4096;  // 32 KB: many ring laps, all misaligned
+  std::vector<double> sent(kDoubles), got(kDoubles, 0);
+  for (size_t i = 0; i < kDoubles; ++i) sent[i] = 0.5 * (i + 1);
+  std::atomic<int> send_rc{-1};
+  std::thread producer(
+      [&] { send_rc = a->Send(sent.data(), kDoubles * 8); });
+  bool aligned_ok = true;
+  size_t cb = 0;
+  std::vector<uint8_t> scratch(kDoubles * 8);
+  int rc = b->RecvSegmented(
+      scratch.data(), kDoubles * 8, 0, /*view_align=*/8,
+      [&](const uint8_t* data, size_t off, size_t len) {
+        aligned_ok = aligned_ok &&
+                     reinterpret_cast<uintptr_t>(data) % 8 == 0 &&
+                     off % 8 == 0 && len % 8 == 0;
+        // Read THROUGH the typed lens the reducers use.
+        const double* d = reinterpret_cast<const double*>(data);
+        for (size_t i = 0; i < len / 8; ++i) {
+          reinterpret_cast<double*>(got.data())[(off / 8) + i] = d[i];
+        }
+        cb += len;
+      });
+  producer.join();
+  CHECK_TRUE(rc == 0 && send_rc == 0);
+  CHECK_TRUE(aligned_ok);
+  CHECK_TRUE(cb == kDoubles * 8);
+  CHECK_TRUE(got == sent);
+}
+
+void TestNumaProbeAndPolicy() {
+  // Sysfs probe fixtures: node<digits> entries count as nodes; an absent
+  // directory reads as single-node (probed no-op everywhere downstream).
+  char tmpl[] = "/tmp/hvdtpu_numa_XXXXXX";
+  char* dir = mkdtemp(tmpl);
+  CHECK_TRUE(dir != nullptr);
+  if (dir == nullptr) return;
+  std::string d(dir);
+  CHECK_TRUE(NumaNodeCount(d + "/missing") == 1);
+  CHECK_TRUE(mkdir((d + "/node0").c_str(), 0700) == 0);
+  CHECK_TRUE(NumaNodeCount(d) == 1);
+  CHECK_TRUE(mkdir((d + "/node1").c_str(), 0700) == 0);
+  CHECK_TRUE(mkdir((d + "/nodeX").c_str(), 0700) == 0);  // not a node
+  CHECK_TRUE(NumaNodeCount(d) == 2);
+  rmdir((d + "/node0").c_str());
+  rmdir((d + "/node1").c_str());
+  rmdir((d + "/nodeX").c_str());
+  rmdir(d.c_str());
+  // Policy application on a live segment: OFF is always a no-op; AUTO/ON
+  // must degrade cleanly (single-node host, missing syscall) and never
+  // break the rings — traffic still flows after.
+  const std::string name = "/hvdtpu_test_numa_" + std::to_string(getpid());
+  auto a = ShmTransport::Create(name, 4096);
+  auto b = ShmTransport::Open(name, 2000);
+  CHECK_TRUE(a != nullptr && b != nullptr);
+  if (a == nullptr || b == nullptr) return;
+  a->Unlink();
+  CHECK_TRUE(!a->ApplyNumaPolicy(ShmNumaMode::OFF));
+  a->ApplyNumaPolicy(ShmNumaMode::AUTO);  // no-crash; result is host-shaped
+  b->ApplyNumaPolicy(ShmNumaMode::ON);
+  uint64_t v = 0xfeedface, got = 0;
+  std::thread s([&] { CHECK_TRUE(a->Send(&v, sizeof(v)) == 0); });
+  CHECK_TRUE(b->Recv(&got, sizeof(got)) == 0);
+  s.join();
+  CHECK_TRUE(got == v);
 }
 
 void TestShmDoorbellWakeup() {
@@ -611,6 +791,149 @@ void TestShmReadDeadlineTripsOnSilentPeer() {
   CHECK_TRUE(ctl.peer_failed.load() != 0 && ctl.is_aborted());
 }
 
+// --- zero-copy TCP lane -----------------------------------------------------
+
+// Connected loopback TCP pair (real AF_INET sockets: the SO_ZEROCOPY probe
+// needs a TCP socket — AF_UNIX pairs refuse it, which is itself a fixture).
+bool MakeTcpPair(int* a, int* b) {
+  int port = 0;
+  int lfd = TcpListen(0, 1, &port);
+  if (lfd < 0) return false;
+  *a = TcpConnectRetry("127.0.0.1", port, 2000);
+  *b = TcpAccept(lfd);
+  CloseFd(lfd);
+  if (*a < 0 || *b < 0) {
+    CloseFd(*a);
+    CloseFd(*b);
+    return false;
+  }
+  return true;
+}
+
+void TestSendAllVecExactConcatenation() {
+  // Vectored scatter-gather send: three iovecs (frame-header-sized + two
+  // payload planes) must arrive as one exact byte stream, including under
+  // an IoControl and partial-transfer advancing.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  uint64_t hdr = 0x1122334455667788ull;
+  std::vector<uint8_t> p1(300000), p2(70001);
+  for (size_t i = 0; i < p1.size(); ++i) p1[i] = static_cast<uint8_t>(i * 3);
+  for (size_t i = 0; i < p2.size(); ++i) p2[i] = static_cast<uint8_t>(i * 5 + 1);
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  std::atomic<int> send_rc{-1};
+  std::thread sender([&] {
+    iovec iov[3] = {{&hdr, sizeof(hdr)},
+                    {p1.data(), p1.size()},
+                    {p2.data(), p2.size()}};
+    send_rc = SendAllVec(sv[0], iov, 3, &ctl);
+  });
+  std::vector<uint8_t> got(sizeof(hdr) + p1.size() + p2.size());
+  CHECK_TRUE(RecvAll(sv[1], got.data(), got.size()) == 0);
+  sender.join();
+  CHECK_TRUE(send_rc == 0);
+  CHECK_TRUE(memcmp(got.data(), &hdr, sizeof(hdr)) == 0);
+  CHECK_TRUE(memcmp(got.data() + sizeof(hdr), p1.data(), p1.size()) == 0);
+  CHECK_TRUE(memcmp(got.data() + sizeof(hdr) + p1.size(), p2.data(),
+                    p2.size()) == 0);
+  close(sv[0]);
+  close(sv[1]);
+}
+
+void TestZeroCopyProbeFallbackBitwise(ZeroCopyMode mode) {
+  // Forced-EOPNOTSUPP fixture: AF_UNIX sockets refuse SO_ZEROCOPY, so the
+  // probe must leave the engine disabled, the send must take the copy path
+  // bit-for-bit, and the fallback counter must record the decline.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  TcpTransport t(sv[0], 32 * 1024, &ctl, mode);
+  CHECK_TRUE(!t.zerocopy_enabled());
+  CHECK_TRUE(std::strcmp(t.kind(), "tcp") == 0);
+  const size_t kBytes = 512 * 1024;  // >= ZeroCopySender::kMinBytes
+  std::vector<uint8_t> sent(kBytes), got(kBytes, 0);
+  for (size_t i = 0; i < kBytes; ++i) sent[i] = static_cast<uint8_t>(i * 11);
+  std::atomic<int> rc{-1};
+  std::thread sender([&] { rc = t.Send(sent.data(), kBytes); });
+  CHECK_TRUE(RecvAll(sv[1], got.data(), kBytes) == 0);
+  sender.join();
+  CHECK_TRUE(rc == 0);
+  CHECK_TRUE(got == sent);  // copy path bitwise-matches the payload
+  CHECK_TRUE(t.zerocopy_sends() == 0);
+  CHECK_TRUE(t.zerocopy_fallbacks() >= 1);
+  close(sv[0]);
+  close(sv[1]);
+}
+
+void TestZeroCopyTcpSendBitwise(ZeroCopyMode mode) {
+  // The armed lane (whatever the probe lands on — MSG_ZEROCOPY, io_uring,
+  // or the copy fallback in a restricted sandbox) must deliver large
+  // payloads bit-for-bit and keep the fallback/sends accounting coherent.
+  int a = -1, b = -1;
+  CHECK_TRUE(MakeTcpPair(&a, &b));
+  if (a < 0 || b < 0) return;
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  TcpTransport t(a, 32 * 1024, &ctl, mode);
+  const size_t kBytes = 1 << 20;
+  std::vector<uint8_t> sent(kBytes), got(kBytes, 0);
+  for (size_t i = 0; i < kBytes; ++i) {
+    sent[i] = static_cast<uint8_t>(i * 7 + 3);
+  }
+  std::atomic<int> rc{-1};
+  std::thread sender([&] { rc = t.Send(sent.data(), kBytes); });
+  CHECK_TRUE(RecvAll(b, got.data(), kBytes) == 0);
+  sender.join();
+  CHECK_TRUE(rc == 0);
+  CHECK_TRUE(got == sent);
+  // Exactly one large send: it either completed zero-copy or was counted
+  // as a fallback — never silently neither.
+  CHECK_TRUE(t.zerocopy_sends() + t.zerocopy_fallbacks() >= 1);
+  // Buffer-reuse safety: mutate and resend — the drain-before-return
+  // contract means the peer must see the NEW bytes.
+  for (size_t i = 0; i < kBytes; ++i) sent[i] = static_cast<uint8_t>(i ^ 0x5a);
+  std::thread sender2([&] { rc = t.Send(sent.data(), kBytes); });
+  CHECK_TRUE(RecvAll(b, got.data(), kBytes) == 0);
+  sender2.join();
+  CHECK_TRUE(rc == 0);
+  CHECK_TRUE(got == sent);
+  CloseFd(a);
+  CloseFd(b);
+}
+
+void TestZeroCopyKilledPeerFailsFast() {
+  // Peer death mid-large-send through the zero-copy lane: the sliced
+  // completion/backpressure waits must fail the plane within a couple of
+  // slices, exactly like the copy path (docs/fault-tolerance.md).
+  int a = -1, b = -1;
+  CHECK_TRUE(MakeTcpPair(&a, &b));
+  if (a < 0 || b < 0) return;
+  IoControl ctl;
+  ctl.detect_slice_ms = 20;
+  TcpTransport t(a, 32 * 1024, &ctl, ZeroCopyMode::AUTO);
+  // Shrink the send buffer so a multi-MB send MUST block on peer drain.
+  int small = 16 * 1024;
+  setsockopt(a, SOL_SOCKET, SO_SNDBUF, &small, sizeof(small));
+  const size_t kBytes = 32 << 20;
+  std::vector<uint8_t> payload(kBytes, 0x77);
+  std::atomic<int> rc{0};
+  std::thread sender([&] { rc = t.Send(payload.data(), kBytes); });
+  // Let the send wedge against the tiny buffer, then kill the peer end.
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  auto t0 = std::chrono::steady_clock::now();
+  CloseFd(b);
+  sender.join();
+  double waited = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  CHECK_TRUE(rc == -1);
+  CHECK_TRUE(waited < 2.0);
+  CHECK_TRUE(ctl.peer_failed.load() != 0 && ctl.is_aborted());
+  CloseFd(a);
+}
+
 // --- data-plane worlds ------------------------------------------------------
 
 // One DataPlane per thread; host strings decide the lanes (same string ->
@@ -788,6 +1111,121 @@ void TestDataPlaneAllreduceAlgos() {
       }
     }
   }
+}
+
+// The probe-fallback acceptance fixture at the world level: identical
+// inputs through TCP worlds with every zero-copy mode (OFF = pure copy
+// path, ON/AUTO = armed MSG_ZEROCOPY where the kernel allows, URING = the
+// io_uring ladder, each degrading to copy under seccomp) must produce
+// BITWISE identical results — the lane may never change payload bytes.
+void TestDataPlaneZeroCopyMatchesCopyPathBitwise() {
+  const int world = 2;
+  const int64_t n = 400000;  // ~1.6 MB: hops clear ZeroCopySender::kMinBytes
+  std::vector<std::vector<float>> outputs;
+  const ZeroCopyMode modes[] = {ZeroCopyMode::OFF, ZeroCopyMode::ON,
+                                ZeroCopyMode::AUTO, ZeroCopyMode::URING};
+  for (ZeroCopyMode mode : modes) {
+    TestWorld w = MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+    for (int r = 0; r < world; ++r) {
+      w.planes[r]->set_allreduce_algo(AllreduceAlgo::RING);
+      w.planes[r]->set_segment_bytes(64 * 1024);
+      w.planes[r]->set_shm_enabled(false);  // pure TCP lanes
+      w.planes[r]->set_hier_mode(HierMode::OFF);
+      w.planes[r]->set_tcp_zerocopy(mode);
+    }
+    std::vector<std::vector<float>> bufs(world);
+    std::atomic<int> bad{0};
+    std::vector<std::thread> threads;
+    for (int r = 0; r < world; ++r) {
+      threads.emplace_back([&, r] {
+        if (!w.planes[r]->Connect(w.peers).ok()) {
+          ++bad;
+          return;
+        }
+        bufs[r].resize(n);
+        for (int64_t i = 0; i < n; ++i) {
+          // Values whose sums are not exactly representable — bitwise
+          // agreement must come from identical arithmetic, not luck.
+          bufs[r][i] = 0.1f * static_cast<float>((i % 97) + r) + 1e-3f;
+        }
+        Status st = w.planes[r]->Allreduce(bufs[r].data(), n,
+                                           DataType::FLOAT32, ReduceOp::SUM);
+        if (!st.ok()) {
+          std::fprintf(stderr, "zc world rank %d allreduce: %s\n", r,
+                       st.reason.c_str());
+          ++bad;
+        }
+      });
+    }
+    for (auto& t : threads) t.join();
+    if (bad != 0) {
+      std::fprintf(stderr, "FAIL zero-copy bitwise world mode=%d (%d bad)\n",
+                   static_cast<int>(mode), bad.load());
+      ++failures;
+    }
+    if (bad == 0) {
+      CHECK_TRUE(bufs[0] == bufs[1]);  // ranks agree within the world
+      if (mode == ZeroCopyMode::ON) {
+        // With the lane armed on real TCP, the transport label must say so
+        // (it may have legitimately downgraded only under AUTO).
+        const std::string& label = w.planes[0]->transport_label();
+        CHECK_TRUE(label == (w.planes[0]->zerocopy_active() ? "tcp-zc"
+                                                            : "tcp"));
+      }
+      outputs.push_back(bufs[0]);
+    }
+    for (auto& p : w.planes) p->Shutdown();
+  }
+  for (size_t i = 1; i < outputs.size(); ++i) {
+    CHECK_TRUE(outputs[i] == outputs[0]);  // every lane bitwise-matches OFF
+  }
+}
+
+// Chaos `drop` (silent partition) through the zero-copy send path: the
+// blackholed exchange must trip the read deadline and abort the plane, not
+// wedge inside the completion drain.
+void TestDataPlaneZeroCopyDropAborts() {
+  const int world = 2;
+  TestWorld w = MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+  for (int r = 0; r < world; ++r) {
+    w.planes[r]->set_allreduce_algo(AllreduceAlgo::RING);
+    w.planes[r]->set_shm_enabled(false);
+    w.planes[r]->set_hier_mode(HierMode::OFF);
+    w.planes[r]->set_tcp_zerocopy(ZeroCopyMode::ON);
+    w.planes[r]->set_failure_detect_ms(100);
+    w.planes[r]->set_read_deadline_secs(0.3);
+  }
+  ChaosSpec drop;
+  drop.action = ChaosSpec::Action::DROP;
+  drop.hop_index = 1;
+  drop.peer = 0;
+  w.planes[1]->set_chaos(drop);
+  const int64_t n = 400000;
+  std::atomic<int> failed{0};
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<std::thread> threads;
+  for (int r = 0; r < world; ++r) {
+    threads.emplace_back([&, r] {
+      if (!w.planes[r]->Connect(w.peers).ok()) {
+        ++failed;
+        return;
+      }
+      std::vector<float> v(n, static_cast<float>(r + 1));
+      Status st = w.planes[r]->Allreduce(v.data(), n, DataType::FLOAT32,
+                                         ReduceOp::SUM);
+      if (!st.ok()) ++failed;
+    });
+  }
+  for (auto& t : threads) t.join();
+  double waited = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+  // At least the dropped side fails (the healthy side's op breaks too once
+  // the abort cascades); nobody may hang past the deadline + slack.
+  CHECK_TRUE(failed >= 1);
+  CHECK_TRUE(waited < 10.0);
+  CHECK_TRUE(w.planes[1]->aborted());
+  for (auto& p : w.planes) p->Shutdown();
 }
 
 // Hierarchical two-level allreduce across synthetic host topologies: two
@@ -1393,7 +1831,12 @@ int main() {
   TestHalfRoundToNearestEven();
   TestReduceBufferHalfMatchesScalar();
   TestSendRecvSegmented();
+  TestSendAllVecExactConcatenation();
   TestShmRingWraparound();
+  TestShmDoorbellBatchingCoalesces();
+  TestShmInPlaceViewsAlignedAcrossWrap();
+  TestShmViewsNeverMisaligned();
+  TestNumaProbeAndPolicy();
   TestShmDoorbellWakeup();
   TestShmAbortCleanup();
   TestShmKilledPeerWakesWaiter();
@@ -1401,7 +1844,15 @@ int main() {
   TestIoControlAbortBreaksBlockedRecv();
   TestIoControlReadDeadlineTripsOnSilentPeer();
   TestShmReadDeadlineTripsOnSilentPeer();
+  TestZeroCopyProbeFallbackBitwise(ZeroCopyMode::ON);
+  TestZeroCopyProbeFallbackBitwise(ZeroCopyMode::AUTO);
+  TestZeroCopyTcpSendBitwise(ZeroCopyMode::ON);
+  TestZeroCopyTcpSendBitwise(ZeroCopyMode::AUTO);
+  TestZeroCopyTcpSendBitwise(ZeroCopyMode::URING);
+  TestZeroCopyKilledPeerFailsFast();
   TestDataPlaneAllreduceAlgos();
+  TestDataPlaneZeroCopyMatchesCopyPathBitwise();
+  TestDataPlaneZeroCopyDropAborts();
   TestDataPlaneHierarchicalAllreduce();
   TestWireQuantizerRoundTrip();
   TestWireInt4PackingAndTail();

@@ -79,6 +79,23 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--shm-ring-bytes", type=int, default=None,
                    help="per-direction shm ring capacity in bytes "
                         "(HVDTPU_SHM_RING_BYTES; default 1 MB)")
+    p.add_argument("--tcp-zerocopy", default=None,
+                   choices=sorted(ev.TCP_ZEROCOPY_MODES),
+                   help="zero-copy TCP send lane (HVDTPU_TCP_ZEROCOPY): "
+                        "'auto' (default) probes MSG_ZEROCOPY per lane and "
+                        "backs off where the kernel copies anyway; 'on' "
+                        "keeps a successful probe armed; 'uring' tries an "
+                        "io_uring submission ring first; 'off' forces the "
+                        "copy path")
+    p.add_argument("--shm-numa", default=None,
+                   choices=sorted(ev.SHM_NUMA_MODES),
+                   help="NUMA placement of the shm rings (HVDTPU_SHM_NUMA): "
+                        "each rank pins its inbound ring to its own node; "
+                        "'auto' (default) only on multi-node hosts")
+    p.add_argument("--doorbell-batch", type=int, default=None,
+                   help="futex-doorbell coalescing window in bytes for the "
+                        "shm rings (HVDTPU_DOORBELL_BATCH): 0 = built-in "
+                        "default (256 KB), 1 = wake on every cursor advance")
     p.add_argument("--compression", default=None,
                    choices=["none", "fp16", "int8", "int4", "auto"],
                    help="wire compression for the native allreduce data "
@@ -271,6 +288,16 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_SHM] = "0"
     if args.shm_ring_bytes is not None:
         env[ev.HVDTPU_SHM_RING_BYTES] = str(args.shm_ring_bytes)
+    # Zero-copy lane: the flags own the knobs only when passed (a
+    # user-exported HVDTPU_TCP_ZEROCOPY/... wins otherwise, like HVDTPU_SHM).
+    if args.tcp_zerocopy is not None:
+        env[ev.HVDTPU_TCP_ZEROCOPY] = args.tcp_zerocopy
+    if args.shm_numa is not None:
+        env[ev.HVDTPU_SHM_NUMA] = args.shm_numa
+    if args.doorbell_batch is not None:
+        if args.doorbell_batch < 0:
+            raise SystemExit("hvdrun: --doorbell-batch must be >= 0")
+        env[ev.HVDTPU_DOORBELL_BATCH] = str(args.doorbell_batch)
     # Wire compression: the flag owns the knob only when passed (a
     # user-exported HVDTPU_COMPRESSION wins otherwise, like HVDTPU_SHM).
     if args.compression is not None:

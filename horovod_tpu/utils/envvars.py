@@ -75,6 +75,27 @@ ALLREDUCE_HIER_MODES = {"0": 0, "off": 0, "false": 0,
                         "1": 1, "on": 1, "true": 1,
                         "auto": 2, "": 2}
 
+# Zero-copy transport lane (native/transport.h ZeroCopySender +
+# shm_transport.h; docs/collectives.md "Zero-copy TCP lane"). TCP_ZEROCOPY:
+# "auto" (default) probes SO_ZEROCOPY per lane at Connect and backs off to
+# the copy path when the kernel reports it copied anyway (loopback); "on"
+# keeps a successful probe armed; "off" never probes; "uring" probes an
+# io_uring submission ring first (SEND_ZC where the kernel has it) and
+# falls down the same ladder. SHM_NUMA: NUMA placement of the shm rings —
+# each side pins its inbound ring to its own node ("auto": only on
+# multi-node hosts, probed via /sys/devices/system/node). DOORBELL_BATCH:
+# futex-doorbell coalescing window in bytes (0 = built-in default, 1 =
+# wake on every cursor advance — the pre-PR-9 behavior).
+HVDTPU_TCP_ZEROCOPY = "HVDTPU_TCP_ZEROCOPY"
+HVDTPU_SHM_NUMA = "HVDTPU_SHM_NUMA"
+HVDTPU_DOORBELL_BATCH = "HVDTPU_DOORBELL_BATCH"
+
+# Valid HVDTPU_TCP_ZEROCOPY values, mapped to hvdtpu::ZeroCopyMode.
+TCP_ZEROCOPY_MODES = {"auto": 0, "on": 1, "off": 2, "uring": 3}
+
+# Valid HVDTPU_SHM_NUMA values, mapped to hvdtpu::ShmNumaMode.
+SHM_NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
+
 # Response cache (reference: HOROVOD_CACHE_CAPACITY)
 HVDTPU_CACHE_CAPACITY = "HVDTPU_CACHE_CAPACITY"
 

@@ -24,7 +24,9 @@ import argparse
 import ctypes
 import json
 import os
+import random
 import socket
+import statistics
 import subprocess
 import sys
 import time
@@ -34,6 +36,15 @@ DEFAULT_LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvdtpu_core.so")
 
 ALGOS = {"auto": 0, "ring": 1, "recursive_doubling": 2, "tree": 3}
 HIER_MODES = {"off": 0, "on": 1, "auto": 2}
+# hvdtpu::ZeroCopyMode / hvdtpu::ShmNumaMode (native/transport.h,
+# shm_transport.h).
+ZC_MODES = {"auto": 0, "on": 1, "off": 2, "uring": 3}
+NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
+# Knobs the paired --ab mode may flip between the two arms of a pair.
+# "lib" pairs two .so builds (the HEAD-vs-new gate that used to run as two
+# unpaired sweeps, ±10% drift windows apart, on this box).
+AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
+            "doorbell-batch", "shm-ring-bytes", "segment", "lib")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -96,6 +107,12 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_longlong)]
     except AttributeError:
         pass  # pre-compression build: raw wire only
+    try:
+        lib.hvdtpu_set_transport_ext.restype = ctypes.c_int
+        lib.hvdtpu_set_transport_ext.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong]
+    except AttributeError:
+        pass  # pre-zero-copy build
     return lib
 
 
@@ -155,6 +172,17 @@ def run_worker(args) -> int:
                                    0, b"")
     elif args.compression != "none":
         print("SKIP compression config: library has no wire compression",
+              file=sys.stderr)
+        return 0
+    if hasattr(lib, "hvdtpu_set_transport_ext"):
+        lib.hvdtpu_set_transport_ext(core, ZC_MODES[args.tcp_zerocopy],
+                                     NUMA_MODES[args.shm_numa],
+                                     args.doorbell_batch)
+    elif args.tcp_zerocopy not in ("auto", "off") or \
+            args.shm_numa != "auto" or args.doorbell_batch not in (0, 1):
+        # Never silently drop an explicitly requested knob on an old
+        # library — an A/B would measure identical arms and report 1.0x.
+        print("SKIP zero-copy config: library has no zero-copy lane",
               file=sys.stderr)
         return 0
     err = ctypes.create_string_buffer(1024)
@@ -237,21 +265,36 @@ def free_port() -> int:
     return port
 
 
-def run_config(args, world: int, algo: str, sizes: list) -> tuple:
+def run_config(args, world: int, algo: str, sizes: list,
+               overrides: dict = None) -> tuple:
     """Returns (rows, failed): rows from rank 0, failed=True when any rank
-    exited nonzero or timed out (rows may still be partial)."""
+    exited nonzero or timed out (rows may still be partial). `overrides`
+    maps AB_FLAGS-style flag names (dashes) to per-run values — the paired
+    --ab mode flips exactly one knob between the two arms of each pair."""
+    cfg = {"transport": args.transport, "hier": args.hier,
+           "compression": args.compression,
+           "tcp-zerocopy": args.tcp_zerocopy, "shm-numa": args.shm_numa,
+           "doorbell-batch": args.doorbell_batch,
+           "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
+           "lib": args.lib}
+    if overrides:
+        cfg.update(overrides)
     port = free_port()
     procs = []
     for r in range(world):
         cmd = [sys.executable, os.path.abspath(__file__), "--worker",
                "--rank", str(r), "--world", str(world), "--port", str(port),
                "--algo", algo, "--sizes", ",".join(map(str, sizes)),
-               "--lib", args.lib, "--dtype", args.dtype,
+               "--lib", str(cfg["lib"]), "--dtype", args.dtype,
                "--crossover", str(args.crossover),
-               "--segment", str(args.segment),
-               "--transport", args.transport, "--hier", args.hier,
-               "--shm-ring-bytes", str(args.shm_ring_bytes),
-               "--compression", args.compression,
+               "--segment", str(cfg["segment"]),
+               "--transport", str(cfg["transport"]),
+               "--hier", str(cfg["hier"]),
+               "--shm-ring-bytes", str(cfg["shm-ring-bytes"]),
+               "--compression", str(cfg["compression"]),
+               "--tcp-zerocopy", str(cfg["tcp-zerocopy"]),
+               "--shm-numa", str(cfg["shm-numa"]),
+               "--doorbell-batch", str(cfg["doorbell-batch"]),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -278,9 +321,121 @@ def run_config(args, world: int, algo: str, sizes: list) -> tuple:
                 p.communicate()
     for row in rows:
         row.update({"world": world, "algo": algo, "dtype": args.dtype,
-                    "transport": args.transport, "hier": args.hier,
-                    "compression": args.compression})
+                    "transport": cfg["transport"], "hier": cfg["hier"],
+                    "compression": cfg["compression"],
+                    "tcp_zerocopy": cfg["tcp-zerocopy"],
+                    "shm_numa": cfg["shm-numa"],
+                    "doorbell_batch": cfg["doorbell-batch"]})
     return rows, failed
+
+
+def bootstrap_ci(ratios: list, resamples: int = 2000,
+                 seed: int = 12345) -> tuple:
+    """95% bootstrap CI on the median of `ratios` (resample-with-replacement
+    medians, 2.5/97.5 percentiles). Deterministic seed: the A/B gate must be
+    reproducible from the same measurements."""
+    rng = random.Random(seed)
+    meds = sorted(
+        statistics.median(rng.choices(ratios, k=len(ratios)))
+        for _ in range(resamples))
+    lo = meds[max(0, int(0.025 * resamples) - 1)]
+    hi = meds[min(resamples - 1, int(0.975 * resamples))]
+    return lo, hi
+
+
+def run_ab(args, sizes: list, worlds: list, algos: list) -> int:
+    """Paired interleaved A/B: for each (world, algo) the two arms run
+    back-to-back --pairs times (A,B,A,B,...), so slow drift on a shared box
+    cancels inside each pair instead of biasing whole unpaired windows
+    (docs/benchmarks.md noted ±10% drift between unpaired runs). The JSON
+    report carries the per-size median-of-pairs ratio (avg_s A / avg_s B,
+    i.e. >1 = B faster) with a 95% bootstrap CI."""
+    flag, _, vals = args.ab.partition("=")
+    if flag not in AB_FLAGS or ":" not in vals:
+        print(f"--ab must be <flag>=<A>:<B> with flag in {AB_FLAGS}",
+              file=sys.stderr)
+        return 2
+    val_a, _, val_b = vals.partition(":")
+    report = {"lib": args.lib, "dtype": args.dtype, "ab": {
+        "flag": flag, "a": val_a, "b": val_b, "pairs": args.pairs,
+        "configs": []}}
+    worst_failed = False
+    for world in worlds:
+        for algo in algos:
+            per_size = {b: {"a": [], "b": []} for b in sizes}
+            failed = False
+            for pair in range(args.pairs):
+                for arm, val in (("a", val_a), ("b", val_b)):
+                    rows, bad = run_config(args, world, algo, sizes,
+                                           {flag: val})
+                    failed |= bad
+                    for row in rows:
+                        per_size[row["bytes"]][arm].append(row["avg_s"])
+                print(f"[ab world={world} algo={algo}] pair {pair + 1}/"
+                      f"{args.pairs} done", file=sys.stderr)
+            entry = {"world": world, "algo": algo, "failed": failed,
+                     "sizes": []}
+            for nbytes in sizes:
+                a_times = per_size[nbytes]["a"]
+                b_times = per_size[nbytes]["b"]
+                n = min(len(a_times), len(b_times))
+                if n == 0:
+                    entry["sizes"].append({"bytes": nbytes, "pairs": 0})
+                    continue
+                ratios = [a_times[i] / b_times[i] for i in range(n)]
+                med = statistics.median(ratios)
+                lo, hi = bootstrap_ci(ratios)
+                entry["sizes"].append({
+                    "bytes": nbytes, "pairs": n,
+                    "median_ratio_b_over_a": round(med, 4),
+                    "ci95": [round(lo, 4), round(hi, 4)],
+                    "a_avg_s": a_times, "b_avg_s": b_times})
+                print(f"[ab world={world} algo={algo}] {human(nbytes)}: "
+                      f"B/A speedup {med:.3f}x (95% CI {lo:.3f}..{hi:.3f})",
+                      file=sys.stderr)
+            worst_failed |= failed
+            report["ab"]["configs"].append(entry)
+    text = json.dumps(report, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 1 if worst_failed else 0
+
+
+def run_smoke(args) -> int:
+    """CI bench-smoke stage (scripts/ci_checks.sh): a tiny 2-proc matrix
+    over both lanes that fails only on crash / format regressions, so
+    transport changes cannot silently break the A/B gate of record."""
+    required = ("bytes", "iters", "avg_s", "algbw_gbps", "busbw_gbps",
+                "world", "algo", "transport", "hier", "compression")
+    ok = True
+    for transport in ("tcp", "shm"):
+        rows, failed = run_config(args, 2, "ring", [4096, 1 << 20],
+                                  {"transport": transport})
+        if failed:
+            print(f"bench-smoke: {transport} config crashed",
+                  file=sys.stderr)
+            ok = False
+            continue
+        if len(rows) != 2:
+            print(f"bench-smoke: {transport} produced {len(rows)} rows, "
+                  "want 2", file=sys.stderr)
+            ok = False
+            continue
+        for row in rows:
+            missing = [k for k in required if k not in row]
+            if missing:
+                print(f"bench-smoke: {transport} row missing {missing}",
+                      file=sys.stderr)
+                ok = False
+            elif not (row["avg_s"] > 0 and row["algbw_gbps"] > 0):
+                print(f"bench-smoke: {transport} row has non-positive "
+                      f"timings: {row}", file=sys.stderr)
+                ok = False
+        print(f"bench-smoke: {transport} OK (4 KB + 1 MB)", file=sys.stderr)
+    print(f"bench-smoke: {'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    return 0 if ok else 1
 
 
 def human(nbytes: int) -> str:
@@ -322,6 +477,25 @@ def main(argv=None) -> int:
                    choices=sorted(COMPRESSION),
                    help="wire compression for the sweep (the compressed-vs-"
                         "raw A/B: run once with none, once with int8)")
+    p.add_argument("--tcp-zerocopy", default="auto", choices=sorted(ZC_MODES),
+                   help="zero-copy TCP send lane (HVDTPU_TCP_ZEROCOPY)")
+    p.add_argument("--shm-numa", default="auto", choices=sorted(NUMA_MODES),
+                   help="NUMA placement of the shm rings (HVDTPU_SHM_NUMA)")
+    p.add_argument("--doorbell-batch", type=int, default=0,
+                   help="shm futex-doorbell coalescing window, bytes "
+                        "(0 = default, 1 = wake per cursor advance)")
+    p.add_argument("--ab", default=None, metavar="FLAG=A:B",
+                   help="paired interleaved A/B over one knob, e.g. "
+                        "'doorbell-batch=1:0' or 'tcp-zerocopy=off:on': "
+                        "each (world, algo) runs --pairs back-to-back "
+                        "A,B pairs and the JSON reports the per-size "
+                        "median-of-pairs speedup with a 95%% bootstrap CI "
+                        f"(flags: {', '.join(AB_FLAGS)})")
+    p.add_argument("--pairs", type=int, default=5,
+                   help="interleaved pairs per config in --ab mode")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI smoke: 2-proc 4KB/1MB over tcp+shm, fail only "
+                        "on crash/format regressions")
     p.add_argument("--cycle-time-ms", type=float, default=1.0)
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--quick", action="store_true",
@@ -336,6 +510,9 @@ def main(argv=None) -> int:
         print(f"native library not found: {args.lib} (make -C "
               f"horovod_tpu/native)", file=sys.stderr)
         return 1
+    if args.smoke:
+        args.timeout = min(args.timeout, 300.0)
+        return run_smoke(args)
     sizes = parse_sizes(args)
     worlds = [int(w) for w in args.world_sizes.split(",")]
     algos = args.algos.split(",")
@@ -347,6 +524,8 @@ def main(argv=None) -> int:
             print(f"unknown algo {a!r}; choices: {sorted(ALGOS)}",
                   file=sys.stderr)
             return 2
+    if args.ab:
+        return run_ab(args, sizes, worlds, algos)
 
     results = []
     failed_configs = []

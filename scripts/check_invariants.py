@@ -529,6 +529,11 @@ def check_enum_mirrors(root: Path, findings, ran):
     # ChaosSpec::Action is nested, but the enum-class regex doesn't care.
     dict_pair("ChaosAction", f"{NATIVE_DIR}/data_plane.h", "Action",
               "horovod_tpu/chaos.py", "CHAOS_ACTIONS")
+    # Zero-copy transport lane modes (PR 9).
+    dict_pair("ZeroCopyMode", f"{NATIVE_DIR}/transport.h", "ZeroCopyMode",
+              ENVVARS_PY, "TCP_ZEROCOPY_MODES")
+    dict_pair("ShmNumaMode", f"{NATIVE_DIR}/shm_transport.h", "ShmNumaMode",
+              ENVVARS_PY, "SHM_NUMA_MODES")
 
     # ReduceOp: IntEnum mirror, names compared verbatim.
     cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/common.h", "ReduceOp")

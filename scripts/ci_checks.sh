@@ -34,6 +34,10 @@ run_check "check"       make check
 run_check "check-tsan"  make check-tsan
 run_check "check-asan"  make check-asan
 run_check "check-ubsan" make check-ubsan
+# Tiny 2-proc bench matrix (4KB/1MB over tcp+shm) through the real harness:
+# fails only on crash/format regressions, so transport changes cannot
+# silently break the paired-A/B gate of record (scripts/bench_native_allreduce.py).
+run_check "bench-smoke" python3 scripts/bench_native_allreduce.py --smoke
 # Fast chaos smoke (docs/fault-tolerance.md): one SIGKILL + one hang on the
 # tcp ring, through the real elastic driver — proves detection + recovery
 # end to end. The full {algo x transport x hier x compression} matrix lives

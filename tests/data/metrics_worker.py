@@ -66,7 +66,8 @@ lbl, lbl_count = max(op_samples, key=lambda s: s[1])
 assert lbl_count >= 3, op_samples
 assert lbl["algo"] in ("ring", "recursive_doubling", "tree",
                        "hierarchical", "adasum"), lbl
-assert lbl["transport"] in ("shm", "tcp", "shm+tcp"), lbl
+assert lbl["transport"] in ("shm", "tcp", "tcp-zc", "shm+tcp",
+                            "shm+tcp-zc"), lbl
 assert lbl["hier"] in ("0", "1") and lbl["dtype"] == "float32", lbl
 # Matching bytes histogram under the same label set.
 assert (sample_value(m, "hvdtpu_op_bytes", suffix="count", **lbl) or 0) \

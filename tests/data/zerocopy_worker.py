@@ -1,0 +1,46 @@
+"""Worker: zero-copy transport lane knobs through the full process-mode
+stack (ISSUE 9).
+
+Runs with HVDTPU_SHM=0 so every lane is real TCP, HVDTPU_TCP_ZEROCOPY from
+the test (auto/on/off/uring), and payloads large enough that each ring hop
+clears the zero-copy engine's size floor. Asserts allreduce correctness
+(the lane must be payload-transparent on every probe outcome) and that the
+zero-copy accounting counters exist and tell a coherent story: at least
+one large send either completed zero-copy or was counted as a fallback —
+never silently neither (unless the lane was configured off).
+"""
+import os
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.observability import sample_value  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+mode = (os.environ.get("HVDTPU_TCP_ZEROCOPY") or "auto").lower()
+
+count = 1 << 19  # 2 MB fp32: every ring hop clears the 128 KB zc floor
+for i in range(3):
+    x = np.full(count, float(r + i + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, name=f"grad/big{i}", op=hvd.Sum))
+    np.testing.assert_allclose(
+        out, np.full(count, sum(q + i + 1 for q in range(n)), np.float32))
+
+m = hvd.metrics()
+sends = sample_value(m, "hvdtpu_zerocopy_sends_total")
+fallbacks = sample_value(m, "hvdtpu_zerocopy_fallbacks_total")
+assert sends is not None and fallbacks is not None, m.keys()
+if mode == "off":
+    # Lane configured off: no zero-copy attempts, no fallback accounting.
+    assert sends == 0 and fallbacks == 0, (sends, fallbacks)
+else:
+    # Large sends happened; each either rode the lane or fell back.
+    assert sends + fallbacks >= 1, (sends, fallbacks)
+
+hvd.shutdown()
+print(f"ALL OK zerocopy mode={mode} sends={sends} fallbacks={fallbacks}")

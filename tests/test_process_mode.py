@@ -173,7 +173,7 @@ def test_hvdrun_cli(tmp_path):
     # setup fell back; never absent).
     lanes = {e.get("args", {}).get("transport")
              for e in events if e["name"] == "ALLREDUCE"}
-    assert lanes & {"shm", "tcp", "shm+tcp"}, lanes
+    assert lanes & {"shm", "tcp", "tcp-zc", "shm+tcp", "shm+tcp-zc"}, lanes
 
 
 def test_programmatic_run():

@@ -150,6 +150,40 @@ class TestArgParsing:
                                "python", "x.py"])
             _apply_tuning_env({}, args)
 
+    def test_zerocopy_lane_flags(self):
+        """--tcp-zerocopy/--shm-numa/--doorbell-batch land in the workers'
+        env as HVDTPU_TCP_ZEROCOPY/_SHM_NUMA/_DOORBELL_BATCH (ISSUE 9); no
+        flag keeps the knobs out (user-exported env wins; native defaults
+        auto/auto/256 KiB)."""
+        from horovod_tpu.runner.launch import _apply_tuning_env
+        from horovod_tpu.utils import envvars as ev
+
+        args = parse_args(["-np", "2", "--tcp-zerocopy", "uring",
+                           "--shm-numa", "on", "--doorbell-batch", "65536",
+                           "python", "x.py"])
+        assert args.tcp_zerocopy == "uring"
+        env = _apply_tuning_env({}, args)
+        assert env[ev.HVDTPU_TCP_ZEROCOPY] == "uring"
+        assert env[ev.HVDTPU_SHM_NUMA] == "on"
+        assert env[ev.HVDTPU_DOORBELL_BATCH] == "65536"
+        args = parse_args(["-np", "2", "python", "x.py"])
+        env = _apply_tuning_env({}, args)
+        assert ev.HVDTPU_TCP_ZEROCOPY not in env
+        assert ev.HVDTPU_SHM_NUMA not in env
+        assert ev.HVDTPU_DOORBELL_BATCH not in env
+
+    def test_zerocopy_lane_flags_reject_bad_values(self):
+        from horovod_tpu.runner.launch import _apply_tuning_env
+        with pytest.raises(SystemExit):
+            parse_args(["-np", "2", "--tcp-zerocopy", "always",
+                        "python", "x.py"])
+        with pytest.raises(SystemExit):
+            parse_args(["-np", "2", "--shm-numa", "2", "python", "x.py"])
+        with pytest.raises(SystemExit):
+            args = parse_args(["-np", "2", "--doorbell-batch", "-1",
+                               "python", "x.py"])
+            _apply_tuning_env({}, args)
+
 
 class TestPythonPlaceholder:
     """Per-slot interpreter substitution (a mixed local+remote job cannot

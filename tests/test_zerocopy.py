@@ -1,0 +1,106 @@
+"""Zero-copy transport lane (ISSUE 9): process-mode coverage of the
+HVDTPU_TCP_ZEROCOPY / HVDTPU_SHM_NUMA / HVDTPU_DOORBELL_BATCH knobs through
+the full stack, plus the paired-A/B bench harness units (median-of-pairs +
+bootstrap CI — the unpaired ±10% drift fix of record).
+
+The native-layer coverage (probe fallback bitwise-matching the copy path,
+killed-peer and chaos-drop through the zero-copy send path, doorbell
+batching, in-place ring views, NUMA probe fixtures) lives in
+horovod_tpu/native/unit_tests.cpp under make check / check-tsan /
+check-asan / check-ubsan.
+"""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import REPO_ROOT, assert_all_ok, launch_world
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+WORKER = os.path.join(DATA, "zerocopy_worker.py")
+
+
+def _bench_module():
+    spec = importlib.util.spec_from_file_location(
+        "bench_native_allreduce",
+        os.path.join(REPO_ROOT, "scripts", "bench_native_allreduce.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("mode", ["auto", "on", "off"])
+def test_zerocopy_world_tcp(mode):
+    """2-rank all-TCP world per zero-copy mode: payload-transparent results
+    and coherent hvdtpu_zerocopy_{sends,fallbacks}_total accounting.
+    Mode "on" keeps the lane armed past the kernel-copied backoff, so it is
+    the one that exercises sustained MSG_ZEROCOPY under the optmem_max
+    pinned-page budget (the ENOBUFS backpressure path)."""
+    results = launch_world(2, WORKER, extra_env={
+        "HVDTPU_SHM": "0", "HVDTPU_TCP_ZEROCOPY": mode})
+    assert_all_ok(results)
+    assert all("zerocopy mode=" in out for _, out, _ in results), results
+
+
+@pytest.mark.slow
+def test_zerocopy_world_uring_mode():
+    """uring mode must work wherever the probe lands (a seccomp'd container
+    degrades through MSG_ZEROCOPY to the copy path)."""
+    results = launch_world(2, WORKER, extra_env={
+        "HVDTPU_SHM": "0", "HVDTPU_TCP_ZEROCOPY": "uring"})
+    assert_all_ok(results)
+
+
+def test_shm_world_with_legacy_doorbells_and_numa_off():
+    """The legacy wake-per-advance doorbell protocol and explicit NUMA
+    modes still carry a correct shm world end to end."""
+    results = launch_world(2, WORKER, extra_env={
+        "HVDTPU_DOORBELL_BATCH": "1", "HVDTPU_SHM_NUMA": "off",
+        "HVDTPU_TCP_ZEROCOPY": "off"})
+    assert_all_ok(results)
+
+
+def test_bad_zerocopy_mode_fails_init_loudly():
+    """A typo'd HVDTPU_TCP_ZEROCOPY must fail init with a clear error on
+    every rank, not silently run on some default."""
+    results = launch_world(1, WORKER, extra_env={
+        "HVDTPU_SHM": "0", "HVDTPU_TCP_ZEROCOPY": "always"})
+    rc, _, err = results[0]
+    assert rc != 0
+    assert "HVDTPU_TCP_ZEROCOPY" in err
+
+
+def test_bootstrap_ci_is_deterministic_and_brackets_median():
+    bench = _bench_module()
+    ratios = [1.1, 1.2, 1.15, 1.3, 1.18, 1.22, 1.12]
+    lo, hi = bench.bootstrap_ci(ratios)
+    lo2, hi2 = bench.bootstrap_ci(ratios)
+    assert (lo, hi) == (lo2, hi2)  # fixed seed: the gate is reproducible
+    assert min(ratios) <= lo <= hi <= max(ratios)
+    import statistics
+    assert lo <= statistics.median(ratios) <= hi
+    # A clean >1.15x sample set must produce a CI excluding 1.0 — the
+    # acceptance-criterion shape.
+    assert lo > 1.0
+
+
+def test_ab_flag_validation():
+    bench = _bench_module()
+    # Malformed --ab specs exit 2 without spawning any worlds.
+    assert bench.main(["--ab", "nonsense", "--lib", sys.executable]) == 2
+    assert bench.main(["--ab", "transport=shm", "--lib", sys.executable]) == 2
+
+
+@pytest.mark.slow
+def test_bench_smoke_mode():
+    """The ci_checks.sh bench-smoke stage: tiny 2-proc matrix over tcp+shm,
+    crash/format regressions only."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "scripts", "bench_native_allreduce.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "bench-smoke: PASS" in proc.stderr
