@@ -44,7 +44,7 @@ _ALLREDUCE_ALGOS = {name: code
 # both languages to the same values: a silent tag drift would corrupt the
 # control plane, not crash it.
 _CTRL_MSGS = {"hello": 1, "peers": 2, "ready": 3, "responses": 4, "join": 5,
-              "need_full": 6, "params": 7}
+              "need_full": 6, "params": 7, "clock": 8}
 _RESPONSE_TYPES = {"ok": 0, "error": 1, "join_done": 2, "shutdown": 3}
 
 
@@ -150,6 +150,15 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_start_timeline.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                           ctypes.c_int]
     lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_set_trace.restype = ctypes.c_int
+    lib.hvdtpu_set_trace.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                     ctypes.c_double]
+    lib.hvdtpu_start_trace.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_longlong]
+    lib.hvdtpu_clock_offset.restype = None
+    lib.hvdtpu_clock_offset.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong)]
     lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
     lib.hvdtpu_cycle_time_ms.argtypes = [ctypes.c_void_p]
     lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
@@ -204,7 +213,29 @@ class NativeCore:
         my_host = ev.get_str(ev.HVDTPU_HOSTNAME, "127.0.0.1")
         cycle_ms = ev.get_float(ev.HVDTPU_CYCLE_TIME, 1.0)
         fusion = ev.get_int(ev.HVDTPU_FUSION_THRESHOLD, 64 * 1024 * 1024)
+        # Distributed tracing (docs/tracing.md): HVDTPU_TRACE names a
+        # DIRECTORY — each rank writes trace.<rank>.json there with per-hop
+        # child spans sampled every HVDTPU_TRACE_SAMPLE ops. An explicit
+        # HVDTPU_TIMELINE wins for the output path (the spans then ride the
+        # timeline file).
+        trace_dir = ev.get_str(ev.HVDTPU_TRACE, "") or ""
+        # "Configured" means the user expressed a sampling choice (the env
+        # var, or tracing enabled at launch); a later hvd.start_trace with
+        # sample=None falls back to the documented default only when they
+        # did NOT (an explicit HVDTPU_TRACE_SAMPLE=0 stays op-phases-only).
+        self._trace_sample_configured = (
+            ev.get_str(ev.HVDTPU_TRACE_SAMPLE) is not None or bool(trace_dir))
+        trace_sample = ev.get_int(
+            ev.HVDTPU_TRACE_SAMPLE,
+            ev.DEFAULT_TRACE_SAMPLE if trace_dir else 0)
+        if trace_sample < 0:
+            raise ValueError(
+                f"{ev.HVDTPU_TRACE_SAMPLE} must be >= 0 (every Nth op; "
+                f"0 disables hop spans), got {trace_sample}")
         timeline = ev.get_str(ev.HVDTPU_TIMELINE, "") or ""
+        if trace_dir and not timeline:
+            os.makedirs(trace_dir, exist_ok=True)
+            timeline = os.path.join(trace_dir, f"trace.{rank}.json")
         mark_cycles = ev.get_bool(ev.HVDTPU_TIMELINE_MARK_CYCLES)
         stall = ev.get_float(ev.HVDTPU_STALL_CHECK_TIME_SECONDS, 60.0)
         if ev.get_bool(ev.HVDTPU_STALL_CHECK_DISABLE):
@@ -215,6 +246,14 @@ class NativeCore:
             cross_size if cross_size is not None else size,
             coord_host.encode(), coord_port, my_host.encode(), cycle_ms,
             fusion, timeline.encode(), int(mark_cycles), stall)
+        # Distributed tracing: every-Nth-op hop-span sampling + the
+        # control-plane clock-refresh period (docs/tracing.md).
+        clock_sync = ev.get_float(ev.HVDTPU_TRACE_CLOCK_SYNC_SECONDS, 30.0)
+        if clock_sync <= 0:
+            raise ValueError(
+                f"{ev.HVDTPU_TRACE_CLOCK_SYNC_SECONDS} must be > 0 seconds, "
+                f"got {clock_sync}")
+        self._lib.hvdtpu_set_trace(self._core, trace_sample, clock_sync)
         # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
         self._lib.hvdtpu_set_cache_capacity(
             self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
@@ -488,6 +527,37 @@ class NativeCore:
         """Stop a running timeline (reference: ``horovod_stop_timeline``,
         operations.cc:780)."""
         self._lib.hvdtpu_stop_timeline(self._core)
+
+    def start_trace(self, path: str, sample: Optional[int] = None,
+                    mark_cycles: bool = False) -> None:
+        """Begin a distributed trace at runtime: a timeline whose per-hop
+        child spans are sampled every ``sample`` ops (None keeps the
+        configured ``HVDTPU_TRACE_SAMPLE`` rate; the file also carries the
+        clock metadata ``scripts/trace_analyze.py`` merges on). See
+        docs/tracing.md."""
+        if sample is not None and sample < 0:
+            raise ValueError(f"sample must be >= 0, got {sample}")
+        if sample is None and not self._trace_sample_configured:
+            # Tracing was never configured at init (cfg rate is 0): a
+            # runtime start_trace must still produce hop spans by default.
+            sample = ev.DEFAULT_TRACE_SAMPLE
+        self._lib.hvdtpu_start_trace(self._core, path.encode(),
+                                     int(mark_cycles),
+                                     -1 if sample is None else int(sample))
+
+    def stop_trace(self) -> None:
+        """Stop a running distributed trace (== stop_timeline)."""
+        self._lib.hvdtpu_stop_timeline(self._core)
+
+    def clock_offset(self) -> tuple:
+        """(offset_us, err_us): this rank's steady-clock offset vs rank 0
+        with its error bound, from the form-up ping-pong sync (refreshed
+        periodically while tracing). err_us < 0 = never synced."""
+        off = ctypes.c_longlong(0)
+        err = ctypes.c_longlong(-1)
+        self._lib.hvdtpu_clock_offset(self._core, ctypes.byref(off),
+                                      ctypes.byref(err))
+        return off.value, err.value
 
     def cycle_time_ms(self) -> float:
         """Current (possibly autotuned) background cycle time."""

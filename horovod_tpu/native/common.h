@@ -6,6 +6,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -88,6 +89,20 @@ class SCOPED_CAPABILITY MutexLock {
 class CondVar {
  public:
   void Wait(MutexLock& lk) { cv_.wait(lk.native_handle()); }
+  // Timed wait (spurious wakeups allowed, like Wait): for consumers that
+  // drain on a period instead of being notified per item — the timeline
+  // writer batches its queue this way so emitters never pay a wakeup.
+  // wait_until on system_clock (not wait_for): libstdc++'s steady-clock
+  // wait_for lowers to pthread_cond_clockwait, which this toolchain's TSan
+  // does not intercept — it then loses the unlock inside the wait and
+  // reports phantom double-locks/races. pthread_cond_timedwait (the
+  // system_clock path) is intercepted; a realtime jump at worst stretches
+  // one backstop period.
+  void WaitForMs(MutexLock& lk, int ms) {
+    cv_.wait_until(lk.native_handle(),
+                   std::chrono::system_clock::now() +
+                       std::chrono::milliseconds(ms));
+  }
   void NotifyOne() { cv_.notify_one(); }
   void NotifyAll() { cv_.notify_all(); }
 
@@ -193,6 +208,10 @@ struct TensorEntry {
   // Output buffer: owned by the core, copied out by the caller after wait.
   std::vector<uint8_t> output;
   int32_t handle = -1;
+  // Absolute steady-clock us at Enqueue (Timeline::SteadyAbsUs): the start
+  // of the tensor's FUSION-WAIT trace span — how long it sat queued/fusing
+  // before its batch executed (docs/tracing.md). 0 on zombie stand-ins.
+  int64_t enqueued_at_us = 0;
 
   int64_t num_elements() const {
     int64_t n = 1;

@@ -43,6 +43,7 @@
 #include "metrics.h"
 #include "socket_util.h"
 #include "timeline.h"
+#include "tracing.h"
 
 #include <execinfo.h>
 #include <poll.h>
@@ -86,6 +87,7 @@ enum class CtrlMsg : int32_t {
   JOIN = 5,
   NEED_FULL = 6,  // coordinator -> worker: cache miss, resend full requests
   PARAMS = 7,     // coordinator -> worker: autotuned cycle time / fusion
+  CLOCK = 8,      // clock-sync ping-pong: worker {t1} <-> coord {t1, t2}
 };
 
 void LogWarn(int rank, const char* fmt, ...) {
@@ -238,6 +240,12 @@ struct CoreConfig {
   int64_t fusion_threshold = 64 * 1024 * 1024;  // reference default, 64 MB
   std::string timeline_path;
   bool timeline_mark_cycles = false;
+  // Distributed tracing (docs/tracing.md): every Nth collective op gets
+  // per-hop child spans on the timeline (0 = spans off; the op-level
+  // phases always ride a running timeline). Clock sync against rank 0 is
+  // refreshed through the control plane on this period while tracing.
+  int64_t trace_sample = 0;
+  double clock_sync_interval_secs = 30.0;
   double stall_warn_secs = 60.0;  // reference HOROVOD_STALL_CHECK_TIME
   // Shared job secret (reference: runner/common/util/secret.py). When set,
   // every HELLO must carry an HMAC proof; unauthenticated connections are
@@ -331,9 +339,21 @@ class Core {
   // Runtime timeline control (reference: horovod_start_timeline /
   // horovod_stop_timeline, operations.cc:735-790). Thread-safe: the request
   // is applied by the background thread at the top of its next cycle so the
-  // Timeline object stays single-owner.
-  void RequestTimeline(bool start, const std::string& path, bool mark_cycles)
-      EXCLUDES(timeline_req_mu_);
+  // Timeline object stays single-owner. trace_sample: -1 = keep the
+  // configured span-sampling rate, otherwise the new every-Nth-op rate
+  // (hvd.start_trace).
+  void RequestTimeline(bool start, const std::string& path, bool mark_cycles,
+                       int64_t trace_sample = -1) EXCLUDES(timeline_req_mu_);
+  // Clock offset vs rank 0 (offset ± error, microseconds; err < 0 = never
+  // synced). Lock-free, callable from any thread (C API introspection).
+  void ClockOffset(int64_t* offset_us, int64_t* err_us) const {
+    if (offset_us != nullptr) {
+      *offset_us = clock_offset_us_.load(std::memory_order_relaxed);
+    }
+    if (err_us != nullptr) {
+      *err_us = clock_err_us_.load(std::memory_order_relaxed);
+    }
+  }
   // Current (possibly autotuned) loop parameters, for tests/introspection.
   double CurrentCycleTimeMs() EXCLUDES(mu_);
   int64_t CurrentFusionThreshold() EXCLUDES(mu_);
@@ -498,8 +518,25 @@ class Core {
   bool timeline_req_start_ GUARDED_BY(timeline_req_mu_) = false;
   std::string timeline_req_path_ GUARDED_BY(timeline_req_mu_);
   bool timeline_req_mark_ GUARDED_BY(timeline_req_mu_) = false;
+  int64_t timeline_req_sample_ GUARDED_BY(timeline_req_mu_) = -1;
 
   void ApplyTimelineRequest() EXCLUDES(timeline_req_mu_);
+
+  // Cross-rank clock alignment (docs/tracing.md): offset ± error of this
+  // rank's steady clock vs rank 0's, estimated from CLOCK ping-pongs at
+  // form-up and refreshed through the control plane while tracing. The
+  // atomics are readable from any thread (hvdtpu_clock_offset); everything
+  // else is background-thread-owned (Start writes before the spawn).
+  std::atomic<int64_t> clock_offset_us_{0};
+  std::atomic<int64_t> clock_err_us_{-1};
+  double clock_synced_at_ = 0;
+  double clock_adopted_at_ = 0;
+  double clock_ping_sent_at_ = 0;
+  bool clock_ping_inflight_ = false;
+  // Emit (or refresh) this rank's trace-metadata event: clock offset ±
+  // error, steady/wall anchors, sampling rate. No-op while no timeline
+  // runs. Background thread (or Start, before the spawn) only.
+  void EmitTraceMeta();
   void FailAllOutstanding(const std::string& reason) EXCLUDES(mu_);
 
   // Live-metrics registry (metrics.h) + handles pre-resolved in Start() so
@@ -536,26 +573,76 @@ class Core {
 };
 
 void Core::RequestTimeline(bool start, const std::string& path,
-                           bool mark_cycles) {
+                           bool mark_cycles, int64_t trace_sample) {
   MutexLock lk(timeline_req_mu_);
   timeline_req_pending_ = true;
   timeline_req_start_ = start;
   timeline_req_path_ = path;
   timeline_req_mark_ = mark_cycles;
+  timeline_req_sample_ = trace_sample;
 }
 
 void Core::ApplyTimelineRequest() {
-  MutexLock lk(timeline_req_mu_);
-  if (!timeline_req_pending_) return;
-  timeline_req_pending_ = false;
-  if (timeline_req_start_) {
+  bool pending, start, mark;
+  std::string path;
+  int64_t sample;
+  {
+    MutexLock lk(timeline_req_mu_);
+    pending = timeline_req_pending_;
+    timeline_req_pending_ = false;
+    start = timeline_req_start_;
+    path = timeline_req_path_;
+    mark = timeline_req_mark_;
+    sample = timeline_req_sample_;
+  }
+  if (!pending) return;
+  if (start) {
     timeline_.Shutdown();
-    timeline_.Initialize(timeline_req_path_, cfg_.rank);
-    cfg_.timeline_mark_cycles = timeline_req_mark_;
+    timeline_.Initialize(path, cfg_.rank);
+    cfg_.timeline_mark_cycles = mark;
+    if (sample >= 0) cfg_.trace_sample = sample;
+    // This (background) thread is the data plane's single driver, so the
+    // sampler can be retargeted here.
+    data_plane_.set_trace_sample(cfg_.trace_sample);
+    // A runtime-started trace on a worker that skipped the form-up sync
+    // (un-traced launch) needs an offset NOW, not one refresh interval
+    // from now: age out the sync state so the next pump cycle pings.
+    if (cfg_.rank != 0 &&
+        clock_err_us_.load(std::memory_order_relaxed) < 0) {
+      clock_synced_at_ = 0;
+    }
+    EmitTraceMeta();
   } else {
     timeline_.Shutdown();
     cfg_.timeline_mark_cycles = false;
   }
+}
+
+void Core::EmitTraceMeta() {
+  if (!timeline_.Initialized()) return;
+  const int64_t unix_us =
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count();
+  // Hostname rides into JSON: strip the two characters that could corrupt
+  // it (quotes/backslashes have no business in a hostname anyway).
+  std::string host = cfg_.my_host;
+  for (char& c : host) {
+    if (c == '"' || c == '\\') c = '_';
+  }
+  std::string args =
+      "{\"rank\": " + std::to_string(cfg_.rank) +
+      ", \"size\": " + std::to_string(cfg_.size) +
+      ", \"host\": \"" + host + "\"" +
+      ", \"clock_offset_us\": " +
+      std::to_string(clock_offset_us_.load(std::memory_order_relaxed)) +
+      ", \"clock_err_us\": " +
+      std::to_string(clock_err_us_.load(std::memory_order_relaxed)) +
+      ", \"steady_init_us\": " + std::to_string(timeline_.init_steady_us()) +
+      ", \"steady_now_us\": " + std::to_string(Timeline::SteadyAbsUs()) +
+      ", \"unix_now_us\": " + std::to_string(unix_us) +
+      ", \"trace_sample\": " + std::to_string(cfg_.trace_sample) + "}";
+  timeline_.Metadata(args);
 }
 
 void Core::ObserveOp(const char* op, double secs, int64_t bytes,
@@ -698,6 +785,10 @@ Status Core::Start() {
   data_plane_.set_formup_timeout_ms(
       static_cast<int64_t>(cfg_.formup_timeout_secs * 1000.0));
   data_plane_.set_chaos(cfg_.chaos);
+  // Distributed tracing: the data plane emits per-hop child spans into this
+  // core's timeline for every trace_sample-th op (docs/tracing.md).
+  data_plane_.set_tracer(&timeline_);
+  data_plane_.set_trace_sample(cfg_.trace_sample);
 
   data_plane_.set_allreduce_algo(
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
@@ -879,6 +970,107 @@ Status Core::Start() {
         peers[rank].port = r.I32();
       }
     }
+    // Cross-rank clock alignment (docs/tracing.md): ping-pong CLOCK frames
+    // piggybacked on the form-up handshake, before the data-plane mesh
+    // forms. The phase is SELF-DESCRIBING so per-rank config cannot
+    // deadlock it: each worker sends as many pings as it wants (8 when a
+    // timeline/trace is configured, zero otherwise — un-traced jobs pay
+    // one done-marker frame per worker, not 8·(N−1) serialized RTTs at
+    // the ROADMAP's w64 scale) and closes with a t1 = -1 marker; rank 0
+    // echoes pings until it sees each worker's marker. A later worker's
+    // first ping just waits in its socket buffer — the min-RTT estimator
+    // discards the queued sample. Runtime-started traces on un-synced
+    // workers get their offset from the control-plane refresh instead
+    // (ApplyTimelineRequest forces a prompt ping).
+    constexpr int kClockPings = 8;
+    const int clock_ms =
+        std::max(1, static_cast<int>(cfg_.formup_timeout_secs * 1000.0));
+    const bool want_clock =
+        cfg_.trace_sample > 0 || !cfg_.timeline_path.empty();
+    if (cfg_.rank == 0) {
+      clock_offset_us_.store(0, std::memory_order_relaxed);
+      clock_err_us_.store(0, std::memory_order_relaxed);
+      for (int rank = 1; rank < cfg_.size; ++rank) {
+        // Bounded serve loop: a buggy peer streaming endless pings must
+        // trip form-up failure, not wedge rendezvous.
+        for (int k = 0; k <= 8 * kClockPings; ++k) {
+          std::vector<uint8_t> frame;
+          if (k == 8 * kClockPings || !Readable(worker_fds_[rank], clock_ms) ||
+              RecvFrame(worker_fds_[rank], &frame) != 0) {
+            return Status::Error(StatusCode::ABORTED,
+                                 "coordinator: clock sync with rank " +
+                                     std::to_string(rank) + " failed");
+          }
+          Reader r(frame);
+          if (static_cast<CtrlMsg>(r.I32()) != CtrlMsg::CLOCK) {
+            return Status::Error(StatusCode::ABORTED,
+                                 "coordinator: expected CLOCK frame");
+          }
+          int64_t t1 = r.I64();
+          if (!r.ok()) {
+            return Status::Error(StatusCode::ABORTED,
+                                 "coordinator: bad CLOCK frame");
+          }
+          if (t1 < 0) break;  // this worker's done marker
+          Writer w;
+          w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
+          w.I64(t1);
+          w.I64(Timeline::SteadyAbsUs());
+          if (SendFrame(worker_fds_[rank], w.buffer()) != 0) {
+            return Status::Error(StatusCode::ABORTED,
+                                 "coordinator: clock reply send failed");
+          }
+        }
+      }
+    } else {
+      std::vector<ClockSample> samples;
+      samples.reserve(kClockPings);
+      for (int k = 0; want_clock && k < kClockPings; ++k) {
+        ClockSample s;
+        s.t1 = Timeline::SteadyAbsUs();
+        Writer w;
+        w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
+        w.I64(s.t1);
+        w.I64(0);
+        std::vector<uint8_t> frame;
+        if (SendFrame(control_fd_, w.buffer()) != 0 ||
+            !Readable(control_fd_, clock_ms) ||
+            RecvFrame(control_fd_, &frame) != 0) {
+          return Status::Error(StatusCode::ABORTED,
+                               "worker: clock sync with rank 0 failed");
+        }
+        s.t3 = Timeline::SteadyAbsUs();
+        Reader r(frame);
+        if (static_cast<CtrlMsg>(r.I32()) != CtrlMsg::CLOCK) {
+          return Status::Error(StatusCode::ABORTED,
+                               "worker: expected CLOCK frame");
+        }
+        r.I64();  // our t1, echoed
+        s.t2 = r.I64();
+        if (!r.ok()) {
+          LogBadFrame(cfg_.rank, "worker CLOCK", frame);
+          continue;
+        }
+        samples.push_back(s);
+      }
+      {
+        Writer w;  // done marker: sync phase over for this worker
+        w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
+        w.I64(-1);
+        w.I64(0);
+        if (SendFrame(control_fd_, w.buffer()) != 0) {
+          return Status::Error(StatusCode::ABORTED,
+                               "worker: clock done-marker send failed");
+        }
+      }
+      ClockEstimate est = EstimateClockOffset(samples);
+      if (est.valid) {
+        clock_offset_us_.store(est.offset_us, std::memory_order_relaxed);
+        clock_err_us_.store(est.err_us, std::memory_order_relaxed);
+      }
+    }
+    clock_synced_at_ = NowSeconds();
+    clock_adopted_at_ = clock_synced_at_;
     st = data_plane_.Connect(peers);
     if (!st.ok()) return st;
   }
@@ -923,6 +1115,16 @@ Status Core::Start() {
 
   UpdateParamGauges(cycle_ms_now, fusion_now, cache_.enabled(),
                     data_plane_.crossover_bytes());
+
+  // Single-rank worlds ARE rank 0: their clock is the global axis.
+  if (cfg_.size == 1) {
+    clock_offset_us_.store(0, std::memory_order_relaxed);
+    clock_err_us_.store(0, std::memory_order_relaxed);
+  }
+  // A timeline opened via HVDTPU_TIMELINE/HVDTPU_TRACE gets its metadata
+  // now that the clock offset is known (runtime starts emit theirs in
+  // ApplyTimelineRequest).
+  EmitTraceMeta();
 
   shutdown_ = false;
   background_ = std::thread([this] { BackgroundLoop(); });
@@ -984,6 +1186,7 @@ int64_t Core::Enqueue(TensorEntry entry, Status* status) {
     entry.postscale /= static_cast<double>(cfg_.size);
   }
   auto* e = new TensorEntry(std::move(entry));
+  e->enqueued_at_us = Timeline::SteadyAbsUs();
   e->handle = static_cast<int32_t>(next_handle_++);
   handles_[e->handle] = e;
   outstanding_[e->name] = e;
@@ -1202,6 +1405,29 @@ void Core::PumpControlPlane() {
       w.I32(cfg_.rank);
       SendFrame(control_fd_, w.buffer());
     }
+    // Periodic clock-sync refresh while a timeline runs (docs/tracing.md):
+    // at most one CLOCK ping in flight; the reply is handled in the drain
+    // loop below. Gated on the timeline alone — an op-phases-only trace
+    // (HVDTPU_TRACE_SAMPLE=0) still needs fresh offsets for the merge.
+    // The refresh rides the busy control plane, so its RTT (and error
+    // bound) is worse than the quiet form-up sync — the adoption logic
+    // keeps the tighter estimate unless it has aged out.
+    // A lost reply must not disable refreshing forever: re-arm once the
+    // outstanding ping has aged past two intervals.
+    if (control_fd_ >= 0 && timeline_.Initialized() &&
+        (!clock_ping_inflight_ ||
+         NowSeconds() - clock_ping_sent_at_ >
+             2.0 * cfg_.clock_sync_interval_secs) &&
+        NowSeconds() - clock_synced_at_ > cfg_.clock_sync_interval_secs) {
+      Writer w;
+      w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
+      w.I64(Timeline::SteadyAbsUs());
+      w.I64(0);
+      if (SendFrame(control_fd_, w.buffer()) == 0) {
+        clock_ping_inflight_ = true;
+        clock_ping_sent_at_ = NowSeconds();
+      }
+    }
     // Drain response lists.
     while (control_fd_ >= 0 && Readable(control_fd_, 0)) {
       std::vector<uint8_t> frame;
@@ -1255,6 +1481,35 @@ void Core::PumpControlPlane() {
           for (auto& q : fulls) cache_.CheckAndPut(q);  // refresh local entry
         }
         if (!fulls.empty()) WorkerSendReady(std::move(fulls), {});
+        continue;
+      }
+      if (type == CtrlMsg::CLOCK) {
+        // Refresh reply: recompute the offset from this single ping-pong.
+        ClockSample s;
+        s.t3 = Timeline::SteadyAbsUs();
+        s.t1 = r.I64();
+        s.t2 = r.I64();
+        if (!r.ok()) {
+          LogBadFrame(cfg_.rank, "worker CLOCK", frame);
+          continue;
+        }
+        clock_ping_inflight_ = false;
+        clock_synced_at_ = NowSeconds();
+        ClockEstimate est = EstimateClockOffset({s});
+        const int64_t cur_err =
+            clock_err_us_.load(std::memory_order_relaxed);
+        // Adopt when at least as tight as the current bound, or when the
+        // current estimate has aged out — past ~10 refresh periods clock
+        // drift beats a stale tight bound.
+        if (est.valid &&
+            (cur_err < 0 || est.err_us <= cur_err ||
+             NowSeconds() - clock_adopted_at_ >
+                 10.0 * cfg_.clock_sync_interval_secs)) {
+          clock_offset_us_.store(est.offset_us, std::memory_order_relaxed);
+          clock_err_us_.store(est.err_us, std::memory_order_relaxed);
+          clock_adopted_at_ = NowSeconds();
+          EmitTraceMeta();
+        }
         continue;
       }
       if (type == CtrlMsg::PARAMS) {
@@ -1382,6 +1637,21 @@ void Core::CoordinatorIngest() {
         int32_t who = r.I32();
         joined_ranks_.insert(who);
         last_joined_rank_ = who;
+      } else if (type == CtrlMsg::CLOCK) {
+        // Clock-sync refresh ping: echo the worker's t1 with our steady
+        // now. Served inline — the timestamp is taken here, so coordinator
+        // scheduling latency lands in the worker's RTT (and its error
+        // bound), never in the offset unnoticed.
+        int64_t t1 = r.I64();
+        if (!r.ok()) {
+          LogBadFrame(cfg_.rank, "coordinator CLOCK", frame);
+          continue;
+        }
+        Writer w;
+        w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
+        w.I64(t1);
+        w.I64(Timeline::SteadyAbsUs());
+        SendFrame(fd, w.buffer());
       }
     }
   }
@@ -2085,6 +2355,23 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     m_fused_tensors_->Add(static_cast<int64_t>(entries.size()));
   }
   const double op_t0 = NowSeconds();
+  const int64_t exec_start_us = Timeline::SteadyAbsUs();
+  // FUSION-WAIT trace spans (emitted after the collective, once the data
+  // plane has rolled its sampling decision): each tensor's enqueue-to-
+  // execution wait on its own row — how long it sat queued/fusing before
+  // the batch ran (docs/tracing.md).
+  auto emit_fusion_wait = [&](const std::vector<TensorEntry*>& es) {
+    if (!data_plane_.trace_sampling_op()) return;
+    const std::string args =
+        "{\"tensors\": " + std::to_string(es.size()) +
+        ", \"batch_bytes\": " + std::to_string(total_bytes) + "}";
+    for (TensorEntry* te : es) {
+      if (te->enqueued_at_us > 0) {
+        timeline_.Span(te->name, "FUSION-WAIT", te->enqueued_at_us,
+                       exec_start_us, args);
+      }
+    }
+  };
 
   // Error-feedback residuals live at the compressing rank, keyed by the
   // fused batch's name signature (steady-state fusions reuse the buffer;
@@ -2133,6 +2420,7 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     if (st.ok()) {
       ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->postscale);
     }
+    emit_fusion_wait(entries);
     timeline_.ActivityEnd(e->name);
     timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason,
                      data_plane_.op_raw_bytes(),
@@ -2170,6 +2458,7 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
             data_plane_.hier_active(), WireCompressionName(comp), resp.dtype,
             st.ok());
   if (!st.ok() && data_plane_.aborted()) HandleDataPlaneFailure(st);
+  emit_fusion_wait(entries);
 
   off = 0;
   for (size_t i = 0; i < entries.size(); ++i) {
@@ -2598,6 +2887,40 @@ void hvdtpu_start_timeline(void* core, const char* path, int mark_cycles) {
 
 void hvdtpu_stop_timeline(void* core) {
   static_cast<Core*>(core)->RequestTimeline(false, "", false);
+}
+
+// Distributed tracing (docs/tracing.md). hvdtpu_set_trace: pre-Start()
+// span-sampling config — sample_every = emit per-hop child spans for every
+// Nth collective op (0 disables; op-level phases always ride a running
+// timeline); clock_sync_interval_secs > 0 overrides the control-plane
+// clock-refresh period (default 30 s). hvdtpu_start_trace: runtime
+// start_timeline variant that also (re)targets the sampler (sample_every
+// < 0 keeps the configured rate). hvdtpu_clock_offset: this rank's steady
+// clock offset ± error vs rank 0 in microseconds (err < 0 = never synced);
+// callable from any thread.
+int hvdtpu_set_trace(void* core, long long sample_every,
+                     double clock_sync_interval_secs) {
+  if (sample_every < 0) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->trace_sample = sample_every;
+  if (clock_sync_interval_secs > 0) {
+    cfg->clock_sync_interval_secs = clock_sync_interval_secs;
+  }
+  return 0;
+}
+
+void hvdtpu_start_trace(void* core, const char* path, int mark_cycles,
+                        long long sample_every) {
+  static_cast<Core*>(core)->RequestTimeline(true, path ? path : "",
+                                            mark_cycles != 0, sample_every);
+}
+
+void hvdtpu_clock_offset(void* core, long long* offset_us,
+                         long long* err_us) {
+  int64_t off = 0, err = -1;
+  static_cast<Core*>(core)->ClockOffset(&off, &err);
+  if (offset_us != nullptr) *offset_us = off;
+  if (err_us != nullptr) *err_us = err;
 }
 
 double hvdtpu_cycle_time_ms(void* core) {

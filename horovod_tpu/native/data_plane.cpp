@@ -14,6 +14,7 @@
 
 #include "shm_transport.h"
 #include "socket_util.h"
+#include "timeline.h"
 
 #if defined(__x86_64__)
 #include <cpuid.h>
@@ -659,6 +660,35 @@ Status DataPlane::FailLane(int peer, const char* what) {
                            " failed (peer death or liveness deadline)");
 }
 
+void DataPlane::BeginOpTrace() {
+  trace_hop_seq_ = 0;
+  trace_op_ = tracer_ != nullptr && tracer_->Initialized() &&
+              trace_sampler_.SampleOp();
+}
+
+void DataPlane::TraceHop(const char* name, int send_peer, int recv_peer,
+                         int64_t bytes, int64_t t0_us, int64_t wait0_us) {
+  if (!trace_op_) return;
+  const int64_t t1_us = Timeline::SteadyAbsUs();
+  const int64_t wait_us = io_ctl_.WaitUs() - wait0_us;
+  const int lane_peer = recv_peer >= 0 ? recv_peer : send_peer;
+  const char* lane =
+      lane_peer >= 0 && lane_peer < size_ && transports_[lane_peer] != nullptr
+          ? transports_[lane_peer]->kind()
+          : "local";
+  std::string args = "{\"send_peer\": " + std::to_string(send_peer) +
+                     ", \"recv_peer\": " + std::to_string(recv_peer) +
+                     ", \"bytes\": " + std::to_string(bytes) +
+                     ", \"lane\": \"" + lane + "\"" +
+                     ", \"algo\": \"" + last_algo_label_ + "\"" +
+                     ", \"hier\": " + (hier_active() ? "1" : "0") +
+                     ", \"compression\": \"" +
+                     WireCompressionName(op_comp_) + "\"" +
+                     ", \"seg\": " + std::to_string(trace_hop_seq_++) +
+                     ", \"wait_us\": " + std::to_string(wait_us) + "}";
+  tracer_->Span("hops", name, t0_us, t1_us, args);
+}
+
 void DataPlane::MaybeChaosOp() {
   if (chaos_.action == ChaosSpec::Action::NONE || chaos_.op_index <= 0) {
     return;
@@ -751,10 +781,13 @@ Status DataPlane::SendTo(int peer, const void* buf, int64_t bytes,
   if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
     return BlackholeWait(peer);
   }
+  const int64_t t0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t w0 = trace_op_ ? io_ctl_.WaitUs() : 0;
   if (bytes > 0 &&
       transports_[peer]->Send(buf, static_cast<size_t>(bytes)) != 0) {
     return FailLane(peer, what);
   }
+  TraceHop("SEND", peer, -1, bytes, t0, w0);
   return Status::OK();
 }
 
@@ -768,10 +801,13 @@ Status DataPlane::RecvFrom(int peer, void* buf, int64_t bytes,
   if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
     return BlackholeWait(peer);
   }
+  const int64_t t0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t w0 = trace_op_ ? io_ctl_.WaitUs() : 0;
   if (bytes > 0 &&
       transports_[peer]->Recv(buf, static_cast<size_t>(bytes)) != 0) {
     return FailLane(peer, what);
   }
+  TraceHop("RECV", -1, peer, bytes, t0, w0);
   return Status::OK();
 }
 
@@ -788,6 +824,9 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
                                 recv_peer == blackholed_peer_)) {
     return BlackholeWait(blackholed_peer_);
   }
+  const int64_t t0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t w0 = trace_op_ ? io_ctl_.WaitUs() : 0;
+  const int64_t hop_bytes = send_bytes + recv_bytes;
   const size_t seg =
       segment_bytes > 0 ? static_cast<size_t>(segment_bytes) : 0;
   if (send_peer == recv_peer) {
@@ -799,6 +838,7 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
             on_segment) != 0) {
       return FailLane(send_peer, "exchange");
     }
+    TraceHop("SENDRECV", send_peer, recv_peer, hop_bytes, t0, w0);
     return Status::OK();
   }
   Transport* ts = transports_[send_peer].get();
@@ -821,6 +861,7 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
                               : recv_peer;
       return FailLane(suspect, "exchange");
     }
+    TraceHop("SENDRECV", send_peer, recv_peer, hop_bytes, t0, w0);
     return Status::OK();
   }
   auto recv_side = [&]() -> int {
@@ -840,6 +881,7 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
       return FailLane(send_peer, "send");
     }
     if (recv_side() != 0) return FailLane(recv_peer, "receive");
+    TraceHop("SENDRECV", send_peer, recv_peer, hop_bytes, t0, w0);
     return Status::OK();
   }
   int send_rc = 0;
@@ -849,6 +891,7 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
   sender.join();
   if (send_rc != 0) return FailLane(send_peer, "send");
   if (recv_rc != 0) return FailLane(recv_peer, "receive");
+  TraceHop("SENDRECV", send_peer, recv_peer, hop_bytes, t0, w0);
   return Status::OK();
 }
 
@@ -879,7 +922,9 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   op_raw_bytes_ = 0;
   op_wire_bytes_ = 0;
   last_algo_label_ = "none";
+  trace_op_ = false;  // never inherit the previous op's sampling decision
   if (size_ == 1 || count == 0) return Status::OK();
+  BeginOpTrace();
   MaybeChaosOp();
   Status st;
   if (hier_active()) {
@@ -964,15 +1009,19 @@ Status DataPlane::CompressedRingReduceScatter(
     const int64_t rc = chunk_count(recv_c);
     const int64_t sw = WireBytes(c, sc);
     const int64_t rw = WireBytes(c, rc);
+    const int64_t qt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
     WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
                  op_residual_ != nullptr ? op_residual_ + starts[send_c]
                                          : nullptr,
                  nullptr);
+    TraceHop("QUANTIZE", -1, -1, sc * 4, qt0, io_ctl_.WaitUs());
     AddOpBytes(sc * 4, sw);
     Status st = Exchange(right, send_wire.data(), sw, left, recv_wire.data(),
                          rw);
     if (!st.ok()) return st;
+    const int64_t dt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
     WireDecompressAdd(c, recv_wire.data(), rc, buf + starts[recv_c]);
+    TraceHop("DEQUANTIZE", -1, -1, rc * 4, dt0, io_ctl_.WaitUs());
   }
   return Status::OK();
 }
@@ -998,10 +1047,13 @@ Status DataPlane::CompressedRingAllgather(float* buf,
   // those wire bytes verbatim, so the whole group decodes identical codes
   // and the final vectors agree bitwise.
   const int own_c = (gi + 1) % gs;
+  const int64_t qt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
   WireCompress(c, buf + starts[own_c], chunk_count(own_c), cur.data(),
                op_residual_ != nullptr ? op_residual_ + starts[own_c]
                                        : nullptr,
                buf + starts[own_c]);
+  TraceHop("QUANTIZE", -1, -1, chunk_count(own_c) * 4, qt0,
+           io_ctl_.WaitUs());
   for (int s = 0; s < gs - 1; ++s) {
     const int send_c = ((gi + 1 - s) % gs + gs) % gs;
     const int recv_c = ((gi - s) % gs + gs) % gs;
@@ -1010,8 +1062,11 @@ Status DataPlane::CompressedRingAllgather(float* buf,
     AddOpBytes(chunk_count(send_c) * 4, sw);
     Status st = Exchange(right, cur.data(), sw, left, next.data(), rw);
     if (!st.ok()) return st;
+    const int64_t dt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
     WireDecompress(c, next.data(), chunk_count(recv_c),
                    buf + starts[recv_c]);
+    TraceHop("DEQUANTIZE", -1, -1, chunk_count(recv_c) * 4, dt0,
+             io_ctl_.WaitUs());
     cur.swap(next);
   }
   return Status::OK();
@@ -1049,12 +1104,16 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
       const int peer = group[gi ^ distance];
       // Self-decode into `data`: both sides of the pair end up with
       // deQ(mine) + deQ(theirs) — bitwise identical by commutativity.
+      const int64_t qt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
       WireCompress(c, data, count, send_wire.data(), op_residual_, data);
+      TraceHop("QUANTIZE", -1, -1, raw_bytes, qt0, io_ctl_.WaitUs());
       AddOpBytes(raw_bytes, wb);
       Status st = Exchange(peer, send_wire.data(), wb, peer,
                            recv_wire.data(), wb);
       if (!st.ok()) return st;
+      const int64_t dt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
       WireDecompressAdd(c, recv_wire.data(), count, data);
+      TraceHop("DEQUANTIZE", -1, -1, raw_bytes, dt0, io_ctl_.WaitUs());
     }
   }
 
@@ -1118,15 +1177,33 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
       // element multiples, and sub-segment chunks simply arrive as one
       // view.
       uint8_t* dst = chunk_ptr(recv_c);
+      // Tracing: the per-segment reductions interleave with the transfer;
+      // the REDUCE child span covers first-to-last with the actual busy
+      // time in its args (docs/tracing.md).
+      int64_t reduce_first_us = 0, reduce_last_us = 0, reduce_busy_us = 0;
       Status st = Exchange(
           right, chunk_ptr(send_c), send_bytes, left, recv_tmp.get(),
           recv_bytes, seg,
           [&](const uint8_t* data, size_t off, size_t len) {
+            const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
             ReduceBuffer(dst + off, data, static_cast<int64_t>(len / elem),
                          dtype, op);
+            if (trace_op_) {
+              const int64_t rt1 = Timeline::SteadyAbsUs();
+              if (reduce_first_us == 0) reduce_first_us = rt0;
+              reduce_last_us = rt1;
+              reduce_busy_us += rt1 - rt0;
+            }
           },
           elem);
       if (!st.ok()) return st;
+      if (trace_op_ && reduce_first_us != 0) {
+        tracer_->Span("hops", "REDUCE", reduce_first_us, reduce_last_us,
+                      "{\"bytes\": " + std::to_string(recv_bytes) +
+                          ", \"busy_us\": " + std::to_string(reduce_busy_us) +
+                          ", \"seg\": " + std::to_string(trace_hop_seq_++) +
+                          "}");
+      }
     } else {
       // Empty chunk (count < group size): send-only hop.
       Status st = Exchange(right, chunk_ptr(send_c), send_bytes, left,
@@ -1197,7 +1274,9 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
   } else if (gi < r) {
     Status st = RecvFrom(group[gi + p], other.data(), bytes, "rd fold recv");
     if (!st.ok()) return st;
+    const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
     ReduceBuffer(data, other.data(), count, dtype, op);
+    TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
   }
 
   if (gi < p) {
@@ -1206,7 +1285,9 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
       AddOpBytes(bytes, bytes);
       Status st = Exchange(peer, data, bytes, peer, other.data(), bytes);
       if (!st.ok()) return st;
+      const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
       ReduceBuffer(data, other.data(), count, dtype, op);
+      TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
     }
   }
 
@@ -1243,7 +1324,9 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
       Status st =
           RecvFrom(group[gi + d], other.data(), bytes, "tree reduce recv");
       if (!st.ok()) return st;
+      const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
       ReduceBuffer(data, other.data(), count, dtype, op);
+      TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
     }
   }
 
@@ -1348,6 +1431,7 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
 Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
                              const std::vector<int64_t>& block_bytes,
                              std::vector<uint8_t>* out) {
+  BeginOpTrace();
   std::vector<int64_t> offsets(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) offsets[r + 1] = offsets[r] + block_bytes[r];
   out->resize(static_cast<size_t>(offsets[size_]));
@@ -1366,7 +1450,9 @@ Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
 }
 
 Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
+  trace_op_ = false;
   if (size_ == 1 || bytes == 0) return Status::OK();
+  BeginOpTrace();
   if (rank_ == root) {
     for (int r = 0; r < size_; ++r) {
       if (r == rank_) continue;
@@ -1384,6 +1470,7 @@ Status DataPlane::Alltoallv(const void* in,
                             const std::vector<int64_t>& send_bytes,
                             const std::vector<int64_t>& recv_bytes,
                             std::vector<uint8_t>* out) {
+  BeginOpTrace();
   std::vector<int64_t> send_off(size_ + 1, 0), recv_off(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) {
     send_off[r + 1] = send_off[r] + send_bytes[r];
@@ -1436,12 +1523,14 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
   op_raw_bytes_ = 0;
   op_wire_bytes_ = 0;
   last_algo_label_ = "adasum";
+  trace_op_ = false;
   if (dtype != DataType::FLOAT32 && dtype != DataType::FLOAT64) {
     return Status::Error(StatusCode::INVALID_ARGUMENT,
                          "Adasum supports float32/float64 only, got " +
                              std::string(DataTypeName(dtype)));
   }
   if (size_ == 1 || count == 0) return Status::OK();
+  BeginOpTrace();
   MaybeChaosOp();
   const size_t elem = DataTypeSize(dtype);
   const int64_t bytes = count * static_cast<int64_t>(elem);

@@ -20,7 +20,12 @@
 #include "compressed.h"
 #include "metrics.h"
 #include "shm_transport.h"
+#include "tracing.h"
 #include "transport.h"
+
+namespace hvdtpu {
+class Timeline;
+}
 
 namespace hvdtpu {
 
@@ -232,6 +237,19 @@ class DataPlane {
   // always have live counters; the core injects its own registry before
   // Listen() so data-plane series land in the worker's /metrics dump.
   void set_metrics(Metrics* m);
+
+  // Distributed tracing (docs/tracing.md): per-hop SEND/RECV/SENDRECV/
+  // REDUCE/QUANTIZE child spans on the timeline's "hops" track, emitted for
+  // every `sample_every_n`-th op (TraceSampler) so the un-sampled hot path
+  // pays one branch per hop. The tracer outlives the plane (core owns
+  // both); both setters are collective-driving-thread-only like the other
+  // knobs (the core's ApplyTimelineRequest runs there).
+  void set_tracer(Timeline* t) { tracer_ = t; }
+  void set_trace_sample(int64_t n) { trace_sampler_.set_every_n(n); }
+  int64_t trace_sample() const { return trace_sampler_.every_n(); }
+  // True while the CURRENT op is being sampled (core gates its own
+  // tensor-level FUSION-WAIT spans on the same decision).
+  bool trace_sampling_op() const { return trace_op_; }
   // Label of the algorithm the LAST Allreduce actually ran ("ring",
   // "recursive_doubling", "tree", with AUTO resolved by size; "hier" phases
   // report the top-level "hierarchical"). Background thread only — set by
@@ -280,6 +298,14 @@ class DataPlane {
   // Record a lane failure against `peer`, abort the plane, and return the
   // coherent "peer failure" status every subsequent op also gets.
   Status FailLane(int peer, const char* what);
+  // Tracing helpers (no-ops unless the current op is sampled). BeginOpTrace
+  // rolls the sampler at op entry; TraceHop emits one child span on the
+  // "hops" track carrying {send/recv peer, bytes, lane, algo, hier,
+  // compression, seg index, wait_us split}. wait0_us is the IoControl
+  // wait counter snapshot from the hop's start.
+  void BeginOpTrace();
+  void TraceHop(const char* name, int send_peer, int recv_peer,
+                int64_t bytes, int64_t t0_us, int64_t wait0_us);
   // One-directional hops with the same fault machinery as Exchange (chaos
   // hop counting, abort fast-fail, blackhole, FailLane attribution): the
   // tree edges, recursive-doubling fold/unfold links, hier leader
@@ -412,6 +438,14 @@ class DataPlane {
   int64_t chaos_ops_ = 0;
   int64_t chaos_hops_ = 0;
   int blackholed_peer_ = -1;
+
+  // Distributed-tracing state (background thread only, like the chaos
+  // counters): the core's timeline as span sink, the every-Nth-op sampler,
+  // and the current op's sampled flag + hop sequence.
+  Timeline* tracer_ = nullptr;
+  TraceSampler trace_sampler_;
+  bool trace_op_ = false;
+  int64_t trace_hop_seq_ = 0;
 
   // Per-op wire compression state (background thread only) + payload
   // accounting (cumulative totals live in the metrics registry, readable

@@ -498,7 +498,13 @@ void ShmTransport::WaitOutboundSpace() {
   r.tail_waiters.fetch_add(1, std::memory_order_seq_cst);
   if (r.tail.load(std::memory_order_seq_cst) + ring_bytes_ == head &&
       !AbortedNow()) {
+    // Peer-wait accounting (tracing layer): time parked on the futex is
+    // time the op stalled on the consumer, not ring bandwidth.
+    const double wait_t0 = MonoSeconds();
     FutexWait(&r.tail_seq, seq, WaitSliceMs());
+    if (ctl_ != nullptr) {
+      ctl_->AddWaitUs(static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));
+    }
   }
   r.tail_waiters.fetch_sub(1, std::memory_order_seq_cst);
 }
@@ -535,7 +541,13 @@ void ShmTransport::WaitInboundData() {
   r.head_waiters.fetch_add(1, std::memory_order_seq_cst);
   if (r.head.load(std::memory_order_seq_cst) == observed &&
       !AbortedNow()) {
+    // Peer-wait accounting (tracing layer): parked waiting for the
+    // producer to publish bytes — the shm analog of a blocked recv().
+    const double wait_t0 = MonoSeconds();
     FutexWait(&r.head_seq, seq, WaitSliceMs());
+    if (ctl_ != nullptr) {
+      ctl_->AddWaitUs(static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));
+    }
   }
   r.head_waiters.fetch_sub(1, std::memory_order_seq_cst);
 }

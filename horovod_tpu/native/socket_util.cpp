@@ -110,7 +110,11 @@ int CtlWait(int fd, short events, IoControl* ctl, double last_progress) {
     return -1;
   }
   pollfd pfd{fd, events, 0};
+  const double wait_t0 = MonoSeconds();
   int rc = poll(&pfd, 1, IoSliceMs(ctl));
+  // Peer-wait accounting for the tracing layer: every microsecond inside
+  // this poll is time the transfer stalled on the peer, not the wire.
+  ctl->AddWaitUs(static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));
   if (rc > 0 && (pfd.revents & POLLNVAL) != 0) {
     ctl->MarkPeerFailed();
     errno = ECONNRESET;

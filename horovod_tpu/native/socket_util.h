@@ -38,6 +38,14 @@ struct IoControl {
   std::atomic<uint32_t> peer_failed{0};  // a lane observed peer death
   int64_t detect_slice_ms = 100;         // poll slice (abort latency bound)
   double read_deadline_secs = 0;         // 0 = no no-progress deadline
+  // Cumulative peer-wait time: microseconds every controlled op spent
+  // blocked for the peer (sliced polls on an empty/full socket, futex waits
+  // on the shm rings, zero-copy completion drains) rather than moving
+  // bytes. The distributed-tracing layer snapshots it around each hop to
+  // split hop time into wait vs wire (docs/tracing.md straggler
+  // attribution). Relaxed adds on the already-slow blocked path: free on
+  // the hot path.
+  std::atomic<int64_t> wait_us{0};
 
   bool is_aborted() const {
     return aborted.load(std::memory_order_acquire) != 0;
@@ -45,6 +53,12 @@ struct IoControl {
   void MarkPeerFailed() {
     peer_failed.store(1, std::memory_order_release);
     aborted.store(1, std::memory_order_release);
+  }
+  void AddWaitUs(int64_t us) {
+    if (us > 0) wait_us.fetch_add(us, std::memory_order_relaxed);
+  }
+  int64_t WaitUs() const {
+    return wait_us.load(std::memory_order_relaxed);
   }
 };
 

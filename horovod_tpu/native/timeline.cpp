@@ -70,6 +70,70 @@ std::string JsonEscape(const std::string& s) {
 
 }  // namespace
 
+void Timeline::Push(std::string json) {
+  MutexLock lk(mu_);
+  queue_.push(Event{std::move(json)});
+}
+
+int64_t Timeline::init_steady_us() {
+  MutexLock st(state_mu_);
+  if (!initialized_) return 0;
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             start_.time_since_epoch())
+      .count();
+}
+
+void Timeline::Span(const std::string& track, const std::string& name,
+                    int64_t start_abs_us, int64_t end_abs_us,
+                    const std::string& args_json) {
+  int64_t origin_us;
+  int rank;
+  {
+    MutexLock st(state_mu_);
+    if (!initialized_) return;
+    origin_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                    start_.time_since_epoch())
+                    .count();
+    rank = rank_;
+  }
+  // A span whose start predates the timeline (runtime start mid-op, or a
+  // FUSION-WAIT whose tensor was enqueued before tracing began) is clamped
+  // to the origin rather than dropped or emitted at a negative ts — and
+  // the duration shrinks with it, so the rendered span still ENDS at its
+  // true end instead of spilling past it.
+  int64_t ts = start_abs_us - origin_us;
+  if (ts < 0) ts = 0;
+  int64_t end_ts = end_abs_us - origin_us;
+  int64_t dur = end_ts - ts;
+  if (dur < 0) dur = 0;
+  std::string e = "{\"name\": \"" + JsonEscape(name) + "\", \"ph\": \"X\"";
+  e += ", \"ts\": " + std::to_string(ts);
+  e += ", \"dur\": " + std::to_string(dur);
+  e += ", \"pid\": \"" + JsonEscape(track) + "\", \"tid\": " +
+       std::to_string(rank);
+  if (!args_json.empty()) e += ", \"args\": " + args_json;
+  e += "}";
+  Push(std::move(e));
+}
+
+void Timeline::Metadata(const std::string& args_json) {
+  int64_t ts;
+  int rank;
+  {
+    MutexLock st(state_mu_);
+    if (!initialized_) return;
+    ts = NowUs();
+    rank = rank_;
+  }
+  std::string e = "{\"name\": \"trace_meta\", \"ph\": \"i\", \"s\": \"g\"";
+  e += ", \"ts\": " + std::to_string(ts);
+  e += ", \"pid\": \"" + std::string(kTraceMetaTrack) + "\", \"tid\": " +
+       std::to_string(rank);
+  if (!args_json.empty()) e += ", \"args\": " + args_json;
+  e += "}";
+  Push(std::move(e));
+}
+
 void Timeline::Emit(const std::string& name, char ph,
                     const std::string& args_json, const std::string& cat) {
   // Snapshot under state_mu_ (so a concurrent runtime Shutdown/Initialize
@@ -96,17 +160,19 @@ void Timeline::Emit(const std::string& name, char ph,
   if (!args_json.empty()) e += ", \"args\": " + args_json;
   if (!cat.empty()) e += ", \"cat\": \"" + JsonEscape(cat) + "\"";
   e += "}";
-  {
-    MutexLock lk(mu_);
-    queue_.push(Event{std::move(e)});
-  }
-  cv_.NotifyOne();
+  Push(std::move(e));
 }
 
 void Timeline::WriterLoop() {
   MutexLock lk(mu_);
   while (true) {
-    while (!stop_ && queue_.empty()) cv_.Wait(lk);
+    // Batched drain: per-event wakes preempt the collective thread on
+    // small hosts, and a short free-running timer fires mid-op — so the
+    // writer is nudged only at OP BOUNDARIES (OpDone/Shutdown), where the
+    // emitting thread is about to idle on the control plane anyway. The
+    // 1 s timed wait is a backstop for op-less stretches (metadata-only
+    // traces, mark-cycles while idle).
+    while (!stop_ && queue_.empty()) cv_.WaitForMs(lk, 1000);
     while (!queue_.empty()) {
       Event e = std::move(queue_.front());
       queue_.pop();
@@ -158,6 +224,11 @@ void Timeline::OpDone(const std::string& name, const std::string& result,
             ", \"wire_bytes\": " + std::to_string(wire_bytes);
   }
   Emit(name, 'E', args + "}");
+  // Op boundary: the only wake the hot path pays. The background thread
+  // is about to return to the control-plane pump, so the writer's drain
+  // (this op's phases + any sampled hop spans) runs in the gap between
+  // ops instead of preempting a pipelined exchange.
+  cv_.NotifyOne();
 }
 
 void Timeline::MarkCycle() {
@@ -170,7 +241,6 @@ void Timeline::MarkCycle() {
            cycle_++, static_cast<long long>(NowUs()), rank_);
   MutexLock lk(mu_);
   queue_.push(Event{std::string(buf)});
-  cv_.NotifyOne();
 }
 
 }  // namespace hvdtpu

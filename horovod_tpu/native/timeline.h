@@ -49,12 +49,42 @@ class Timeline {
               int64_t raw_bytes = -1, int64_t wire_bytes = -1);
   void MarkCycle() EXCLUDES(state_mu_, mu_);  // HVDTPU_TIMELINE_MARK_CYCLES
 
+  // --- distributed-tracing surface (docs/tracing.md) ----------------------
+  // Complete ('X') span on track `track` (one Perfetto row per track per
+  // rank). start/end are ABSOLUTE steady-clock microseconds (SteadyAbsUs);
+  // the timeline converts to its own origin at emission, so emitters can
+  // timestamp without taking state_mu_. args_json: "{...}" or "".
+  void Span(const std::string& track, const std::string& name,
+            int64_t start_abs_us, int64_t end_abs_us,
+            const std::string& args_json) EXCLUDES(state_mu_, mu_);
+  // Trace-metadata instant on the reserved kTraceMetaTrack row: clock
+  // offset ± error bound vs rank 0, steady/wall anchors — everything
+  // scripts/trace_analyze.py needs to align this rank's events globally.
+  void Metadata(const std::string& args_json) EXCLUDES(state_mu_, mu_);
+  // Absolute steady-clock now in microseconds (the spans' time base).
+  static int64_t SteadyAbsUs() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+  // Absolute steady us of this timeline's ts origin (0 if uninitialized).
+  int64_t init_steady_us() EXCLUDES(state_mu_);
+
+  static constexpr const char* kTraceMetaTrack = "__hvdtpu_trace_meta";
+
  private:
   struct Event {
     std::string json;
   };
   void Emit(const std::string& name, char ph, const std::string& args_json,
             const std::string& cat = "") EXCLUDES(state_mu_, mu_);
+  // Queue one rendered event WITHOUT waking the writer: every emitter runs
+  // on (or inside) the collective path, where a per-event futex wake
+  // preempts the pipelined overlap on small hosts (measured up to ~8% at
+  // 16 MB on a 1-CPU box). The writer is nudged at op boundaries (OpDone)
+  // and otherwise drains on a 1 s backstop; Shutdown notifies for the
+  // prompt final drain.
+  void Push(std::string json) EXCLUDES(mu_);
   void WriterLoop() EXCLUDES(mu_);
   int64_t NowUs() const REQUIRES(state_mu_);
 

@@ -220,7 +220,13 @@ int ZeroCopySender::DrainCompletions(IoControl* ctl) {
     // errqueue readiness surfaces — the completion wait folds into the
     // same slice discipline as every other blocking transport op.
     pollfd pfd{fd_, 0, 0};
+    const double wait_t0 = MonoSeconds();
     poll(&pfd, 1, IoSliceMs(ctl));
+    if (ctl != nullptr) {
+      // Peer-wait accounting (tracing): a completion drain parks on the
+      // peer consuming our bytes, exactly like a blocked send.
+      ctl->AddWaitUs(static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));
+    }
     if ((pfd.revents & (POLLHUP | POLLNVAL)) != 0 &&
         (pfd.revents & POLLERR) == 0) {
       if (ctl != nullptr) ctl->MarkPeerFailed();
@@ -290,7 +296,11 @@ int ZeroCopySender::UringSubmitSend(const void* buf, size_t len,
       return -1;
     }
     pollfd pfd{ring_fd_, POLLIN, 0};
+    const double wait_t0 = MonoSeconds();
     poll(&pfd, 1, IoSliceMs(ctl));
+    if (ctl != nullptr) {
+      ctl->AddWaitUs(static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));
+    }
     return 0;
   };
   while (off < len) {
@@ -445,7 +455,12 @@ int ZeroCopySender::SendAll(const void* buf, size_t len, IoControl* ctl) {
         // completions arrive instead of busy-spinning on an already
         // writable socket. EAGAIN waits for writability as usual.
         pollfd pfd{fd_, static_cast<short>(optmem_full ? 0 : POLLOUT), 0};
+        const double wait_t0 = MonoSeconds();
         poll(&pfd, 1, IoSliceMs(ctl));
+        if (ctl != nullptr) {
+          ctl->AddWaitUs(
+              static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));
+        }
         if ((pfd.revents & POLLNVAL) != 0) {
           if (ctl != nullptr) ctl->MarkPeerFailed();
           errno = ECONNRESET;

@@ -40,6 +40,8 @@
 #include "metrics.h"
 #include "shm_transport.h"
 #include "socket_util.h"
+#include "timeline.h"
+#include "tracing.h"
 
 namespace hvdtpu {
 namespace {
@@ -1817,6 +1819,106 @@ void TestDataPlaneWireCountersInRegistry() {
              std::string::npos);
 }
 
+void TestClockOffsetEstimator() {
+  // Min-RTT sample wins: the second sample (RTT 10) beats the first
+  // (RTT 100); offset = t2 - midpoint(t1, t3).
+  std::vector<ClockSample> samples = {
+      {1000, 2000, 1100},   // rtt 100: offset 2000 - 1050 = 950, err 51
+      {2000, 2505, 2010},   // rtt 10:  offset 2505 - 2005 = 500, err 6
+  };
+  ClockEstimate est = EstimateClockOffset(samples);
+  CHECK_TRUE(est.valid);
+  CHECK_TRUE(est.offset_us == 500);
+  CHECK_TRUE(est.err_us == 6);
+  // Bogus samples (clock went backwards) are skipped; none usable ->
+  // invalid.
+  ClockEstimate bad = EstimateClockOffset({{100, 0, 50}});
+  CHECK_TRUE(!bad.valid);
+  CHECK_TRUE(!EstimateClockOffset({}).valid);
+}
+
+void TestTraceSamplerGating() {
+  TraceSampler s;
+  CHECK_TRUE(!s.enabled());
+  CHECK_TRUE(!s.SampleOp());  // disabled: never samples
+  s.set_every_n(3);
+  CHECK_TRUE(s.enabled());
+  int sampled = 0;
+  bool first = s.SampleOp();
+  CHECK_TRUE(first);  // the first op is always sampled when enabled
+  sampled += first ? 1 : 0;
+  for (int i = 0; i < 8; ++i) sampled += s.SampleOp() ? 1 : 0;
+  CHECK_TRUE(sampled == 3);  // ops 0, 3, 6 of the 9 rolled
+  TraceSampler every;
+  every.set_every_n(1);
+  for (int i = 0; i < 4; ++i) CHECK_TRUE(every.SampleOp());
+}
+
+void TestTimelineSpanAndMetadata() {
+  char path[] = "/tmp/hvdtpu_tl_span_XXXXXX";
+  int fd = mkstemp(path);
+  CHECK_TRUE(fd >= 0);
+  close(fd);
+  {
+    Timeline tl;
+    tl.Initialize(path, /*rank=*/3);
+    const int64_t t0 = Timeline::SteadyAbsUs();
+    tl.Span("hops", "SENDRECV", t0, t0 + 250,
+            "{\"bytes\": 42, \"wait_us\": 7}");
+    // A span predating the timeline origin clamps to ts 0, never negative.
+    tl.Span("hops", "EARLY", t0 - 10'000'000, t0 - 9'999'000, "");
+    tl.Metadata("{\"clock_offset_us\": -12, \"clock_err_us\": 5}");
+    tl.Shutdown();
+  }
+  FILE* f = fopen(path, "r");
+  CHECK_TRUE(f != nullptr);
+  std::string text;
+  char buf[512];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+  fclose(f);
+  unlink(path);
+  CHECK_TRUE(text.find("\"ph\": \"X\"") != std::string::npos);
+  CHECK_TRUE(text.find("\"dur\": 250") != std::string::npos);
+  CHECK_TRUE(text.find("\"pid\": \"hops\"") != std::string::npos);
+  CHECK_TRUE(text.find("\"tid\": 3") != std::string::npos);
+  CHECK_TRUE(text.find("\"wait_us\": 7") != std::string::npos);
+  CHECK_TRUE(text.find("trace_meta") != std::string::npos);
+  CHECK_TRUE(text.find("\"clock_offset_us\": -12") != std::string::npos);
+  CHECK_TRUE(text.find("\"ts\": -") == std::string::npos);  // no negatives
+  // The clamp shrinks the DURATION too: a fully pre-origin span must not
+  // spill past its true end (here: entirely before the origin -> dur 0).
+  CHECK_TRUE(text.find("\"name\": \"EARLY\", \"ph\": \"X\", \"ts\": 0, "
+                       "\"dur\": 0") != std::string::npos);
+  // The file as a whole must still be a JSON array (same writer contract
+  // as the op events).
+  CHECK_TRUE(!text.empty() && text[0] == '[');
+  CHECK_TRUE(text.find(']') != std::string::npos);
+}
+
+void TestIoControlWaitAccounting() {
+  // A controlled recv with no data must accrue peer-wait time; completing
+  // the transfer stops the clock.
+  int sv[2];
+  CHECK_TRUE(socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  IoControl ctl;
+  ctl.detect_slice_ms = 5;
+  std::thread sender([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(30));
+    const char b[4] = {1, 2, 3, 4};
+    CHECK_TRUE(SendAll(sv[1], b, sizeof(b), nullptr) == 0);
+  });
+  char out[4];
+  CHECK_TRUE(RecvAll(sv[0], out, sizeof(out), &ctl) == 0);
+  sender.join();
+  // ~30 ms blocked: the accounting must see most of it (scheduler slack
+  // allowed) and not wildly more.
+  CHECK_TRUE(ctl.WaitUs() >= 10'000);
+  CHECK_TRUE(ctl.WaitUs() < 5'000'000);
+  close(sv[0]);
+  close(sv[1]);
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -1868,6 +1970,10 @@ int main() {
   TestGaussianProcessInterpolates();
   TestBayesianOptimizerPicksBestSample();
   TestParameterManagerFreezesAtBest();
+  TestClockOffsetEstimator();
+  TestTraceSamplerGating();
+  TestTimelineSpanAndMetadata();
+  TestIoControlWaitAccounting();
   if (failures == 0) {
     std::printf("native unit tests: ALL OK\n");
     return 0;

@@ -53,6 +53,17 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                    help="write per-rank Chrome-trace timelines to "
                         "FILE.rank.json (reference: --timeline-filename)")
     p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="cross-rank distributed tracing (HVDTPU_TRACE; "
+                        "docs/tracing.md): each rank writes "
+                        "DIR/trace.<rank>.json with sampled per-hop spans "
+                        "+ clock-alignment metadata; at job end the driver "
+                        "merges them into DIR/merged_trace.json and prints "
+                        "the critical-path/straggler report")
+    p.add_argument("--trace-sample", type=int, default=None,
+                   help="emit per-hop trace spans for every Nth collective "
+                        "op (HVDTPU_TRACE_SAMPLE; default 10, 1 = every "
+                        "op, 0 = op phases only)")
     p.add_argument("--fusion-threshold-mb", type=float, default=64.0,
                    help="tensor fusion threshold (reference: "
                         "HOROVOD_FUSION_THRESHOLD)")
@@ -322,6 +333,27 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_TIMELINE] = args.timeline
     if args.timeline_mark_cycles:
         env[ev.HVDTPU_TIMELINE_MARK_CYCLES] = "1"
+    # Distributed tracing (docs/tracing.md): DIR rides the env; workers name
+    # their own files trace.<rank>.json (elastic rounds re-rank workers, so
+    # the per-rank suffix must come from the worker, not the launcher).
+    if args.trace:
+        os.makedirs(args.trace, exist_ok=True)
+        # A reused directory keeps ranks beyond this world's size from a
+        # previous run — the analyzer would silently merge two unrelated
+        # runs. Clear our own naming pattern up front.
+        import glob
+        stale = glob.glob(os.path.join(args.trace, "trace.*.json"))
+        stale.append(os.path.join(args.trace, "merged_trace.json"))
+        for old in stale:
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+        env[ev.HVDTPU_TRACE] = args.trace
+    if args.trace_sample is not None:
+        if args.trace_sample < 0:
+            raise SystemExit("hvdrun: --trace-sample must be >= 0")
+        env[ev.HVDTPU_TRACE_SAMPLE] = str(args.trace_sample)
     if getattr(args, "_chaos_spec", None):
         env[ev.HVDTPU_CHAOS] = args._chaos_spec
         if getattr(args, "_chaos_marker", None):
@@ -428,9 +460,15 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
     # when the metrics endpoints are on (docs/fault-tolerance.md).
     metrics_base = args.metrics_port if args.metrics_port is not None else \
         ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
-    return run_elastic(discovery, settings, list(args.command), env,
-                       verbose=args.verbose,
-                       metrics_base=metrics_base or None)
+    rc = run_elastic(discovery, settings, list(args.command), env,
+                     verbose=args.verbose,
+                     metrics_base=metrics_base or None)
+    if args.trace:
+        # Elastic rounds re-rank workers; the last round's files win per
+        # rank suffix — still the right trace for "why was the final world
+        # slow". Merge what landed locally.
+        _merge_trace_dir(args.trace)
+    return rc
 
 
 def _preflight_spawn(args):
@@ -542,12 +580,47 @@ def run_launcher(args: argparse.Namespace) -> int:
     if aggregator is not None:
         aggregator.start()
     try:
-        return safe_exec.run_workers(commands, envs, names,
-                                     verbose=args.verbose,
-                                     stdin_datas=stdins)
+        rc = safe_exec.run_workers(commands, envs, names,
+                                   verbose=args.verbose,
+                                   stdin_datas=stdins)
     finally:
         if aggregator is not None:
             aggregator.stop()
+    if args.trace:
+        _merge_trace_dir(args.trace)
+    return rc
+
+
+def _merge_trace_dir(trace_dir: str) -> None:
+    """End-of-job trace collection (hvdrun --trace; docs/tracing.md):
+    merge the per-rank Chrome traces into one clock-aligned Perfetto file
+    and print the critical-path/straggler report. Best-effort — remote
+    workers' files live on their own hosts and are simply absent here —
+    and never fails the job."""
+    try:
+        import json
+
+        from ..trace_analysis import (build_report, format_report,
+                                      load_trace_dir, merge_events)
+        per_rank = load_trace_dir(trace_dir)
+        if not per_rank:
+            print(f"hvdrun: trace: no per-rank traces in {trace_dir} "
+                  "(remote workers keep theirs on their own hosts; copy "
+                  "them here and run scripts/trace_analyze.py)",
+                  file=sys.stderr)
+            return
+        merged, _ = merge_events(per_rank)
+        merged_path = os.path.join(trace_dir, "merged_trace.json")
+        with open(merged_path, "w") as f:
+            json.dump(merged, f)
+        print(format_report(build_report(trace_dir, per_rank=per_rank)),
+              file=sys.stderr)
+        print(f"hvdrun: trace: merged {len(per_rank)} rank trace(s) -> "
+              f"{merged_path} (load in https://ui.perfetto.dev; "
+              "scripts/trace_analyze.py re-runs the analysis)",
+              file=sys.stderr)
+    except Exception as exc:  # observability must never fail the job
+        print(f"hvdrun: trace: merge failed: {exc}", file=sys.stderr)
 
 
 def main(argv: List[str] = None) -> int:

@@ -99,6 +99,42 @@ def merge_dumps(dumps: Dict[int, str]) -> str:
 SummaryPrev = Tuple[float, Dict[int, float], Dict[int, Tuple[float, float]]]
 
 
+def histogram_quantile(parsed_by_rank: Dict[int, dict], name: str,
+                       q: float) -> Optional[float]:
+    """Quantile of a native histogram merged across ranks: sum the
+    per-(rank, le) bucket counts, then linearly interpolate inside the
+    first bucket whose cumulative count crosses ``q`` (the standard
+    Prometheus ``histogram_quantile`` estimate). None when no
+    observations exist."""
+    buckets: Dict[float, float] = {}
+    for parsed in parsed_by_rank.values():
+        for suf, lbls, value in parsed.get(name, {}).get("samples", []):
+            if suf != "bucket":
+                continue
+            le = lbls.get("le", "")
+            bound = float("inf") if le == "+Inf" else float(le)
+            buckets[bound] = buckets.get(bound, 0.0) + value
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]  # cumulative: +Inf holds the count
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = buckets[b]
+        if cum >= target:
+            if b == float("inf"):
+                return prev_bound  # best lower bound we have
+            if cum == prev_cum:
+                return b
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (b - prev_bound)
+        prev_bound, prev_cum = b, cum
+    return bounds[-1] if bounds[-1] != float("inf") else prev_bound
+
+
 def summarize(parsed_by_rank: Dict[int, dict],
               prev: Optional[SummaryPrev],
               now: float) -> Tuple[str, SummaryPrev]:
@@ -114,6 +150,7 @@ def summarize(parsed_by_rank: Dict[int, dict],
     ops_now: Dict[int, float] = {}
     opsec_now: Dict[int, Tuple[float, float]] = {}
     raw = wire = 0.0
+    failures = zc_sends = zc_fallbacks = 0.0
     stalled: List[int] = []
     for rank, parsed in sorted(parsed_by_rank.items()):
         ops_now[rank] = sum(
@@ -122,6 +159,11 @@ def summarize(parsed_by_rank: Dict[int, dict],
             if suf == "")
         raw += sample_value(parsed, "hvdtpu_allreduce_raw_bytes_total") or 0
         wire += sample_value(parsed, "hvdtpu_allreduce_wire_bytes_total") or 0
+        failures += sample_value(parsed,
+                                 "hvdtpu_failures_detected_total") or 0
+        zc_sends += sample_value(parsed, "hvdtpu_zerocopy_sends_total") or 0
+        zc_fallbacks += sample_value(parsed,
+                                     "hvdtpu_zerocopy_fallbacks_total") or 0
         if (sample_value(parsed, "hvdtpu_stalled") or 0) > 0:
             stalled.append(rank)
         secs = sum(v for (suf, _l, v) in
@@ -159,7 +201,20 @@ def summarize(parsed_by_rank: Dict[int, dict],
         (f"slowest=rank{slowest_rank}({slowest_avg * 1e3:.1f}ms/op)"
          if slowest_rank is not None else "slowest=n/a"),
         f"stalled={stalled if stalled else '[]'}",
+        # Reliability + zero-copy counters (PR 6/7) the one-liner predates:
+        # cumulative failure detections, elastic-recovery p50, and the
+        # zero-copy engagement rate of large TCP sends (off = no TCP lane
+        # tried the engine — all-shm worlds, zero large sends).
+        f"failures={int(failures)}",
     ]
+    p50 = histogram_quantile(parsed_by_rank, "hvdtpu_recovery_seconds", 0.5)
+    if p50 is not None:
+        parts.append(f"recovery_p50={p50:.2f}s")
+    zc_total = zc_sends + zc_fallbacks
+    parts.append(
+        f"zc={100.0 * zc_sends / zc_total:.0f}%"
+        f"({int(zc_sends)}zc/{int(zc_fallbacks)}cp)"
+        if zc_total > 0 else "zc=off")
     return "hvdrun metrics: " + " ".join(parts), (now, ops_now, opsec_now)
 
 

@@ -628,3 +628,34 @@ def stop_timeline() -> None:
         import jax.profiler
         jax.profiler.stop_trace()
         st.xla_trace_active = False
+
+
+def start_trace(file_path: str, sample: Optional[int] = None,
+                mark_cycles: bool = False) -> None:
+    """Begin a distributed trace at runtime (docs/tracing.md).
+
+    Process mode: a Chrome-trace timeline whose per-hop child spans
+    (SEND/RECV/SENDRECV/REDUCE/QUANTIZE, with wait-vs-wire split) are
+    sampled every ``sample`` collective ops (None keeps the configured
+    ``HVDTPU_TRACE_SAMPLE`` rate, default 10) and whose metadata carries
+    this rank's clock offset ± error vs rank 0 — merge the per-rank files
+    with ``scripts/trace_analyze.py`` into one globally-aligned Perfetto
+    trace plus a critical-path/straggler report. No extra tracing exists in
+    SPMD mode (collectives are compiled into the XLA program); this falls
+    back to :func:`start_timeline`'s XLA profiler trace there.
+    """
+    st = _require_init()
+    if st.core is not None and hasattr(st.core, "start_trace"):
+        st.core.start_trace(file_path, sample=sample,
+                            mark_cycles=mark_cycles)
+    else:
+        start_timeline(file_path, mark_cycles=mark_cycles)
+
+
+def stop_trace() -> None:
+    """Stop a distributed trace started by :func:`start_trace`."""
+    st = _require_init()
+    if st.core is not None and hasattr(st.core, "stop_trace"):
+        st.core.stop_trace()
+    else:
+        stop_timeline()

@@ -110,6 +110,22 @@ HVDTPU_STALL_SHUTDOWN_TIME_SECONDS = "HVDTPU_STALL_SHUTDOWN_TIME_SECONDS"
 HVDTPU_TIMELINE = "HVDTPU_TIMELINE"
 HVDTPU_TIMELINE_MARK_CYCLES = "HVDTPU_TIMELINE_MARK_CYCLES"
 
+# Cross-rank distributed tracing (docs/tracing.md; no reference analog —
+# the reference timeline is strictly per-rank). TRACE: a DIRECTORY; each
+# worker writes DIR/trace.<rank>.json with per-hop child spans + clock
+# metadata (hvdrun --trace collects and merges them at job end via
+# scripts/trace_analyze.py). TRACE_SAMPLE: emit the per-hop span firehose
+# for every Nth collective op (default 10 when tracing; 1 = every op,
+# 0 = op-level phases only). TRACE_CLOCK_SYNC_SECONDS: how often a worker
+# refreshes its clock offset vs rank 0 through the control plane while a
+# trace is running (the form-up ping-pong sync always happens).
+HVDTPU_TRACE = "HVDTPU_TRACE"
+HVDTPU_TRACE_SAMPLE = "HVDTPU_TRACE_SAMPLE"
+HVDTPU_TRACE_CLOCK_SYNC_SECONDS = "HVDTPU_TRACE_CLOCK_SYNC_SECONDS"
+
+# Default every-Nth-op hop-span sampling rate while tracing.
+DEFAULT_TRACE_SAMPLE = 10
+
 # Autotune (reference: HOROVOD_AUTOTUNE, HOROVOD_AUTOTUNE_LOG,
 # horovod/common/operations.cc:474-532)
 HVDTPU_AUTOTUNE = "HVDTPU_AUTOTUNE"

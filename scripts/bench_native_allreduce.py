@@ -44,7 +44,7 @@ NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
 # "lib" pairs two .so builds (the HEAD-vs-new gate that used to run as two
 # unpaired sweeps, ±10% drift windows apart, on this box).
 AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
-            "doorbell-batch", "shm-ring-bytes", "segment", "lib")
+            "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -113,6 +113,12 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong]
     except AttributeError:
         pass  # pre-zero-copy build
+    try:
+        lib.hvdtpu_set_trace.restype = ctypes.c_int
+        lib.hvdtpu_set_trace.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                         ctypes.c_double]
+    except AttributeError:
+        pass  # pre-tracing build
     return lib
 
 
@@ -144,9 +150,26 @@ def run_worker(args) -> int:
     lib = load_lib(args.lib)
     rank, n = args.rank, args.world
     dtype_code, itemsize = DTYPES[args.dtype]
+    # --trace on: a real distributed trace rides the run — timeline file +
+    # default-rate hop-span sampling — so `--ab trace=off:on` measures the
+    # tracing layer's overhead through the production path. "timeline" runs
+    # the timeline WITHOUT hop-span sampling, isolating the pre-existing
+    # writer cost from the tracing layer's additions (`--ab
+    # trace=timeline:on`).
+    trace_path = b""
+    if args.trace in ("on", "timeline"):
+        trace_path = (f"/tmp/hvdtpu_bench_trace.{os.getpid()}."
+                      f"{rank}.json").encode()
     core = lib.hvdtpu_create(rank, n, rank, n, 0, 1, b"127.0.0.1", args.port,
                              b"127.0.0.1", args.cycle_time_ms,
-                             64 * 1024 * 1024, b"", 0, 600.0)
+                             64 * 1024 * 1024, trace_path, 0, 600.0)
+    if args.trace == "on":
+        if hasattr(lib, "hvdtpu_set_trace"):
+            lib.hvdtpu_set_trace(core, args.trace_sample, 30.0)
+        else:
+            print("SKIP trace config: library has no tracing support",
+                  file=sys.stderr)
+            return 0
     if hasattr(lib, "hvdtpu_set_allreduce_tuning"):
         lib.hvdtpu_set_allreduce_tuning(core, ALGOS[args.algo],
                                         args.crossover, args.segment)
@@ -250,6 +273,11 @@ def run_worker(args) -> int:
     finally:
         lib.hvdtpu_shutdown(core)
         lib.hvdtpu_destroy(core)
+        if trace_path:
+            try:
+                os.unlink(trace_path.decode())
+            except OSError:
+                pass
     return rc
 
 
@@ -276,7 +304,7 @@ def run_config(args, world: int, algo: str, sizes: list,
            "tcp-zerocopy": args.tcp_zerocopy, "shm-numa": args.shm_numa,
            "doorbell-batch": args.doorbell_batch,
            "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
-           "lib": args.lib}
+           "lib": args.lib, "trace": args.trace}
     if overrides:
         cfg.update(overrides)
     port = free_port()
@@ -295,6 +323,8 @@ def run_config(args, world: int, algo: str, sizes: list,
                "--tcp-zerocopy", str(cfg["tcp-zerocopy"]),
                "--shm-numa", str(cfg["shm-numa"]),
                "--doorbell-batch", str(cfg["doorbell-batch"]),
+               "--trace", str(cfg["trace"]),
+               "--trace-sample", str(args.trace_sample),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -325,7 +355,8 @@ def run_config(args, world: int, algo: str, sizes: list,
                     "compression": cfg["compression"],
                     "tcp_zerocopy": cfg["tcp-zerocopy"],
                     "shm_numa": cfg["shm-numa"],
-                    "doorbell_batch": cfg["doorbell-batch"]})
+                    "doorbell_batch": cfg["doorbell-batch"],
+                    "trace": cfg["trace"]})
     return rows, failed
 
 
@@ -481,6 +512,17 @@ def main(argv=None) -> int:
                    help="zero-copy TCP send lane (HVDTPU_TCP_ZEROCOPY)")
     p.add_argument("--shm-numa", default="auto", choices=sorted(NUMA_MODES),
                    help="NUMA placement of the shm rings (HVDTPU_SHM_NUMA)")
+    p.add_argument("--trace-sample", type=int, default=10,
+                   help="hop-span sampling rate for --trace on (every Nth "
+                        "op; the production HVDTPU_TRACE_SAMPLE default "
+                        "is 10)")
+    p.add_argument("--trace", default="off",
+                   choices=["off", "timeline", "on"],
+                   help="run with the distributed-tracing layer live: 'on' "
+                        "= timeline + default-rate hop-span sampling (--ab "
+                        "trace=off:on is the tracing-overhead gate), "
+                        "'timeline' = timeline only (isolates the "
+                        "pre-existing writer cost from the span layer)")
     p.add_argument("--doorbell-batch", type=int, default=0,
                    help="shm futex-doorbell coalescing window, bytes "
                         "(0 = default, 1 = wake per cursor advance)")

@@ -43,6 +43,25 @@ run_check "bench-smoke" python3 scripts/bench_native_allreduce.py --smoke
 # end to end. The full {algo x transport x hier x compression} matrix lives
 # in tests/test_chaos.py (slow marker) / `python3 scripts/chaos_harness.py`.
 run_check "chaos-smoke" env JAX_PLATFORMS=cpu python3 scripts/chaos_harness.py --smoke
+# Distributed-tracing smoke (docs/tracing.md): a real 2-rank --trace job,
+# then the analyzer must produce a valid merged trace and a NON-EMPTY
+# critical-path table (exit 2 otherwise) — so the tracing pipeline cannot
+# silently regress into empty traces.
+trace_smoke() {
+  local dir
+  dir=$(mktemp -d /tmp/hvdtpu_trace_smoke.XXXXXX) || return 1
+  env JAX_PLATFORMS=cpu TEST_ALGO_ITERS=1 "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 --trace "${dir}" \
+    --trace-sample 1 python3 tests/data/algo_worker.py || return 1
+  python3 scripts/trace_analyze.py "${dir}" -o "${dir}/merged.json" \
+    --require-critical-path > /dev/null || return 1
+  python3 -c "import json,sys; e=json.load(open(sys.argv[1])); \
+assert isinstance(e, list) and e, 'empty merged trace'" \
+    "${dir}/merged.json" || return 1
+  rm -rf "${dir}"
+  return 0
+}
+run_check "trace-smoke" trace_smoke
 
 echo
 echo "============ CI summary ============"

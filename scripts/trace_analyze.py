@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Merge per-rank distributed traces + emit the critical-path report.
+
+CLI over :mod:`horovod_tpu.trace_analysis` (docs/tracing.md):
+
+    # merge DIR's trace.<rank>.json files into one Perfetto-loadable trace
+    # and print the critical-path/straggler report
+    python scripts/trace_analyze.py /tmp/trace -o /tmp/trace/merged.json
+
+    # machine-readable report
+    python scripts/trace_analyze.py /tmp/trace --json report.json
+
+    # compare two runs (gating-leg phase totals, straggler movement)
+    python scripts/trace_analyze.py /tmp/trace_a --diff /tmp/trace_b
+
+Exit status: 0 on success; 2 with --require-critical-path when no sampled
+op produced a critical-path row (the CI trace-smoke gate).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.trace_analysis import (build_report, diff_reports,  # noqa: E402
+                                        format_report, load_trace_dir,
+                                        merge_events)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace_dir", help="directory of per-rank *.<rank>.json "
+                                     "traces (hvdrun --trace DIR)")
+    p.add_argument("-o", "--merged", default=None,
+                   help="write the merged clock-aligned Chrome/Perfetto "
+                        "trace here (default: <dir>/merged_trace.json)")
+    p.add_argument("--no-merged", action="store_true",
+                   help="analysis only; skip writing the merged trace")
+    p.add_argument("--report", default=None,
+                   help="write the text report here (default: stdout)")
+    p.add_argument("--json", default=None,
+                   help="write the machine-readable report here")
+    p.add_argument("--diff", default=None, metavar="TRACE_DIR_B",
+                   help="compare against a second run's trace directory")
+    p.add_argument("--require-critical-path", action="store_true",
+                   help="exit 2 unless the critical-path table is "
+                        "non-empty (CI smoke gate)")
+    args = p.parse_args(argv)
+
+    per_rank = load_trace_dir(args.trace_dir)
+    report = build_report(args.trace_dir, per_rank=per_rank)
+    if not args.no_merged:
+        merged_path = args.merged or os.path.join(args.trace_dir,
+                                                  "merged_trace.json")
+        merged, _ = merge_events(per_rank)
+        with open(merged_path, "w") as f:
+            json.dump(merged, f)
+        print(f"merged trace: {merged_path} ({len(merged)} events; load in "
+              "https://ui.perfetto.dev)", file=sys.stderr)
+
+    text = format_report(report)
+    if args.diff:
+        text += "\n\n" + diff_reports(report, build_report(args.diff))
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+
+    if args.require_critical_path and not report["critical_path"]:
+        print("trace_analyze: no sampled ops -> empty critical-path table "
+              "(is HVDTPU_TRACE_SAMPLE 0?)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Worker: pin the zero-copy transport tag in timeline per-op args.
+
+The ``tcp-zc`` / ``shm+tcp-zc`` labels exist since the zero-copy lane
+(PR 7) but nothing asserted them in actual trace output. Launched with
+HVDTPU_TCP_ZEROCOPY=on and payloads clearing the zero-copy size floor;
+TEST_EXPECT_LANE names the label this topology must produce. When the
+kernel lacks MSG_ZEROCOPY (probe failed: zero zc sends), the label
+legitimately stays plain — asserted against the copy-path set instead.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.observability import sample_value  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+path = os.environ["TEST_TIMELINE_PATH"] + f".{r}.json"
+hvd.start_timeline(path)
+count = 1 << 19  # 2 MB fp32: every TCP hop clears the 128 KB zc floor
+for i in range(3):
+    x = np.full(count, float(r + i + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, name=f"grad/zc{i}", op=hvd.Sum))
+    np.testing.assert_allclose(
+        out, np.full(count, sum(q + i + 1 for q in range(n)), np.float32))
+m = hvd.metrics()
+zc_sends = sample_value(m, "hvdtpu_zerocopy_sends_total") or 0
+hvd.stop_timeline()
+
+deadline = time.time() + 30
+while True:
+    try:
+        events = json.load(open(path))
+        break
+    except Exception:
+        assert time.time() < deadline, "timeline never closed"
+        time.sleep(0.05)
+
+lanes = {e.get("args", {}).get("transport")
+         for e in events if e.get("name") == "ALLREDUCE"}
+lanes.discard(None)
+expect = os.environ["TEST_EXPECT_LANE"]
+if zc_sends > 0:
+    # The engine really sent zero-copy: the per-op tag MUST say so.
+    assert expect in lanes, (expect, lanes, zc_sends)
+else:
+    # Probe failed on this kernel (no SO_ZEROCOPY) or every send was
+    # declined: the label stays on the copy-path vocabulary.
+    fallback = expect.replace("tcp-zc", "tcp")
+    assert lanes & {expect, fallback}, (expect, lanes, zc_sends)
+    print(f"SKIP zc tag: no zero-copy sends (lanes={lanes})")
+
+hvd.shutdown()
+print(f"ALL OK lanes={sorted(lanes)} zc_sends={zc_sends}")
+sys.exit(0)

@@ -1,0 +1,46 @@
+"""Worker: distributed tracing end to end (docs/tracing.md).
+
+Launched with HVDTPU_TRACE pointing at a shared directory (and usually
+HVDTPU_TRACE_SAMPLE=1 + an HVDTPU_CHAOS delay on one rank): runs a few
+named allreduces so every rank writes trace.<rank>.json with op phases,
+sampled hop spans, FUSION-WAIT spans and clock metadata. Also asserts the
+clock-sync API surface: rank 0's offset is exactly 0 ± 0, workers got a
+bounded estimate from the form-up ping-pong.
+"""
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import runtime  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+
+off, err = runtime.core().clock_offset()
+if r == 0:
+    assert (off, err) == (0, 0), (off, err)
+else:
+    assert err >= 0, f"rank {r} never clock-synced: err={err}"
+    assert abs(off) < 10_000_000, f"absurd offset {off}us on localhost"
+
+iters = int(os.environ.get("TEST_TRACE_ITERS", "3"))
+for it in range(iters):
+    # Small (recursive doubling under auto) + multi-segment ring payloads,
+    # so the sampled hop spans cover both algorithm shapes.
+    s = np.full((256,), float(r + it), np.float32)
+    out = np.asarray(hvd.allreduce(s, name=f"s{it}", op=hvd.Sum))
+    np.testing.assert_allclose(out, sum(range(n)) + n * it, rtol=1e-6)
+
+    x = np.full((200_001,), float(r + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, name=f"x{it}", op=hvd.Sum))
+    np.testing.assert_allclose(out[0], n * (n + 1) / 2.0, rtol=1e-6)
+
+hvd.shutdown()
+print(f"ALL OK trace rank={r} offset={off}us err={err}us")
+sys.exit(0)

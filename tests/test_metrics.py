@@ -152,6 +152,44 @@ class TestAggregator:
         parsed = parse_prometheus_text(merged)
         assert len(parsed["hvdtpu_ops_total"]["samples"]) == 4
 
+    def test_summary_reliability_and_zerocopy_counters(self):
+        """The one-line summary carries the PR-6/PR-7 counters it predated:
+        cumulative failure detections, recovery p50 from the merged
+        histogram, and the zero-copy engagement rate (ISSUE 10 satellite)."""
+        from horovod_tpu.observability import parse_prometheus_text
+        from horovod_tpu.runner.metrics_agg import (histogram_quantile,
+                                                    summarize)
+
+        quiet = parse_prometheus_text(
+            "# TYPE hvdtpu_ops_total counter\n"
+            'hvdtpu_ops_total{op="ALLREDUCE"} 5\n')
+        line, _ = summarize({0: quiet}, None, 0.0)
+        assert "failures=0" in line
+        assert "zc=off" in line
+        assert "recovery_p50" not in line  # no observations yet
+
+        busy = parse_prometheus_text(
+            "# TYPE hvdtpu_failures_detected_total counter\n"
+            "hvdtpu_failures_detected_total 2\n"
+            "# TYPE hvdtpu_zerocopy_sends_total counter\n"
+            "hvdtpu_zerocopy_sends_total 30\n"
+            "# TYPE hvdtpu_zerocopy_fallbacks_total counter\n"
+            "hvdtpu_zerocopy_fallbacks_total 10\n"
+            "# TYPE hvdtpu_recovery_seconds histogram\n"
+            'hvdtpu_recovery_seconds_bucket{le="0.1"} 0\n'
+            'hvdtpu_recovery_seconds_bucket{le="0.4"} 2\n'
+            'hvdtpu_recovery_seconds_bucket{le="+Inf"} 2\n'
+            "hvdtpu_recovery_seconds_sum 0.5\n"
+            "hvdtpu_recovery_seconds_count 2\n")
+        line, _ = summarize({0: busy, 1: quiet}, None, 0.0)
+        assert "failures=2" in line
+        assert "zc=75%(30zc/10cp)" in line
+        assert "recovery_p50=" in line
+        # Interpolated p50 inside the (0.1, 0.4] bucket: both observations
+        # land there, target = 1 of 2 -> 0.1 + 0.5 * 0.3 = 0.25.
+        p50 = histogram_quantile({0: busy}, "hvdtpu_recovery_seconds", 0.5)
+        assert abs(p50 - 0.25) < 1e-9, p50
+
     def test_scrape_merge_and_summary(self):
         from horovod_tpu.observability import MetricsServer
         from horovod_tpu.runner.metrics_agg import MetricsAggregator
