@@ -159,6 +159,14 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_clock_offset.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong)]
+    lib.hvdtpu_set_flightrec.restype = ctypes.c_int
+    lib.hvdtpu_set_flightrec.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                         ctypes.c_char_p]
+    lib.hvdtpu_flightrec_dump.restype = ctypes.c_int
+    lib.hvdtpu_flightrec_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.hvdtpu_flightrec_snapshot.restype = ctypes.c_longlong
+    lib.hvdtpu_flightrec_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
     lib.hvdtpu_cycle_time_ms.argtypes = [ctypes.c_void_p]
     lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
@@ -254,6 +262,32 @@ class NativeCore:
                 f"{ev.HVDTPU_TRACE_CLOCK_SYNC_SECONDS} must be > 0 seconds, "
                 f"got {clock_sync}")
         self._lib.hvdtpu_set_trace(self._core, trace_sample, clock_sync)
+        # Always-on flight recorder (docs/fault-tolerance.md "Post-mortem
+        # debugging"): in-memory ring of binary phase records, dumped to
+        # HVDTPU_FLIGHTREC_DIR/flightrec.<rank>.bin on abort/stall/fatal
+        # signal. On by default; the ring alone is ~160 KB and costs five
+        # relaxed atomic stores per hop.
+        fr_events = ev.get_int(ev.HVDTPU_FLIGHTREC_EVENTS,
+                               ev.DEFAULT_FLIGHTREC_EVENTS)
+        # Upper bound: 16M records = 640 MB of ring — far past any forensic
+        # need, and a fat-fingered value must fail naming the knob instead
+        # of aborting every worker in a native bad_alloc. (Values 1..63 are
+        # raised to the native floor of 64; see docs/envvars.md.)
+        if fr_events < 0 or fr_events > ev.MAX_FLIGHTREC_EVENTS:
+            raise ValueError(
+                f"{ev.HVDTPU_FLIGHTREC_EVENTS} must be 0.."
+                f"{ev.MAX_FLIGHTREC_EVENTS} records, got {fr_events}")
+        if not ev.get_bool(ev.HVDTPU_FLIGHTREC, default=True):
+            fr_events = 0
+        fr_dir = ev.get_str(ev.HVDTPU_FLIGHTREC_DIR, "") or ""
+        if fr_dir and fr_events > 0:
+            # Absolute: the native side precomposes the dump path once and
+            # opens it at failure time — a training script that chdir()s
+            # after init must not scatter dumps across working dirs.
+            fr_dir = os.path.abspath(fr_dir)
+            os.makedirs(fr_dir, exist_ok=True)
+        self._lib.hvdtpu_set_flightrec(self._core, fr_events,
+                                       fr_dir.encode())
         # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
         self._lib.hvdtpu_set_cache_capacity(
             self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
@@ -409,25 +443,29 @@ class NativeCore:
                                     ctypes.byref(wire))
         return raw.value, wire.value
 
+    def _probe_then_copy(self, cfunc) -> bytes:
+        """Drain a probe-then-copy C API (``cfunc(core, NULL, 0)`` returns
+        the full size; a second call copies): loop in case the payload
+        grew between the two calls. b"" when the core is shut down (an
+        HTTP handler thread racing teardown gets empty, not a dead
+        pointer) or the source is disabled."""
+        core = self._core
+        if not core:
+            return b""
+        need = cfunc(core, None, 0)
+        while need > 0:
+            buf = ctypes.create_string_buffer(int(need))
+            got = cfunc(core, buf, len(buf))
+            if got <= len(buf):
+                return buf.raw[:got]
+            need = got
+        return b""
+
     def metrics_dump(self) -> str:
         """Prometheus text exposition of the native metrics registry
         (counters, gauges, histograms instrumented throughout the
         background loop and data plane; see docs/metrics.md)."""
-        core = self._core
-        if not core:
-            # Shut down: an HTTP handler thread that raced the teardown
-            # (the endpoint is stopped first, but an in-flight request may
-            # still reach here) gets an empty dump, not a dead pointer.
-            return ""
-        # Probe for the size, then copy; loop in case the registry grew a
-        # new series between the two calls.
-        need = self._lib.hvdtpu_metrics_dump(core, None, 0)
-        while True:
-            buf = ctypes.create_string_buffer(int(need) + 1)
-            got = self._lib.hvdtpu_metrics_dump(core, buf, len(buf))
-            if got <= len(buf) - 1:
-                return buf.raw[:got].decode()
-            need = got
+        return self._probe_then_copy(self._lib.hvdtpu_metrics_dump).decode()
 
     def metrics(self) -> dict:
         """Parsed snapshot of :meth:`metrics_dump` — see
@@ -558,6 +596,22 @@ class NativeCore:
         self._lib.hvdtpu_clock_offset(self._core, ctypes.byref(off),
                                       ctypes.byref(err))
         return off.value, err.value
+
+    def flightrec_snapshot(self) -> bytes:
+        """Serialized flight-recorder dump image (binary; decode with
+        :mod:`horovod_tpu.flightrec`): the in-flight op and last-N phase
+        events of this rank, live. ``b""`` when the recorder is disabled
+        or the core is shut down."""
+        return self._probe_then_copy(self._lib.hvdtpu_flightrec_snapshot)
+
+    def flightrec_dump(self, path: Optional[str] = None) -> bool:
+        """On-demand flight-recorder dump to ``path`` (None = the
+        configured ``HVDTPU_FLIGHTREC_DIR/flightrec.<rank>.bin``). Returns
+        False when the recorder is disabled or no destination is known."""
+        if not self._core:
+            return False
+        return self._lib.hvdtpu_flightrec_dump(
+            self._core, path.encode() if path else None) == 0
 
     def cycle_time_ms(self) -> float:
         """Current (possibly autotuned) background cycle time."""

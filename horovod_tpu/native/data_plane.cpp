@@ -639,6 +639,11 @@ void DataPlane::Shutdown() {
 }
 
 void DataPlane::Abort() {
+  if (flight_ != nullptr && !io_ctl_.is_aborted()) {
+    const int64_t now = Timeline::SteadyAbsUs();
+    flight_->Record(FlightEvent::ABORT, -1, 0, failed_peer_, -1, now, now, 0,
+                    0);
+  }
   io_ctl_.aborted.store(1, std::memory_order_release);
   for (auto& t : transports_) {
     if (t != nullptr) t->Abort();  // shm: flag + futex wake; tcp: no-op
@@ -652,6 +657,14 @@ void DataPlane::Abort() {
 
 Status DataPlane::FailLane(int peer, const char* what) {
   if (failed_peer_ < 0) failed_peer_ = peer;
+  if (flight_ != nullptr) {
+    // The forensic money shot: which lane died, pinned on which peer. The
+    // post-mortem verdict votes across every surviving rank's FAIL_DETECT
+    // records to name the dead rank.
+    const int64_t now = Timeline::SteadyAbsUs();
+    flight_->Record(FlightEvent::FAIL_DETECT, -1, 0, peer, -1, now, now, 0,
+                    0);
+  }
   io_ctl_.MarkPeerFailed();
   Abort();
   return Status::Error(StatusCode::ABORTED,
@@ -664,11 +677,35 @@ void DataPlane::BeginOpTrace() {
   trace_hop_seq_ = 0;
   trace_op_ = tracer_ != nullptr && tracer_->Initialized() &&
               trace_sampler_.SampleOp();
+  // The flight ring wants every hop; the sampled JSON tracer only its share.
+  rec_hops_ = trace_op_ || flight_ != nullptr;
 }
+
+namespace {
+
+// Map a TraceHop span name onto its flight-record tag. The strings are the
+// handful of literals the data plane emits; first-character dispatch keeps
+// this branchy-but-trivial on the hop path.
+FlightEvent FlightHopEvent(const char* name) {
+  switch (name[0]) {
+    case 'S':
+      return name[4] == 'R' ? FlightEvent::SENDRECV : FlightEvent::SEND;
+    case 'R':
+      return name[2] == 'C' ? FlightEvent::RECV : FlightEvent::REDUCE;
+    case 'Q':
+      return FlightEvent::QUANTIZE;
+    case 'D':
+      return FlightEvent::DEQUANTIZE;
+    default:
+      return FlightEvent::NONE;
+  }
+}
+
+}  // namespace
 
 void DataPlane::TraceHop(const char* name, int send_peer, int recv_peer,
                          int64_t bytes, int64_t t0_us, int64_t wait0_us) {
-  if (!trace_op_) return;
+  if (!rec_hops_) return;
   const int64_t t1_us = Timeline::SteadyAbsUs();
   const int64_t wait_us = io_ctl_.WaitUs() - wait0_us;
   const int lane_peer = recv_peer >= 0 ? recv_peer : send_peer;
@@ -676,6 +713,11 @@ void DataPlane::TraceHop(const char* name, int send_peer, int recv_peer,
       lane_peer >= 0 && lane_peer < size_ && transports_[lane_peer] != nullptr
           ? transports_[lane_peer]->kind()
           : "local";
+  if (flight_ != nullptr) {
+    flight_->Record(FlightHopEvent(name), /*name_id=*/-1, bytes, send_peer,
+                    recv_peer, t0_us, t1_us, wait_us, FlightLaneCode(lane));
+  }
+  if (!trace_op_) return;
   std::string args = "{\"send_peer\": " + std::to_string(send_peer) +
                      ", \"recv_peer\": " + std::to_string(recv_peer) +
                      ", \"bytes\": " + std::to_string(bytes) +
@@ -781,8 +823,8 @@ Status DataPlane::SendTo(int peer, const void* buf, int64_t bytes,
   if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
     return BlackholeWait(peer);
   }
-  const int64_t t0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
-  const int64_t w0 = trace_op_ ? io_ctl_.WaitUs() : 0;
+  const int64_t t0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t w0 = rec_hops_ ? io_ctl_.WaitUs() : 0;
   if (bytes > 0 &&
       transports_[peer]->Send(buf, static_cast<size_t>(bytes)) != 0) {
     return FailLane(peer, what);
@@ -801,8 +843,8 @@ Status DataPlane::RecvFrom(int peer, void* buf, int64_t bytes,
   if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
     return BlackholeWait(peer);
   }
-  const int64_t t0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
-  const int64_t w0 = trace_op_ ? io_ctl_.WaitUs() : 0;
+  const int64_t t0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t w0 = rec_hops_ ? io_ctl_.WaitUs() : 0;
   if (bytes > 0 &&
       transports_[peer]->Recv(buf, static_cast<size_t>(bytes)) != 0) {
     return FailLane(peer, what);
@@ -824,8 +866,8 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
                                 recv_peer == blackholed_peer_)) {
     return BlackholeWait(blackholed_peer_);
   }
-  const int64_t t0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
-  const int64_t w0 = trace_op_ ? io_ctl_.WaitUs() : 0;
+  const int64_t t0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t w0 = rec_hops_ ? io_ctl_.WaitUs() : 0;
   const int64_t hop_bytes = send_bytes + recv_bytes;
   const size_t seg =
       segment_bytes > 0 ? static_cast<size_t>(segment_bytes) : 0;
@@ -1009,7 +1051,7 @@ Status DataPlane::CompressedRingReduceScatter(
     const int64_t rc = chunk_count(recv_c);
     const int64_t sw = WireBytes(c, sc);
     const int64_t rw = WireBytes(c, rc);
-    const int64_t qt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+    const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
     WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
                  op_residual_ != nullptr ? op_residual_ + starts[send_c]
                                          : nullptr,
@@ -1019,7 +1061,7 @@ Status DataPlane::CompressedRingReduceScatter(
     Status st = Exchange(right, send_wire.data(), sw, left, recv_wire.data(),
                          rw);
     if (!st.ok()) return st;
-    const int64_t dt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
     WireDecompressAdd(c, recv_wire.data(), rc, buf + starts[recv_c]);
     TraceHop("DEQUANTIZE", -1, -1, rc * 4, dt0, io_ctl_.WaitUs());
   }
@@ -1047,7 +1089,7 @@ Status DataPlane::CompressedRingAllgather(float* buf,
   // those wire bytes verbatim, so the whole group decodes identical codes
   // and the final vectors agree bitwise.
   const int own_c = (gi + 1) % gs;
-  const int64_t qt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+  const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
   WireCompress(c, buf + starts[own_c], chunk_count(own_c), cur.data(),
                op_residual_ != nullptr ? op_residual_ + starts[own_c]
                                        : nullptr,
@@ -1062,7 +1104,7 @@ Status DataPlane::CompressedRingAllgather(float* buf,
     AddOpBytes(chunk_count(send_c) * 4, sw);
     Status st = Exchange(right, cur.data(), sw, left, next.data(), rw);
     if (!st.ok()) return st;
-    const int64_t dt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
     WireDecompress(c, next.data(), chunk_count(recv_c),
                    buf + starts[recv_c]);
     TraceHop("DEQUANTIZE", -1, -1, chunk_count(recv_c) * 4, dt0,
@@ -1104,14 +1146,14 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
       const int peer = group[gi ^ distance];
       // Self-decode into `data`: both sides of the pair end up with
       // deQ(mine) + deQ(theirs) — bitwise identical by commutativity.
-      const int64_t qt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+      const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       WireCompress(c, data, count, send_wire.data(), op_residual_, data);
       TraceHop("QUANTIZE", -1, -1, raw_bytes, qt0, io_ctl_.WaitUs());
       AddOpBytes(raw_bytes, wb);
       Status st = Exchange(peer, send_wire.data(), wb, peer,
                            recv_wire.data(), wb);
       if (!st.ok()) return st;
-      const int64_t dt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+      const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       WireDecompressAdd(c, recv_wire.data(), count, data);
       TraceHop("DEQUANTIZE", -1, -1, raw_bytes, dt0, io_ctl_.WaitUs());
     }
@@ -1185,10 +1227,10 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
           right, chunk_ptr(send_c), send_bytes, left, recv_tmp.get(),
           recv_bytes, seg,
           [&](const uint8_t* data, size_t off, size_t len) {
-            const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+            const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
             ReduceBuffer(dst + off, data, static_cast<int64_t>(len / elem),
                          dtype, op);
-            if (trace_op_) {
+            if (rec_hops_) {
               const int64_t rt1 = Timeline::SteadyAbsUs();
               if (reduce_first_us == 0) reduce_first_us = rt0;
               reduce_last_us = rt1;
@@ -1197,12 +1239,21 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
           },
           elem);
       if (!st.ok()) return st;
-      if (trace_op_ && reduce_first_us != 0) {
-        tracer_->Span("hops", "REDUCE", reduce_first_us, reduce_last_us,
-                      "{\"bytes\": " + std::to_string(recv_bytes) +
-                          ", \"busy_us\": " + std::to_string(reduce_busy_us) +
-                          ", \"seg\": " + std::to_string(trace_hop_seq_++) +
-                          "}");
+      if (rec_hops_ && reduce_first_us != 0) {
+        if (flight_ != nullptr) {
+          // busy_us in arg: the span is first-to-last segment, the actual
+          // reduction time is what the analyzer attributes.
+          flight_->Record(FlightEvent::REDUCE, -1, recv_bytes, -1, -1,
+                          reduce_first_us, reduce_last_us, reduce_busy_us,
+                          0);
+        }
+        if (trace_op_) {
+          tracer_->Span(
+              "hops", "REDUCE", reduce_first_us, reduce_last_us,
+              "{\"bytes\": " + std::to_string(recv_bytes) +
+                  ", \"busy_us\": " + std::to_string(reduce_busy_us) +
+                  ", \"seg\": " + std::to_string(trace_hop_seq_++) + "}");
+        }
       }
     } else {
       // Empty chunk (count < group size): send-only hop.
@@ -1274,7 +1325,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
   } else if (gi < r) {
     Status st = RecvFrom(group[gi + p], other.data(), bytes, "rd fold recv");
     if (!st.ok()) return st;
-    const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+    const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
     ReduceBuffer(data, other.data(), count, dtype, op);
     TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
   }
@@ -1285,7 +1336,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
       AddOpBytes(bytes, bytes);
       Status st = Exchange(peer, data, bytes, peer, other.data(), bytes);
       if (!st.ok()) return st;
-      const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+      const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       ReduceBuffer(data, other.data(), count, dtype, op);
       TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
     }
@@ -1324,7 +1375,7 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
       Status st =
           RecvFrom(group[gi + d], other.data(), bytes, "tree reduce recv");
       if (!st.ok()) return st;
-      const int64_t rt0 = trace_op_ ? Timeline::SteadyAbsUs() : 0;
+      const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       ReduceBuffer(data, other.data(), count, dtype, op);
       TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
     }

@@ -18,6 +18,7 @@
 
 #include "common.h"
 #include "compressed.h"
+#include "flightrec.h"
 #include "metrics.h"
 #include "shm_transport.h"
 #include "tracing.h"
@@ -247,6 +248,13 @@ class DataPlane {
   void set_tracer(Timeline* t) { tracer_ = t; }
   void set_trace_sample(int64_t n) { trace_sampler_.set_every_n(n); }
   int64_t trace_sample() const { return trace_sampler_.every_n(); }
+  // Always-on flight recorder (flightrec.h): every hop/reduce/quantize and
+  // failure-detect event lands in the ring UNSAMPLED — five relaxed atomic
+  // stores per event, no JSON — alongside whatever the sampled tracer
+  // emits. Set before Connect (core owns the recorder; nullptr disables).
+  void set_flightrec(FlightRecorder* fr) {
+    flight_ = fr != nullptr && fr->enabled() ? fr : nullptr;
+  }
   // True while the CURRENT op is being sampled (core gates its own
   // tensor-level FUSION-WAIT spans on the same decision).
   bool trace_sampling_op() const { return trace_op_; }
@@ -441,11 +449,15 @@ class DataPlane {
 
   // Distributed-tracing state (background thread only, like the chaos
   // counters): the core's timeline as span sink, the every-Nth-op sampler,
-  // and the current op's sampled flag + hop sequence.
+  // and the current op's sampled flag + hop sequence. rec_hops_ is the
+  // combined "timestamp this hop at all" gate: sampled-trace JSON OR the
+  // always-on flight ring (flight_) wants it.
   Timeline* tracer_ = nullptr;
   TraceSampler trace_sampler_;
   bool trace_op_ = false;
+  bool rec_hops_ = false;
   int64_t trace_hop_seq_ = 0;
+  FlightRecorder* flight_ = nullptr;
 
   // Per-op wire compression state (background thread only) + payload
   // accounting (cumulative totals live in the metrics registry, readable

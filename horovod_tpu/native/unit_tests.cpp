@@ -36,6 +36,7 @@
 #include "autotune.h"
 #include "compressed.h"
 #include "data_plane.h"
+#include "flightrec.h"
 #include "message.h"
 #include "metrics.h"
 #include "shm_transport.h"
@@ -1896,6 +1897,274 @@ void TestTimelineSpanAndMetadata() {
   CHECK_TRUE(text.find(']') != std::string::npos);
 }
 
+// Minimal decoder over the flight-recorder dump image (the production
+// decoder is horovod_tpu/flightrec.py; this one pins the binary layout at
+// the C++ boundary so a silent repack breaks HERE, not in a post-mortem).
+struct FlightImage {
+  int32_t rank = 0, world = 0, reason = -1, detail = 0;
+  int64_t clock_offset = 0, clock_err = 0, write_count = 0;
+  uint32_t capacity = 0, record_bytes = 0;
+  std::vector<std::string> names;
+  std::vector<FlightRecord> recs;
+};
+
+template <typename T>
+T GetAt(const std::string& img, size_t off) {
+  T v;
+  std::memcpy(&v, img.data() + off, sizeof(T));
+  return v;
+}
+
+FlightImage DecodeFlightImage(const std::string& img) {
+  FlightImage out;
+  CHECK_TRUE(img.size() >= kFlightHeaderBytes);
+  CHECK_TRUE(std::memcmp(img.data(), kFlightMagic, 8) == 0);
+  CHECK_TRUE(GetAt<uint32_t>(img, 8) == 1);   // version
+  CHECK_TRUE(GetAt<uint32_t>(img, 12) == kFlightHeaderBytes);
+  out.rank = GetAt<int32_t>(img, 16);
+  out.world = GetAt<int32_t>(img, 20);
+  out.clock_offset = GetAt<int64_t>(img, 24);
+  out.clock_err = GetAt<int64_t>(img, 32);
+  out.write_count = GetAt<int64_t>(img, 56);
+  out.capacity = GetAt<uint32_t>(img, 64);
+  out.record_bytes = GetAt<uint32_t>(img, 68);
+  const uint32_t names = GetAt<uint32_t>(img, 72);
+  const uint32_t name_bytes = GetAt<uint32_t>(img, 76);
+  out.reason = GetAt<int32_t>(img, 80);
+  out.detail = GetAt<int32_t>(img, 84);
+  size_t off = kFlightHeaderBytes;
+  for (uint32_t i = 0; i < names; ++i) {
+    out.names.emplace_back(img.data() + off);  // NUL-terminated slot
+    off += name_bytes;
+  }
+  while (off + out.record_bytes <= img.size()) {
+    FlightRecord r;
+    r.t_end_us = GetAt<int64_t>(img, off);
+    const uint64_t w1 = GetAt<uint64_t>(img, off + 8);
+    r.dur_us = static_cast<uint32_t>(w1 & 0xffffffffu);
+    r.type = static_cast<FlightEvent>(
+        static_cast<int32_t>((w1 >> 32) & 0xffff));
+    r.lane = static_cast<uint16_t>(w1 >> 48);
+    r.bytes = GetAt<int64_t>(img, off + 16);
+    const uint64_t w3 = GetAt<uint64_t>(img, off + 24);
+    r.name_id = static_cast<int32_t>(w3 & 0xffffffffu);
+    r.arg = static_cast<int32_t>(w3 >> 32);
+    const uint64_t w4 = GetAt<uint64_t>(img, off + 32);
+    r.send_peer = static_cast<int32_t>(w4 & 0xffffffffu);
+    r.recv_peer = static_cast<int32_t>(w4 >> 32);
+    out.recs.push_back(r);
+    off += out.record_bytes;
+  }
+  return out;
+}
+
+void TestFlightRecorderSnapshotRoundtrip() {
+  FlightRecorder fr;
+  fr.Configure(64, "", /*rank=*/2, /*world=*/4);
+  fr.SetClock(1234, 56);
+  const int nid = fr.InternName("layer0/kernel");
+  CHECK_TRUE(nid == 1);  // slot 0 is the overflow name
+  CHECK_TRUE(fr.InternName("layer0/kernel") == nid);
+  fr.Record(FlightEvent::OP_BEGIN, nid, 4096, -1, -1, 1000, 1000, 0, 0);
+  fr.Record(FlightEvent::SENDRECV, -1, 8192, 1, 3, 1100, 1400, 250, 2);
+  fr.Record(FlightEvent::OP_END, nid, 4096, -1, -1, 1000, 1500, 0, 0);
+  FlightImage img = DecodeFlightImage(
+      fr.Snapshot(DumpReason::ON_DEMAND, -1));
+  CHECK_TRUE(img.rank == 2 && img.world == 4);
+  CHECK_TRUE(img.clock_offset == 1234 && img.clock_err == 56);
+  CHECK_TRUE(img.reason == static_cast<int32_t>(DumpReason::ON_DEMAND));
+  CHECK_TRUE(img.write_count == 3);
+  CHECK_TRUE(img.recs.size() == 3);
+  CHECK_TRUE(img.names.size() == 2 && img.names[1] == "layer0/kernel");
+  CHECK_TRUE(img.recs[0].type == FlightEvent::OP_BEGIN);
+  CHECK_TRUE(img.recs[0].name_id == nid);
+  const FlightRecord& hop = img.recs[1];
+  CHECK_TRUE(hop.type == FlightEvent::SENDRECV);
+  CHECK_TRUE(hop.send_peer == 1 && hop.recv_peer == 3);
+  CHECK_TRUE(hop.bytes == 8192 && hop.dur_us == 300 && hop.arg == 250);
+  CHECK_TRUE(hop.lane == 2 && hop.name_id == -1);
+  CHECK_TRUE(img.recs[2].t_end_us == 1500 && img.recs[2].dur_us == 500);
+}
+
+void TestFlightRecorderWraparoundOldestFirst() {
+  FlightRecorder fr;
+  fr.Configure(64, "", 0, 1);
+  for (int i = 0; i < 150; ++i) {
+    fr.Record(FlightEvent::SEND, -1, i, -1, -1, i, i, 0, 1);
+  }
+  FlightImage img = DecodeFlightImage(
+      fr.Snapshot(DumpReason::ON_DEMAND, -1));
+  CHECK_TRUE(img.write_count == 150);
+  CHECK_TRUE(img.recs.size() == 64);
+  // Oldest kept record is #86 (150 - 64), newest #149, strictly in order.
+  CHECK_TRUE(img.recs.front().bytes == 86);
+  CHECK_TRUE(img.recs.back().bytes == 149);
+  for (size_t i = 1; i < img.recs.size(); ++i) {
+    CHECK_TRUE(img.recs[i].bytes == img.recs[i - 1].bytes + 1);
+  }
+}
+
+void TestFlightRecorderNameOverflowSharesSlotZero() {
+  FlightRecorder fr;
+  fr.Configure(64, "", 0, 1);
+  int last = 0;
+  for (int i = 0; i < kFlightMaxNames + 10; ++i) {
+    last = fr.InternName("t" + std::to_string(i));
+  }
+  CHECK_TRUE(last == 0);  // overflowed names share the reserved slot
+  FlightImage img = DecodeFlightImage(
+      fr.Snapshot(DumpReason::ON_DEMAND, -1));
+  CHECK_TRUE(img.names.size() == kFlightMaxNames);
+  CHECK_TRUE(img.names[0] == "<names-overflowed>");
+  CHECK_TRUE(img.names[1] == "t0");
+}
+
+void TestFlightRecorderConcurrentWriters() {
+  // The ring is claimed by fetch_add and written with relaxed word stores:
+  // hammer it from several threads (TSan build included in check-tsan)
+  // while a reader snapshots mid-flight.
+  FlightRecorder fr;
+  fr.Configure(256, "", 0, 1);
+  std::atomic<bool> stop{false};
+  std::thread reader([&] {
+    while (!stop.load()) {
+      std::string img = fr.Snapshot(DumpReason::ON_DEMAND, -1);
+      CHECK_TRUE(img.size() >= kFlightHeaderBytes);
+    }
+  });
+  std::vector<std::thread> writers;
+  for (int t = 0; t < 4; ++t) {
+    writers.emplace_back([&fr, t] {
+      for (int i = 0; i < 5000; ++i) {
+        fr.Record(FlightEvent::SEND, -1, i, t, -1, i, i + 1, 0, 1);
+      }
+    });
+  }
+  for (auto& w : writers) w.join();
+  stop.store(true);
+  reader.join();
+  CHECK_TRUE(fr.record_count() == 4 * 5000);
+  FlightImage img = DecodeFlightImage(
+      fr.Snapshot(DumpReason::ON_DEMAND, -1));
+  CHECK_TRUE(img.recs.size() == 256);
+  for (const FlightRecord& r : img.recs) {
+    CHECK_TRUE(r.type == FlightEvent::SEND && r.dur_us == 1);
+  }
+}
+
+void TestFlightRecorderDumpLatchAndOnDemand() {
+  char tmpl[] = "/tmp/hvdtpu_frec_XXXXXX";
+  char* dir = mkdtemp(tmpl);
+  CHECK_TRUE(dir != nullptr);
+  FlightRecorder fr;
+  fr.Configure(64, dir, /*rank=*/1, /*world=*/2);
+  fr.Record(FlightEvent::OP_BEGIN, -1, 1, -1, -1, 1, 1, 0, 0);
+  // First fatal dump writes; the second is latched out (the original
+  // failure's forensics must survive a later cascade).
+  CHECK_TRUE(fr.DumpToFile(DumpReason::ABORT, 3, "", true));
+  CHECK_TRUE(!fr.DumpToFile(DumpReason::STALL, -1, "", true));
+  const std::string path = std::string(dir) + "/flightrec.1.bin";
+  CHECK_TRUE(fr.dump_path() == path);
+  FILE* f = std::fopen(path.c_str(), "rb");
+  CHECK_TRUE(f != nullptr);
+  std::string img;
+  char buf[4096];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) img.append(buf, n);
+  std::fclose(f);
+  FlightImage dec = DecodeFlightImage(img);
+  CHECK_TRUE(dec.reason == static_cast<int32_t>(DumpReason::ABORT));
+  CHECK_TRUE(dec.detail == 3);
+  // On-demand dumps bypass the latch.
+  const std::string alt = std::string(dir) + "/ondemand.bin";
+  CHECK_TRUE(fr.DumpToFile(DumpReason::ON_DEMAND, -1, alt, false));
+  std::remove(path.c_str());
+  std::remove(alt.c_str());
+  std::remove(dir);
+}
+
+void TestFlightRecorderSigtermDoesNotBurnLatch() {
+  // A SIGTERM dump (watchdog/launcher cleanup — classified as "not the
+  // cause" by the post-mortem) must leave the fatal latch armed so a
+  // LATER genuine fatal can still record the real story; the reverse
+  // order (fatal first) stays protected.
+  char tmpl[] = "/tmp/hvdtpu_frst_XXXXXX";
+  char* dir = mkdtemp(tmpl);
+  CHECK_TRUE(dir != nullptr);
+  FlightRecorder fr;
+  fr.Configure(64, dir, 0, 1);
+  fr.Record(FlightEvent::OP_BEGIN, -1, 1, -1, -1, 1, 1, 0, 0);
+  fr.SignalDump(SIGTERM);
+  // The abort cascade after the SIGTERM still gets its dump...
+  CHECK_TRUE(fr.DumpToFile(DumpReason::ABORT, 2, "", true));
+  // ...and now the latch holds: a later SIGTERM cannot overwrite it.
+  const std::string path = fr.dump_path();
+  FILE* f = std::fopen(path.c_str(), "rb");
+  CHECK_TRUE(f != nullptr);
+  std::string img;
+  char buf[4096];
+  size_t n;
+  while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) img.append(buf, n);
+  std::fclose(f);
+  CHECK_TRUE(DecodeFlightImage(img).reason ==
+             static_cast<int32_t>(DumpReason::ABORT));
+  CHECK_TRUE(!fr.DumpToFile(DumpReason::STALL, -1, "", true));
+  std::remove(path.c_str());
+  std::remove(dir);
+}
+
+void TestFlightLaneCodes() {
+  CHECK_TRUE(FlightLaneCode("tcp") == 1);
+  CHECK_TRUE(FlightLaneCode("shm") == 2);
+  CHECK_TRUE(FlightLaneCode("tcp-zc") == 3);
+  CHECK_TRUE(FlightLaneCode("local") == 0);
+  CHECK_TRUE(FlightLaneCode(nullptr) == 0);
+}
+
+void TestDataPlaneRecordsFlightHops() {
+  // A threaded 2-rank in-process world with the recorder attached: every
+  // hop of an UNSAMPLED op (no tracer at all) must land in the ring.
+  FlightRecorder fr0, fr1;
+  fr0.Configure(1024, "", 0, 2);
+  fr1.Configure(1024, "", 1, 2);
+  DataPlane a(0, 2), b(1, 2);
+  a.set_flightrec(&fr0);
+  b.set_flightrec(&fr1);
+  CHECK_TRUE(a.Listen().ok());
+  CHECK_TRUE(b.Listen().ok());
+  std::vector<PeerAddr> peers = {{"127.0.0.1", a.port()},
+                                 {"127.0.0.1", b.port()}};
+  Status sa, sb;
+  std::thread tb([&] { sb = b.Connect(peers); });
+  sa = a.Connect(peers);
+  tb.join();
+  CHECK_TRUE(sa.ok() && sb.ok());
+  std::vector<float> va(1024, 1.0f), vb(1024, 2.0f);
+  std::thread tr([&] {
+    sb = b.Allreduce(vb.data(), 1024, DataType::FLOAT32, ReduceOp::SUM);
+  });
+  sa = a.Allreduce(va.data(), 1024, DataType::FLOAT32, ReduceOp::SUM);
+  tr.join();
+  CHECK_TRUE(sa.ok() && sb.ok());
+  CHECK_TRUE(va[0] == 3.0f && vb[0] == 3.0f);
+  FlightImage img = DecodeFlightImage(
+      fr0.Snapshot(DumpReason::ON_DEMAND, -1));
+  bool saw_hop = false, saw_reduce = false;
+  for (const FlightRecord& r : img.recs) {
+    if (r.type == FlightEvent::SENDRECV || r.type == FlightEvent::SEND ||
+        r.type == FlightEvent::RECV) {
+      saw_hop = true;
+      CHECK_TRUE(r.send_peer == 1 || r.recv_peer == 1);
+      CHECK_TRUE(r.bytes > 0);
+    }
+    if (r.type == FlightEvent::REDUCE) saw_reduce = true;
+  }
+  CHECK_TRUE(saw_hop);
+  (void)saw_reduce;  // algo-dependent (RD at this size): hops are the pin
+  a.Shutdown();
+  b.Shutdown();
+}
+
 void TestIoControlWaitAccounting() {
   // A controlled recv with no data must accrue peer-wait time; completing
   // the transfer stops the clock.
@@ -1974,6 +2243,14 @@ int main() {
   TestTraceSamplerGating();
   TestTimelineSpanAndMetadata();
   TestIoControlWaitAccounting();
+  TestFlightRecorderSnapshotRoundtrip();
+  TestFlightRecorderWraparoundOldestFirst();
+  TestFlightRecorderNameOverflowSharesSlotZero();
+  TestFlightRecorderConcurrentWriters();
+  TestFlightRecorderDumpLatchAndOnDemand();
+  TestFlightRecorderSigtermDoesNotBurnLatch();
+  TestFlightLaneCodes();
+  TestDataPlaneRecordsFlightHops();
   if (failures == 0) {
     std::printf("native unit tests: ALL OK\n");
     return 0;

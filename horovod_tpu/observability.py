@@ -138,6 +138,27 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/debugz":
+            # Flight-recorder live view (docs/fault-tolerance.md): the
+            # in-flight op + last-N phase events, decoded from an in-memory
+            # ring snapshot. Secret-gated like /metrics.
+            fn = getattr(self.server, "metrics_debugz_fn", None)
+            if fn is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                body = fn().encode()
+            except Exception as exc:  # keep the endpoint alive
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(str(exc).encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/healthz":
             info = getattr(self.server, "metrics_health", None) or {}
             body = json.dumps(dict(info, status="ok")).encode()
@@ -162,12 +183,15 @@ class MetricsServer:
 
     def __init__(self, dump_fn: Callable[[], str], port: int = 0,
                  secret: Optional[str] = None,
-                 health: Optional[dict] = None):
+                 health: Optional[dict] = None,
+                 debugz_fn: Optional[Callable[[], str]] = None):
         self._server = ThreadingHTTPServer(("0.0.0.0", port),
                                            _MetricsHandler)
         self._server.metrics_dump_fn = dump_fn  # type: ignore[attr-defined]
         self._server.metrics_secret = secret  # type: ignore[attr-defined]
         self._server.metrics_health = health  # type: ignore[attr-defined]
+        # /debugz JSON source (flight-recorder live view); None = 404.
+        self._server.metrics_debugz_fn = debugz_fn  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -64,6 +64,18 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                    help="emit per-hop trace spans for every Nth collective "
                         "op (HVDTPU_TRACE_SAMPLE; default 10, 1 = every "
                         "op, 0 = op phases only)")
+    p.add_argument("--postmortem", default=None, metavar="DIR",
+                   help="post-mortem forensics (HVDTPU_FLIGHTREC_DIR; "
+                        "docs/fault-tolerance.md): every rank dumps its "
+                        "always-on flight recorder to DIR/flightrec."
+                        "<rank>.bin on abort/stall/fatal signal; when the "
+                        "job fails, the driver merges the surviving dumps "
+                        "into a clock-aligned Perfetto trace and prints "
+                        "the verdict (scripts/postmortem.py re-runs it)")
+    p.add_argument("--debugz", action="store_true",
+                   help="print each worker's /debugz URL at launch (the "
+                        "flight recorder's live in-flight-op view next to "
+                        "/metrics; requires --metrics-port)")
     p.add_argument("--fusion-threshold-mb", type=float, default=64.0,
                    help="tensor fusion threshold (reference: "
                         "HOROVOD_FUSION_THRESHOLD)")
@@ -337,19 +349,23 @@ def _apply_tuning_env(env: dict, args) -> dict:
     # their own files trace.<rank>.json (elastic rounds re-rank workers, so
     # the per-rank suffix must come from the worker, not the launcher).
     if args.trace:
-        os.makedirs(args.trace, exist_ok=True)
         # A reused directory keeps ranks beyond this world's size from a
         # previous run — the analyzer would silently merge two unrelated
         # runs. Clear our own naming pattern up front.
-        import glob
-        stale = glob.glob(os.path.join(args.trace, "trace.*.json"))
-        stale.append(os.path.join(args.trace, "merged_trace.json"))
-        for old in stale:
-            try:
-                os.unlink(old)
-            except OSError:
-                pass
+        _prepare_artifact_dir(args.trace, "trace.*.json",
+                              "merged_trace.json")
         env[ev.HVDTPU_TRACE] = args.trace
+    # Post-mortem forensics: point every rank's always-on flight recorder
+    # at one dump directory (workers on this host land there directly;
+    # remote workers keep theirs on their own hosts — copy them over and
+    # run scripts/postmortem.py). Stale dumps from a previous run would
+    # convict the wrong rank. Absolute path: a worker that chdir()s after
+    # init must still dump where the driver will look.
+    if args.postmortem:
+        args.postmortem = os.path.abspath(args.postmortem)
+        _prepare_artifact_dir(args.postmortem, "flightrec.*.bin",
+                              "merged_postmortem.json")
+        env[ev.HVDTPU_FLIGHTREC_DIR] = args.postmortem
     if args.trace_sample is not None:
         if args.trace_sample < 0:
             raise SystemExit("hvdrun: --trace-sample must be >= 0")
@@ -383,6 +399,22 @@ def _apply_tuning_env(env: dict, args) -> dict:
             env[ev.HVDTPU_AUTOTUNE_GAUSSIAN_PROCESS_NOISE] = str(
                 args.autotune_gaussian_process_noise)
     return env
+
+
+def _prepare_artifact_dir(path: str, stale_glob: str,
+                          merged_name: str) -> None:
+    """Create a per-run artifact directory (trace / post-mortem dumps) and
+    clear this launcher's own naming pattern from a previous run — stale
+    per-rank files would silently merge two unrelated runs."""
+    import glob
+    os.makedirs(path, exist_ok=True)
+    stale = glob.glob(os.path.join(path, stale_glob))
+    stale.append(os.path.join(path, merged_name))
+    for old in stale:
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
 
 
 def _build_env(slot: hosts_mod.SlotInfo, args, controller_host: str,
@@ -442,6 +474,18 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
     """Elastic path (reference: _run_elastic, launch.py:624)."""
     from .elastic import ElasticSettings, HostDiscoveryScript, run_elastic
 
+    metrics_base_pre = args.metrics_port if args.metrics_port is not None \
+        else ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
+    if args.debugz:
+        if metrics_base_pre <= 0:
+            raise SystemExit("hvdrun: --debugz requires --metrics-port (the "
+                             "/debugz endpoint rides each worker's metrics "
+                             "server)")
+        # Elastic ranks move between hosts across rendezvous rounds; the
+        # stable fact is the port formula, not a static URL list.
+        print(f"hvdrun: debugz: rank r serves "
+              f"http://<its-host>:{metrics_base_pre}+r/debugz "
+              "(flight-recorder live view)", file=sys.stderr)
     _resolve_chaos(args, args.min_np or args.num_proc)
     settings = ElasticSettings(
         min_np=args.min_np or args.num_proc,
@@ -468,6 +512,8 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
         # rank suffix — still the right trace for "why was the final world
         # slow". Merge what landed locally.
         _merge_trace_dir(args.trace)
+    if args.postmortem and rc != 0:
+        _postmortem_report(args.postmortem)
     return rc
 
 
@@ -532,6 +578,10 @@ def run_launcher(args: argparse.Namespace) -> int:
     # scrape URLs so the operator can point a browser/Prometheus at them.
     metrics_base = args.metrics_port if args.metrics_port is not None else \
         ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
+    if args.debugz and metrics_base <= 0:
+        raise SystemExit("hvdrun: --debugz requires --metrics-port (the "
+                         "/debugz endpoint rides each worker's metrics "
+                         "server)")
     aggregator = None
     if metrics_base > 0:
         from .preflight import check_metrics_ports
@@ -544,6 +594,11 @@ def run_launcher(args: argparse.Namespace) -> int:
             print(f"hvdrun: metrics: rank {s.rank} -> "
                   f"http://{s.hostname}:{metrics_base + s.rank}/metrics",
                   file=sys.stderr)
+        if args.debugz:
+            for s in slots:
+                print(f"hvdrun: debugz: rank {s.rank} -> "
+                      f"http://{s.hostname}:{metrics_base + s.rank}/debugz",
+                      file=sys.stderr)
         # The aggregator binds on THIS (driver) machine, which need not be
         # the controller host — advertise the driver's reachable address.
         from .preflight import local_addr
@@ -588,6 +643,13 @@ def run_launcher(args: argparse.Namespace) -> int:
             aggregator.stop()
     if args.trace:
         _merge_trace_dir(args.trace)
+    if args.postmortem and rc != 0:
+        # The launcher knows which ranks ran on THIS host — their dumps are
+        # the only ones expected locally; remote ranks' missing dumps read
+        # as "uncollected", never as deaths.
+        _postmortem_report(args.postmortem,
+                           local_ranks={s.rank for s in slots
+                                        if _is_local(s.hostname)})
     return rc
 
 
@@ -621,6 +683,30 @@ def _merge_trace_dir(trace_dir: str) -> None:
               file=sys.stderr)
     except Exception as exc:  # observability must never fail the job
         print(f"hvdrun: trace: merge failed: {exc}", file=sys.stderr)
+
+
+def _postmortem_report(dump_dir: str, local_ranks=None) -> None:
+    """Job-failure forensics (hvdrun --postmortem; docs/fault-tolerance.md):
+    merge whatever flight-recorder dumps the surviving ranks froze, write
+    the clock-aligned last-window Perfetto view, and print the verdict —
+    which rank died/hung, its last in-flight op, what everyone else was
+    blocked on. Best-effort like the trace merge: remote workers' dumps
+    live on their own hosts, and forensics never masks the job's own exit."""
+    try:
+        from ..postmortem import format_verdict, run_postmortem
+        verdict, merged_path = run_postmortem(dump_dir,
+                                              local_ranks=local_ranks)
+        print(format_verdict(verdict), file=sys.stderr)
+        print(f"hvdrun: postmortem: merged trace -> {merged_path} "
+              "(load in https://ui.perfetto.dev; scripts/postmortem.py "
+              "re-runs the analysis)", file=sys.stderr)
+    except FileNotFoundError:
+        print(f"hvdrun: postmortem: no flightrec.<rank>.bin dumps in "
+              f"{dump_dir} (remote workers keep theirs on their own hosts; "
+              "copy them here and run scripts/postmortem.py)",
+              file=sys.stderr)
+    except Exception as exc:  # observability must never fail the job
+        print(f"hvdrun: postmortem: analysis failed: {exc}", file=sys.stderr)
 
 
 def main(argv: List[str] = None) -> int:

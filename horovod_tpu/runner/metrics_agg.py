@@ -120,6 +120,12 @@ def histogram_quantile(parsed_by_rank: Dict[int, dict], name: str,
     total = buckets[bounds[-1]]  # cumulative: +Inf holds the count
     if total <= 0:
         return None
+    if len(bounds) == 1:
+        # A lone +Inf bucket carries a count but ZERO bound information —
+        # interpolating from an implicit 0.0 would report "p50 = 0s" for a
+        # histogram whose every observation might be minutes. Promtool's
+        # histogram_quantile returns NaN here; None is our spelling.
+        return None if bounds[0] == float("inf") else bounds[0]
     target = q * total
     prev_bound, prev_cum = 0.0, 0.0
     for b in bounds:

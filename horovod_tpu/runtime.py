@@ -425,11 +425,21 @@ def init(comm: Optional[Sequence[int]] = None,
             if metrics_base > 0:
                 from .observability import MetricsServer
                 port = metrics_base + st.rank
+
+                def _debugz(core=st.core):
+                    # Flight-recorder live view next to /metrics: in-flight
+                    # op + last-N ring events (docs/fault-tolerance.md).
+                    from .flightrec import debugz_json
+                    snap = (core.flightrec_snapshot()
+                            if hasattr(core, "flightrec_snapshot") else b"")
+                    return debugz_json(snap)
+
                 try:
                     st.metrics_server = MetricsServer(
                         dump_fn=st.core.metrics_dump, port=port,
                         secret=ev.get_str(ev.HVDTPU_SECRET) or None,
-                        health={"rank": st.rank, "size": st.size})
+                        health={"rank": st.rank, "size": st.size},
+                        debugz_fn=_debugz)
                 except OSError as exc:
                     # The core already joined the world — tear it down
                     # before failing or it would linger as a zombie rank
@@ -597,6 +607,31 @@ def metrics_server():
     """The worker's running :class:`horovod_tpu.observability.MetricsServer`
     (``HVDTPU_METRICS_PORT`` > 0 in process mode) or None."""
     return _require_init().metrics_server
+
+
+def debugz(last_n: int = 50) -> dict:
+    """Flight-recorder live view (docs/fault-tolerance.md "Post-mortem
+    debugging"): this rank's in-flight op, last wire hop, and the last
+    ``last_n`` ring events — the same JSON the worker's ``/debugz``
+    endpoint serves. ``{"flightrec": "disabled"}`` when the recorder is
+    off or outside process mode."""
+    from .flightrec import debugz_dict
+    st = _require_init()
+    if st.core is None or not hasattr(st.core, "flightrec_snapshot"):
+        return {"flightrec": "disabled"}
+    return debugz_dict(st.core.flightrec_snapshot(), last_n=last_n)
+
+
+def flightrec_dump(path: Optional[str] = None) -> bool:
+    """On-demand flight-recorder dump to ``path`` (None = the configured
+    ``HVDTPU_FLIGHTREC_DIR/flightrec.<rank>.bin``); decode with
+    ``scripts/postmortem.py`` or :mod:`horovod_tpu.flightrec`. False when
+    the recorder is disabled, no destination is known, or outside process
+    mode."""
+    st = _require_init()
+    if st.core is None or not hasattr(st.core, "flightrec_dump"):
+        return False
+    return st.core.flightrec_dump(path)
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:

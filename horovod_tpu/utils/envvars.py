@@ -126,6 +126,27 @@ HVDTPU_TRACE_CLOCK_SYNC_SECONDS = "HVDTPU_TRACE_CLOCK_SYNC_SECONDS"
 # Default every-Nth-op hop-span sampling rate while tracing.
 DEFAULT_TRACE_SAMPLE = 10
 
+# Always-on flight recorder (native/flightrec.{h,cpp} +
+# horovod_tpu/flightrec.py; docs/fault-tolerance.md "Post-mortem
+# debugging"). FLIGHTREC: "1" (default) keeps the in-memory ring of compact
+# binary phase records live on every rank — unsampled, JSON-free, inside
+# the <2% observability budget; "0" disables. FLIGHTREC_EVENTS: ring
+# capacity in records (default 4096, ~160 KB). FLIGHTREC_DIR: directory
+# for the automatic flightrec.<rank>.bin dumps on abort cascade / stall
+# escalation / fatal signals (unset = in-memory only; the /debugz endpoint
+# and hvdtpu_flightrec_snapshot still work). `hvdrun --postmortem DIR`
+# sets it and runs scripts/postmortem.py on job failure.
+HVDTPU_FLIGHTREC = "HVDTPU_FLIGHTREC"
+HVDTPU_FLIGHTREC_EVENTS = "HVDTPU_FLIGHTREC_EVENTS"
+HVDTPU_FLIGHTREC_DIR = "HVDTPU_FLIGHTREC_DIR"
+
+# Default flight-recorder ring capacity in records, and the sanity ceiling
+# (16M records = 640 MB of ring) init enforces so a typo'd value fails
+# naming the knob instead of dying in a native allocation. The native side
+# floors nonzero capacities at 64 records.
+DEFAULT_FLIGHTREC_EVENTS = 4096
+MAX_FLIGHTREC_EVENTS = 16 * 1024 * 1024
+
 # Autotune (reference: HOROVOD_AUTOTUNE, HOROVOD_AUTOTUNE_LOG,
 # horovod/common/operations.cc:474-532)
 HVDTPU_AUTOTUNE = "HVDTPU_AUTOTUNE"

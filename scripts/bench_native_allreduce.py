@@ -44,7 +44,8 @@ NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
 # "lib" pairs two .so builds (the HEAD-vs-new gate that used to run as two
 # unpaired sweeps, ±10% drift windows apart, on this box).
 AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
-            "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace")
+            "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace",
+            "flightrec")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -119,6 +120,13 @@ def load_lib(path: str) -> ctypes.CDLL:
                                          ctypes.c_double]
     except AttributeError:
         pass  # pre-tracing build
+    try:
+        lib.hvdtpu_set_flightrec.restype = ctypes.c_int
+        lib.hvdtpu_set_flightrec.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_longlong,
+                                             ctypes.c_char_p]
+    except AttributeError:
+        pass  # pre-flight-recorder build
     return lib
 
 
@@ -197,6 +205,19 @@ def run_worker(args) -> int:
         print("SKIP compression config: library has no wire compression",
               file=sys.stderr)
         return 0
+    if args.flightrec != "default":
+        # Explicit on/off only (the "default" arm never calls the API, so
+        # `--ab lib=old:new` still runs against pre-flight-recorder .so
+        # builds). on = production default (4096-record ring, no dump
+        # dir); `--ab flightrec=off:on` is the always-on observability-
+        # budget gate (docs/benchmarks.md).
+        if hasattr(lib, "hvdtpu_set_flightrec"):
+            lib.hvdtpu_set_flightrec(
+                core, 4096 if args.flightrec == "on" else 0, b"")
+        else:
+            print("SKIP flightrec config: library has no flight recorder",
+                  file=sys.stderr)
+            return 0
     if hasattr(lib, "hvdtpu_set_transport_ext"):
         lib.hvdtpu_set_transport_ext(core, ZC_MODES[args.tcp_zerocopy],
                                      NUMA_MODES[args.shm_numa],
@@ -304,7 +325,8 @@ def run_config(args, world: int, algo: str, sizes: list,
            "tcp-zerocopy": args.tcp_zerocopy, "shm-numa": args.shm_numa,
            "doorbell-batch": args.doorbell_batch,
            "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
-           "lib": args.lib, "trace": args.trace}
+           "lib": args.lib, "trace": args.trace,
+           "flightrec": args.flightrec}
     if overrides:
         cfg.update(overrides)
     port = free_port()
@@ -325,6 +347,7 @@ def run_config(args, world: int, algo: str, sizes: list,
                "--doorbell-batch", str(cfg["doorbell-batch"]),
                "--trace", str(cfg["trace"]),
                "--trace-sample", str(args.trace_sample),
+               "--flightrec", str(cfg["flightrec"]),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -356,7 +379,8 @@ def run_config(args, world: int, algo: str, sizes: list,
                     "tcp_zerocopy": cfg["tcp-zerocopy"],
                     "shm_numa": cfg["shm-numa"],
                     "doorbell_batch": cfg["doorbell-batch"],
-                    "trace": cfg["trace"]})
+                    "trace": cfg["trace"],
+                    "flightrec": cfg["flightrec"]})
     return rows, failed
 
 
@@ -492,6 +516,10 @@ def main(argv=None) -> int:
     p.add_argument("--max-bytes", type=int, default=256 << 20)
     p.add_argument("--size-step", type=int, default=16,
                    help="geometric step between message sizes")
+    p.add_argument("--size-list", default=None,
+                   help="explicit comma-separated message sizes in bytes "
+                        "(overrides --min-bytes/--max-bytes/--size-step; "
+                        "e.g. '4096,16777216,67108864')")
     p.add_argument("--crossover", type=int, default=-1,
                    help="ring/latency-algorithm crossover bytes (-1: default)")
     p.add_argument("--segment", type=int, default=-1,
@@ -526,6 +554,13 @@ def main(argv=None) -> int:
     p.add_argument("--doorbell-batch", type=int, default=0,
                    help="shm futex-doorbell coalescing window, bytes "
                         "(0 = default, 1 = wake per cursor advance)")
+    p.add_argument("--flightrec", default="default",
+                   choices=["default", "on", "off"],
+                   help="always-on flight recorder (HVDTPU_FLIGHTREC): "
+                        "'default' leaves the library's default (on for "
+                        "this build, absent on older .so builds — keeps "
+                        "--ab lib=old:new runnable); --ab flightrec=off:on "
+                        "is the observability-budget gate")
     p.add_argument("--ab", default=None, metavar="FLAG=A:B",
                    help="paired interleaved A/B over one knob, e.g. "
                         "'doorbell-batch=1:0' or 'tcp-zerocopy=off:on': "
@@ -555,7 +590,8 @@ def main(argv=None) -> int:
     if args.smoke:
         args.timeout = min(args.timeout, 300.0)
         return run_smoke(args)
-    sizes = parse_sizes(args)
+    sizes = ([int(s) for s in args.size_list.split(",")]
+             if args.size_list else parse_sizes(args))
     worlds = [int(w) for w in args.world_sizes.split(",")]
     algos = args.algos.split(",")
     if args.quick:

@@ -534,6 +534,19 @@ def check_enum_mirrors(root: Path, findings, ran):
               ENVVARS_PY, "TCP_ZEROCOPY_MODES")
     dict_pair("ShmNumaMode", f"{NATIVE_DIR}/shm_transport.h", "ShmNumaMode",
               ENVVARS_PY, "SHM_NUMA_MODES")
+    # Flight-recorder binary dump format (ISSUE 12): the record type tags
+    # and dump reasons cross the C++/Python boundary inside
+    # flightrec.<rank>.bin — a drifted value misdecodes a post-mortem
+    # instead of crashing it.
+    dict_pair("FlightEvent", f"{NATIVE_DIR}/flightrec.h", "FlightEvent",
+              "horovod_tpu/flightrec.py", "FLIGHT_EVENTS")
+    dict_pair("DumpReason", f"{NATIVE_DIR}/flightrec.h", "DumpReason",
+              "horovod_tpu/flightrec.py", "DUMP_REASONS")
+    # postmortem.py keeps its own OpType literal (no runtime import) to
+    # label the fatal op; a drifted code misnames the collective in the
+    # verdict, so it is pinned like the others.
+    dict_pair("OpType-postmortem", f"{NATIVE_DIR}/common.h", "OpType",
+              "horovod_tpu/postmortem.py", "_OP_TYPES")
 
     # ReduceOp: IntEnum mirror, names compared verbatim.
     cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/common.h", "ReduceOp")

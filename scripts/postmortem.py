@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Post-mortem forensics CLI over flight-recorder dumps
+(docs/fault-tolerance.md "Post-mortem debugging").
+
+    python scripts/postmortem.py DUMP_DIR
+    python scripts/postmortem.py DUMP_DIR -o merged.json --window-ms 500
+    python scripts/postmortem.py DUMP_DIR --json   # verdict as JSON
+
+DUMP_DIR holds the ``flightrec.<rank>.bin`` files every surviving rank
+wrote when the job died (``hvdrun --postmortem DIR`` collects them there
+and runs this automatically). Output: a merged, clock-aligned Perfetto
+trace of the last --window-ms milliseconds (load in
+https://ui.perfetto.dev) plus a verdict naming the dead/hung rank, its
+last in-flight op and hop peer, and what every surviving rank was blocked
+on.
+
+Exit status: 0 on a verdict, 1 when the directory holds no dumps, 2 on
+bad arguments.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.postmortem import (DEFAULT_WINDOW_MS,  # noqa: E402
+                                    format_verdict, run_postmortem)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dump_dir", help="directory of flightrec.<rank>.bin dumps")
+    p.add_argument("-o", "--output", default=None,
+                   help="merged Perfetto trace path "
+                        "(default DUMP_DIR/merged_postmortem.json)")
+    p.add_argument("--window-ms", type=int, default=DEFAULT_WINDOW_MS,
+                   help="merged-view window before the freeze in ms "
+                        "(0 = everything the rings kept; default "
+                        f"{DEFAULT_WINDOW_MS})")
+    p.add_argument("--json", action="store_true",
+                   help="print the verdict as JSON instead of text")
+    args = p.parse_args(argv)
+    if args.window_ms < 0:
+        p.error("--window-ms must be >= 0")
+    try:
+        verdict, merged_path = run_postmortem(args.dump_dir, args.output,
+                                              window_ms=args.window_ms)
+    except FileNotFoundError as exc:
+        print(f"postmortem: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        print(format_verdict(verdict))
+    print(f"postmortem: merged trace -> {merged_path} "
+          "(load in https://ui.perfetto.dev)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

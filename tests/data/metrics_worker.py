@@ -104,6 +104,13 @@ assert sample_value(parsed, "hvdtpu_rank") == 0.0
 health = json.loads(scrape("127.0.0.1", base + 0, "/healthz",
                            secret=secret, timeout=10.0))
 assert health["status"] == "ok" and health["rank"] == 0, health
+# /debugz rides the same server: the flight recorder's live view shows
+# rank 0's identity and the ops every rank just ran (ISSUE 12).
+dz = json.loads(scrape("127.0.0.1", base + 0, "/debugz",
+                       secret=secret, timeout=10.0))
+assert dz["flightrec"] == "on" and dz["rank"] == 0, dz
+assert dz["records_written"] > 0, dz
+assert any(ev["type"] == "op_end" for ev in dz["last_events"]), dz
 if secret:
     # With a cluster secret set, a proof-less scrape of a LIVE worker
     # endpoint must be rejected (tests/test_security.py satellite).

@@ -88,6 +88,102 @@ class TestExpositionParser:
         (_suf, labels, value), = parsed["esc_total"]["samples"]
         assert labels == {"name": 'a"b\\c'} and value == 1.0
 
+    def test_escaped_label_values_exhaustive(self):
+        """ISSUE 12 satellite: every escape the exposition format defines
+        (\\n, \\", \\\\) plus a literal '}' inside a value — the greedy
+        label-block regex must not truncate at the embedded brace."""
+        from horovod_tpu.observability import parse_prometheus_text
+        parsed = parse_prometheus_text(
+            'esc_total{a="line1\\nline2",b="br{ace}s",c="tail\\\\"} 2\n')
+        (_suf, labels, value), = parsed["esc_total"]["samples"]
+        assert labels == {"a": "line1\nline2", "b": "br{ace}s",
+                          "c": "tail\\"}
+        assert value == 2.0
+
+    def test_inf_bucket_roundtrip_through_relabel(self):
+        """ISSUE 12 satellite: the +Inf bucket must survive parse ->
+        relabel -> reparse with its `le` intact and still resolve through
+        sample_value — the aggregator's quantile math keys on it."""
+        from horovod_tpu.observability import (parse_prometheus_text,
+                                               sample_value)
+        from horovod_tpu.runner.metrics_agg import relabel_with_rank
+        relabeled = relabel_with_rank(SAMPLE, 3)
+        assert 'hvdtpu_cycle_seconds_bucket{le="+Inf",rank="3"} 9' \
+            in relabeled
+        parsed = parse_prometheus_text(relabeled)
+        assert sample_value(parsed, "hvdtpu_cycle_seconds", suffix="bucket",
+                            le="+Inf", rank="3") == 9
+        # The finite bucket kept its bound too (no float re-rendering).
+        assert sample_value(parsed, "hvdtpu_cycle_seconds", suffix="bucket",
+                            le="0.0001", rank="3") == 5
+
+
+class TestHistogramQuantile:
+    """ISSUE 12 satellite: the merged-histogram quantile helper's edge
+    cases — empty, zero-count, and single-bucket histograms."""
+
+    @staticmethod
+    def _parse(text):
+        from horovod_tpu.observability import parse_prometheus_text
+        return parse_prometheus_text(text)
+
+    def test_empty_inputs(self):
+        from horovod_tpu.runner.metrics_agg import histogram_quantile
+        assert histogram_quantile({}, "hvdtpu_recovery_seconds", 0.5) is None
+        # Parsed dumps without the family, and with the family but no
+        # bucket samples, both report "no data" instead of crashing.
+        assert histogram_quantile(
+            {0: self._parse("# TYPE x counter\nx 1\n")}, "h", 0.5) is None
+        assert histogram_quantile(
+            {0: self._parse("# TYPE h histogram\nh_sum 0\nh_count 0\n")},
+            "h", 0.5) is None
+
+    def test_zero_count_histogram(self):
+        from horovod_tpu.runner.metrics_agg import histogram_quantile
+        parsed = self._parse(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 0\nh_bucket{le="+Inf"} 0\n'
+            "h_sum 0\nh_count 0\n")
+        assert histogram_quantile({0: parsed}, "h", 0.5) is None
+
+    def test_single_inf_bucket_has_no_bound_info(self):
+        """A lone +Inf bucket holds a count but no bound — the helper used
+        to interpolate from an implicit 0.0 and report p50=0 for a
+        histogram whose observations could be anything."""
+        from horovod_tpu.runner.metrics_agg import histogram_quantile
+        parsed = self._parse(
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 7\nh_sum 3.5\nh_count 7\n')
+        assert histogram_quantile({0: parsed}, "h", 0.5) is None
+
+    def test_single_finite_bucket_interpolates(self):
+        from horovod_tpu.runner.metrics_agg import histogram_quantile
+        parsed = self._parse(
+            "# TYPE h histogram\n"
+            'h_bucket{le="2"} 4\nh_bucket{le="+Inf"} 4\n'
+            "h_sum 4\nh_count 4\n")
+        # All mass in [0, 2]: the median interpolates to the middle.
+        assert histogram_quantile({0: parsed}, "h", 0.5) \
+            == pytest.approx(1.0)
+
+    def test_merges_bucket_counts_across_ranks(self):
+        from horovod_tpu.runner.metrics_agg import histogram_quantile
+        a = self._parse("# TYPE h histogram\n"
+                        'h_bucket{le="1"} 10\nh_bucket{le="2"} 10\n'
+                        'h_bucket{le="+Inf"} 10\n')
+        b = self._parse("# TYPE h histogram\n"
+                        'h_bucket{le="1"} 0\nh_bucket{le="2"} 10\n'
+                        'h_bucket{le="+Inf"} 10\n')
+        # 20 observations total: 10 under 1, 10 in (1, 2]; p75 lands
+        # halfway through the second bucket.
+        assert histogram_quantile({0: a, 1: b}, "h", 0.75) \
+            == pytest.approx(1.5)
+        # Observations above every finite bound: the finite edge is the
+        # best lower bound the data supports.
+        c = self._parse("# TYPE h histogram\n"
+                        'h_bucket{le="1"} 1\nh_bucket{le="+Inf"} 9\n')
+        assert histogram_quantile({0: c}, "h", 0.99) == 1.0
+
 
 class TestMetricsServer:
     def test_serve_and_scrape(self):
