@@ -18,7 +18,8 @@ from .runtime import (init, shutdown, is_initialized, rank, size, local_rank,
                       local_size, cross_rank, cross_size, is_homogeneous, mesh,
                       dp_axis, mode, start_timeline, stop_timeline,
                       start_trace, stop_trace,
-                      metrics, metrics_dump, debugz, flightrec_dump)
+                      metrics, metrics_dump, debugz, flightrec_dump,
+                      perf_report)
 
 # Collectives (reference: horovod/torch/mpi_ops.py).
 from .ops.collectives import (

@@ -162,6 +162,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_flightrec.restype = ctypes.c_int
     lib.hvdtpu_set_flightrec.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                          ctypes.c_char_p]
+    lib.hvdtpu_set_perfstats.restype = ctypes.c_int
+    lib.hvdtpu_set_perfstats.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+        ctypes.c_char_p]
+    lib.hvdtpu_perfstats_snapshot.restype = ctypes.c_longlong
+    lib.hvdtpu_perfstats_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvdtpu_flightrec_dump.restype = ctypes.c_int
     lib.hvdtpu_flightrec_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.hvdtpu_flightrec_snapshot.restype = ctypes.c_longlong
@@ -288,6 +295,31 @@ class NativeCore:
             os.makedirs(fr_dir, exist_ok=True)
         self._lib.hvdtpu_set_flightrec(self._core, fr_events,
                                        fr_dir.encode())
+        # Always-on perf attribution (docs/observability.md): streaming
+        # per-key baselines + the slowdown sentry. The profile path is
+        # absolute for the same chdir() reason as the flight-recorder dir.
+        perf_pct = ev.get_float(ev.HVDTPU_PERF_SLOWDOWN_PCT,
+                                ev.DEFAULT_PERF_SLOWDOWN_PCT)
+        if perf_pct < 0:
+            raise ValueError(
+                f"{ev.HVDTPU_PERF_SLOWDOWN_PCT} must be >= 0 percent "
+                f"(0 disables the sentry), got {perf_pct}")
+        perf_min = ev.get_int(ev.HVDTPU_PERF_MIN_SAMPLES,
+                              ev.DEFAULT_PERF_MIN_SAMPLES)
+        if perf_min < 1:
+            raise ValueError(
+                f"{ev.HVDTPU_PERF_MIN_SAMPLES} must be >= 1 sample, "
+                f"got {perf_min}")
+        perf_on = ev.get_bool(ev.HVDTPU_PERFSTATS, default=True)
+        profile_path = ""
+        profile_dir = ev.get_str(ev.HVDTPU_PERF_PROFILE_DIR, "") or ""
+        if profile_dir and perf_on:
+            profile_dir = os.path.abspath(profile_dir)
+            os.makedirs(profile_dir, exist_ok=True)
+            profile_path = os.path.join(profile_dir,
+                                        f"perf_profile.{rank}.json")
+        self._lib.hvdtpu_set_perfstats(self._core, int(perf_on), perf_pct,
+                                       perf_min, profile_path.encode())
         # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
         self._lib.hvdtpu_set_cache_capacity(
             self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
@@ -596,6 +628,15 @@ class NativeCore:
         self._lib.hvdtpu_clock_offset(self._core, ctypes.byref(off),
                                       ctypes.byref(err))
         return off.value, err.value
+
+    def perfstats_snapshot(self) -> bytes:
+        """Keyed perf-baseline snapshot as JSON bytes (decode with
+        :mod:`horovod_tpu.perfstats` / ``json.loads``): per-{tensor-set,
+        algo, transport, hier, compression, op} EWMA + p50/p99 of op wall time
+        and the wait/wire/reduce/codec phase buckets, plus anomaly counts.
+        The same payload the ``/perfz`` endpoint serves. ``b""`` when the
+        core is shut down."""
+        return self._probe_then_copy(self._lib.hvdtpu_perfstats_snapshot)
 
     def flightrec_snapshot(self) -> bytes:
         """Serialized flight-recorder dump image (binary; decode with

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional
 FLIGHT_EVENTS = {"none": 0, "op_begin": 1, "op_end": 2, "send": 3,
                  "recv": 4, "sendrecv": 5, "reduce": 6, "quantize": 7,
                  "dequantize": 8, "fusion_wait": 9, "fail_detect": 10,
-                 "stall": 11, "abort": 12, "mark": 13}
+                 "stall": 11, "abort": 12, "mark": 13, "anomaly": 14}
 EVENT_NAMES = {v: k for k, v in FLIGHT_EVENTS.items()}
 
 # Byte-for-byte mirror of hvdtpu::DumpReason (native/flightrec.h).

@@ -41,6 +41,7 @@
 #include "data_plane.h"
 #include "message.h"
 #include "metrics.h"
+#include "perfstats.h"
 #include "socket_util.h"
 #include "timeline.h"
 #include "tracing.h"
@@ -253,6 +254,16 @@ struct CoreConfig {
   // /debugz still work).
   int64_t flightrec_events = 4096;
   std::string flightrec_dir;
+  // Always-on perf attribution (perfstats.h; docs/observability.md). The
+  // streaming baselines are on by default (HVDTPU_PERFSTATS=0 disables);
+  // the slowdown sentry fires past slowdown_pct once a key has
+  // min_samples. perf_profile_path: where Shutdown persists this rank's
+  // per-key baselines + anomaly log for the cross-run regression sentry
+  // (HVDTPU_PERF_PROFILE_DIR -> perf_profile.<rank>.json; empty = skip).
+  bool perfstats = true;
+  double perf_slowdown_pct = 50.0;
+  int64_t perf_min_samples = 20;
+  std::string perf_profile_path;
   double stall_warn_secs = 60.0;  // reference HOROVOD_STALL_CHECK_TIME
   // Shared job secret (reference: runner/common/util/secret.py). When set,
   // every HELLO must carry an HMAC proof; unauthenticated connections are
@@ -388,6 +399,10 @@ class Core {
     if (ok && m_flightrec_dumps_ != nullptr) m_flightrec_dumps_->Inc();
     return ok;
   }
+  // Perf-attribution surface (C API hvdtpu_perfstats_snapshot; /perfz).
+  // Keyed-baseline snapshot as JSON — lock-free reads, callable from any
+  // thread at any point in the core lifecycle.
+  std::string PerfSnapshot() { return perfstats_.SnapshotJson(); }
   CoreConfig* mutable_config() { return &cfg_; }  // pre-Start() only
 
  private:
@@ -449,11 +464,28 @@ class Core {
   // paths dump it (FailAllOutstanding, CheckStalls escalation, the signal
   // handlers flightrec.cpp installs).
   FlightRecorder flightrec_;
+  // Always-on perf attribution: streaming per-key baselines fed after
+  // every completed op; the slowdown sentry rides ObserveOp
+  // (docs/observability.md "Live perf attribution").
+  PerfStats perfstats_;
+  // Anomaly log for perf_profile.<rank>.json (background thread only,
+  // bounded; written out by Shutdown after the loop is joined).
+  std::vector<std::string> perf_anomaly_log_;
+  bool perf_profile_written_ = false;
+  // Sentry log throttle: anomalies can cluster (every op of a slow phase
+  // fires) — the counter and flight ring record each one, the LOG warns at
+  // most once per second (background thread only).
+  double last_perf_warn_at_ = 0;
+  void WritePerfProfile();
 
-  // One histogram-pair + counter observation per completed data-plane op.
+  // One histogram-pair + counter observation per completed data-plane op,
+  // plus the perf-attribution sentry: `perf_sig` is the tensor-set
+  // signature keying the streaming baselines (empty skips perf — JOIN,
+  // failed lookups).
   void ObserveOp(const char* op, double secs, int64_t bytes,
                  const char* algo, const std::string& transport, bool hier,
-                 const char* compression, DataType dtype, bool ok);
+                 const char* compression, DataType dtype, bool ok,
+                 const std::string& perf_sig = std::string());
   // Refresh the autotune-owned parameter gauges (Start + every adoption).
   void UpdateParamGauges(double cycle_ms, int64_t fusion, bool cache_on,
                          int64_t crossover);
@@ -591,6 +623,10 @@ class Core {
   Counter* m_failures_detected_ = nullptr;
   Histogram* m_recovery_seconds_ = nullptr;
   Counter* m_flightrec_dumps_ = nullptr;
+  // Clock-sync quality vs rank 0 (PR-8 alignment), refreshed at every
+  // adoption so the aggregator/console can flag degraded ranks.
+  Gauge* m_clock_offset_gauge_ = nullptr;
+  Gauge* m_clock_err_gauge_ = nullptr;
   // One failure-cascade count per core incarnation: after the plane aborts,
   // every queued op fails with the same coherent status — only the first
   // detection is a new failure (background thread only).
@@ -673,7 +709,7 @@ void Core::EmitTraceMeta() {
 void Core::ObserveOp(const char* op, double secs, int64_t bytes,
                      const char* algo, const std::string& transport,
                      bool hier, const char* compression, DataType dtype,
-                     bool ok) {
+                     bool ok, const std::string& perf_sig) {
   MetricLabels labels{{"op", op},
                       {"algo", algo},
                       {"transport", transport},
@@ -695,6 +731,107 @@ void Core::ObserveOp(const char* op, double secs, int64_t bytes,
                   MetricLabels{{"op", op}})
       ->Inc();
   if (!ok) m_op_errors_->Inc();
+
+  // Perf attribution (docs/observability.md): feed the streaming baselines
+  // and run the slowdown sentry. Failed ops are excluded — their wall time
+  // measures abort latency, not performance — and only real tensor-set
+  // signatures key a baseline.
+  if (!ok || !perfstats_.enabled() || perf_sig.empty()) return;
+  // The op type is part of the key: a BROADCAST and an ALLREDUCE of the
+  // same tensor name have unrelated cost profiles — sharing a baseline
+  // would let the cheaper op drag it down and fire phantom anomalies on
+  // the costlier one.
+  std::string key;
+  key.reserve(perf_sig.size() + transport.size() + 36);
+  key += perf_sig;
+  key += '|';
+  key += algo;
+  key += '|';
+  key += transport;
+  key += hier ? "|1|" : "|0|";
+  key += compression;
+  key += '|';
+  key += op;
+  PerfStats::OpSample sample;
+  sample.wall_us = static_cast<int64_t>(secs * 1e6);
+  sample.wait_us = data_plane_.op_wait_us();
+  sample.wire_us = data_plane_.op_wire_us();
+  sample.reduce_us = data_plane_.op_reduce_us();
+  sample.codec_us = data_plane_.op_codec_us();
+  sample.slow_peer = data_plane_.op_slow_peer();
+  const PerfStats::Anomaly an =
+      perfstats_.RecordOp(perfstats_.KeySlot(key), sample);
+  if (!an.fired) return;
+  metrics_
+      .GetCounter(
+          "hvdtpu_perf_anomalies_total",
+          "Completed ops the slowdown sentry flagged against their rolling "
+          "baseline (HVDTPU_PERF_SLOWDOWN_PCT), by dominant phase",
+          MetricLabels{{"phase", PerfPhaseName(an.phase)}})
+      ->Inc();
+  {
+    // Flight ring: the anomaly spans the op it flags; arg carries the
+    // dominant-phase code, send_peer the wire-slow suspect (-1 otherwise).
+    const int64_t now = Timeline::SteadyAbsUs();
+    flightrec_.Record(FlightEvent::ANOMALY, flightrec_.InternName(perf_sig),
+                      bytes, an.slow_peer, -1, now - sample.wall_us, now,
+                      static_cast<int64_t>(an.phase), 0);
+  }
+  const double warn_now = NowSeconds();
+  if (warn_now - last_perf_warn_at_ >= 1.0) {
+    last_perf_warn_at_ = warn_now;
+    LogWarn(cfg_.rank,
+            "perf sentry: op '%s' ran %.2fx its baseline (%.2f ms vs "
+            "%.2f ms), dominant phase %s%s",
+            perf_sig.c_str(), an.ratio, sample.wall_us / 1e3,
+            an.baseline_us / 1e3, PerfPhaseName(an.phase),
+            an.slow_peer >= 0
+                ? (" (slow hop peer rank " + std::to_string(an.slow_peer) +
+                   ")")
+                      .c_str()
+                : "");
+  }
+  if (perf_anomaly_log_.size() < 512) {
+    // Tensor names are user-controlled and ride into JSON: escape them
+    // properly (quotes/backslashes/control bytes) — a stripped-only key
+    // with an embedded newline would corrupt perf_profile.<rank>.json and
+    // silently drop this rank from the cross-run merge.
+    perf_anomaly_log_.push_back(
+        "{\"t_us\": " + std::to_string(Timeline::SteadyAbsUs()) +
+        ", \"op\": \"" + op + "\", \"key\": " + JsonEscapeString(key) +
+        ", \"wall_us\": " + std::to_string(sample.wall_us) +
+        ", \"baseline_us\": " +
+        std::to_string(static_cast<int64_t>(an.baseline_us)) +
+        ", \"ratio\": " + std::to_string(an.ratio) + ", \"phase\": \"" +
+        PerfPhaseName(an.phase) +
+        "\", \"slow_peer\": " + std::to_string(an.slow_peer) + "}");
+  }
+}
+
+void Core::WritePerfProfile() {
+  if (cfg_.perf_profile_path.empty() || !perfstats_.enabled() ||
+      perf_profile_written_) {
+    return;
+  }
+  perf_profile_written_ = true;
+  std::string body = "{\"version\": 1, \"rank\": " +
+                     std::to_string(cfg_.rank) +
+                     ", \"size\": " + std::to_string(cfg_.size) +
+                     ", \"perfstats\": " + perfstats_.SnapshotJson() +
+                     ", \"anomalies\": [";
+  for (size_t i = 0; i < perf_anomaly_log_.size(); ++i) {
+    if (i > 0) body += ", ";
+    body += perf_anomaly_log_[i];
+  }
+  body += "]}\n";
+  FILE* f = fopen(cfg_.perf_profile_path.c_str(), "w");
+  if (f == nullptr) {
+    LogWarn(cfg_.rank, "perf profile: cannot write %s",
+            cfg_.perf_profile_path.c_str());
+    return;
+  }
+  fwrite(body.data(), 1, body.size(), f);
+  fclose(f);
 }
 
 void Core::UpdateParamGauges(double cycle_ms, int64_t fusion, bool cache_on,
@@ -805,6 +942,18 @@ Status Core::Start() {
       "Flight-recorder dump files written (abort cascade, stall "
       "escalation, or on demand; fatal-signal dumps happen after the "
       "registry is unreachable and are not counted)");
+  // Clock-sync quality (docs/tracing.md): this rank's steady-clock offset
+  // vs rank 0 and the estimator's error bound. err = -1 until the first
+  // sync, so the aggregator/console can flag never-aligned ranks.
+  m_clock_offset_gauge_ = metrics_.GetGauge(
+      "hvdtpu_clock_offset_us",
+      "Steady-clock offset vs rank 0 in microseconds (PR-8 NTP-style "
+      "alignment; 0 on rank 0)");
+  m_clock_err_gauge_ = metrics_.GetGauge(
+      "hvdtpu_clock_err_us",
+      "Error bound of the clock-offset estimate in microseconds "
+      "(-1 = never synced)");
+  m_clock_err_gauge_->Set(-1);
 
   // Failure detection + fault injection (docs/fault-tolerance.md): slices
   // bound abort-propagation latency on every lane, the read deadline
@@ -830,6 +979,12 @@ Status Core::Start() {
     InstallFlightSignalHandlers();
     SetSignalFlightRecorder(&flightrec_);
   }
+  // Always-on perf attribution (docs/observability.md): streaming per-key
+  // baselines + the slowdown sentry, fed from the same hop instrumentation
+  // the flight recorder rides.
+  perfstats_.Configure(cfg_.perfstats, cfg_.perf_slowdown_pct,
+                       cfg_.perf_min_samples);
+  data_plane_.set_perf_enabled(perfstats_.enabled());
 
   data_plane_.set_allreduce_algo(
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
@@ -1032,6 +1187,8 @@ Status Core::Start() {
       clock_offset_us_.store(0, std::memory_order_relaxed);
       clock_err_us_.store(0, std::memory_order_relaxed);
       flightrec_.SetClock(0, 0);
+      m_clock_offset_gauge_->Set(0);
+      m_clock_err_gauge_->Set(0);
       for (int rank = 1; rank < cfg_.size; ++rank) {
         // Bounded serve loop: a buggy peer streaming endless pings must
         // trip form-up failure, not wedge rendezvous.
@@ -1110,6 +1267,8 @@ Status Core::Start() {
         clock_offset_us_.store(est.offset_us, std::memory_order_relaxed);
         clock_err_us_.store(est.err_us, std::memory_order_relaxed);
         flightrec_.SetClock(est.offset_us, est.err_us);
+        m_clock_offset_gauge_->Set(static_cast<double>(est.offset_us));
+        m_clock_err_gauge_->Set(static_cast<double>(est.err_us));
       }
     }
     clock_synced_at_ = NowSeconds();
@@ -1164,6 +1323,8 @@ Status Core::Start() {
     clock_offset_us_.store(0, std::memory_order_relaxed);
     clock_err_us_.store(0, std::memory_order_relaxed);
     flightrec_.SetClock(0, 0);
+    m_clock_offset_gauge_->Set(0);
+    m_clock_err_gauge_->Set(0);
   }
   // A timeline opened via HVDTPU_TIMELINE/HVDTPU_TRACE gets its metadata
   // now that the clock offset is known (runtime starts emit theirs in
@@ -1185,6 +1346,10 @@ void Core::Shutdown() {
   cv_.NotifyAll();
   Wake();
   if (background_.joinable()) background_.join();
+  // Cross-run regression sentry (docs/observability.md): persist this
+  // rank's per-key baselines + anomaly log. After the join, the
+  // background thread's perf state is quiescent.
+  WritePerfProfile();
   // Fail any still-outstanding handles.
   {
     MutexLock lk(mu_);
@@ -1552,6 +1717,8 @@ void Core::PumpControlPlane() {
           clock_offset_us_.store(est.offset_us, std::memory_order_relaxed);
           clock_err_us_.store(est.err_us, std::memory_order_relaxed);
           flightrec_.SetClock(est.offset_us, est.err_us);
+          m_clock_offset_gauge_->Set(static_cast<double>(est.offset_us));
+          m_clock_err_gauge_->Set(static_cast<double>(est.err_us));
           clock_adopted_at_ = NowSeconds();
           EmitTraceMeta();
         }
@@ -2280,7 +2447,7 @@ void Core::ExecuteResponse(const Response& resp) {
   if (!entries.empty()) {
     ObserveOp(opname, NowSeconds() - op_t0, entries[0]->byte_size(), "none",
               data_plane_.transport_label(), false, "none", resp.dtype,
-              st.ok());
+              st.ok(), entries[0]->name);
   }
   flightrec_.Record(FlightEvent::OP_END, fr_name, batch_bytes, -1, -1,
                     fr_t0, Timeline::SteadyAbsUs(), st.ok() ? 0 : 1, 0);
@@ -2495,7 +2662,7 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     ObserveOp("ALLREDUCE", NowSeconds() - op_t0, total_bytes,
               data_plane_.last_algo_label(), data_plane_.transport_label(),
               data_plane_.hier_active(), WireCompressionName(comp),
-              resp.dtype, st.ok());
+              resp.dtype, st.ok(), e->name);
     flightrec_.Record(FlightEvent::OP_END, flightrec_.InternName(e->name),
                       total_bytes, -1, -1, exec_start_us,
                       Timeline::SteadyAbsUs(), st.ok() ? 0 : 1, 0);
@@ -2536,10 +2703,17 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
   data_plane_.EndCompressedOp();
   const int64_t op_raw = data_plane_.op_raw_bytes();
   const int64_t op_wire = data_plane_.op_wire_bytes();
+  // Fused batches key their perf baseline on the primary tensor plus the
+  // batch width: steady-state fusions recur with the same composition, and
+  // a re-fused batch must not be judged against a different one's baseline.
   ObserveOp("ALLREDUCE", NowSeconds() - op_t0, total_bytes,
             data_plane_.last_algo_label(), data_plane_.transport_label(),
             data_plane_.hier_active(), WireCompressionName(comp), resp.dtype,
-            st.ok());
+            st.ok(),
+            entries.empty()
+                ? std::string()
+                : entries[0]->name + "(+" +
+                      std::to_string(entries.size() - 1) + ")");
   flightrec_.Record(
       FlightEvent::OP_END,
       entries.empty() ? -1 : flightrec_.InternName(entries[0]->name),
@@ -2988,6 +3162,37 @@ int hvdtpu_set_flightrec(void* core, long long events,
 // recorder is disabled or no destination is known. Callable any thread.
 int hvdtpu_flightrec_dump(void* core, const char* path) {
   return static_cast<Core*>(core)->FlightDumpToFile(path) ? 0 : -1;
+}
+
+// Always-on perf attribution (perfstats.h; docs/observability.md).
+// hvdtpu_set_perfstats: pre-Start() config — enabled toggles the streaming
+// baselines (default on), slowdown_pct is the sentry threshold in percent
+// over the rolling baseline (<= 0 keeps baselines but disables the sentry;
+// < 0 keeps the default 50), min_samples the per-key warmup before the
+// sentry may fire (<= 0 keeps the default 20), profile_path where Shutdown
+// writes perf_profile.<rank>.json for scripts/perf_diff.py (NULL/empty =
+// skip).
+int hvdtpu_set_perfstats(void* core, int enabled, double slowdown_pct,
+                         long long min_samples, const char* profile_path) {
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->perfstats = enabled != 0;
+  if (slowdown_pct >= 0) cfg->perf_slowdown_pct = slowdown_pct;
+  if (min_samples > 0) cfg->perf_min_samples = min_samples;
+  cfg->perf_profile_path = profile_path != nullptr ? profile_path : "";
+  return 0;
+}
+
+// Keyed-baseline snapshot as JSON (horovod_tpu/perfstats.py decodes it —
+// hvd.perf_report() and the /perfz endpoint's data source). Same
+// probe-then-copy contract as hvdtpu_metrics_dump. Callable any thread.
+long long hvdtpu_perfstats_snapshot(void* core, char* buf, long long buflen) {
+  std::string img = static_cast<Core*>(core)->PerfSnapshot();
+  if (buf != nullptr && buflen > 0) {
+    long long n = std::min<long long>(buflen, img.size());
+    std::memcpy(buf, img.data(), static_cast<size_t>(n));
+    if (n < buflen) buf[n] = '\0';
+  }
+  return static_cast<long long>(img.size());
 }
 
 // Serialized dump image (binary; horovod_tpu/flightrec.py decodes it —

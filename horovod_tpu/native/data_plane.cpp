@@ -677,8 +677,19 @@ void DataPlane::BeginOpTrace() {
   trace_hop_seq_ = 0;
   trace_op_ = tracer_ != nullptr && tracer_->Initialized() &&
               trace_sampler_.SampleOp();
-  // The flight ring wants every hop; the sampled JSON tracer only its share.
-  rec_hops_ = trace_op_ || flight_ != nullptr;
+  // The flight ring and the perf-attribution accumulators want every hop;
+  // the sampled JSON tracer only its share.
+  rec_hops_ = trace_op_ || flight_ != nullptr || perf_on_;
+  ResetOpPhaseAccum();
+}
+
+void DataPlane::ResetOpPhaseAccum() {
+  op_wait_us_ = 0;
+  op_wire_us_ = 0;
+  op_reduce_us_ = 0;
+  op_codec_us_ = 0;
+  op_slow_peer_ = -1;
+  op_slow_peer_wait_us_ = 0;
 }
 
 namespace {
@@ -713,8 +724,34 @@ void DataPlane::TraceHop(const char* name, int send_peer, int recv_peer,
       lane_peer >= 0 && lane_peer < size_ && transports_[lane_peer] != nullptr
           ? transports_[lane_peer]->kind()
           : "local";
+  const FlightEvent fev = FlightHopEvent(name);
+  // Perf-attribution phase buckets (perfstats.h): every hop of every op —
+  // plain integer adds, no strings, no branches beyond this switch.
+  switch (fev) {
+    case FlightEvent::SEND:
+    case FlightEvent::RECV:
+    case FlightEvent::SENDRECV: {
+      op_wait_us_ += wait_us;
+      const int64_t wire = t1_us - t0_us - wait_us;
+      op_wire_us_ += wire > 0 ? wire : 0;
+      if (lane_peer >= 0 && wait_us > op_slow_peer_wait_us_) {
+        op_slow_peer_wait_us_ = wait_us;
+        op_slow_peer_ = lane_peer;
+      }
+      break;
+    }
+    case FlightEvent::REDUCE:
+      op_reduce_us_ += t1_us - t0_us;
+      break;
+    case FlightEvent::QUANTIZE:
+    case FlightEvent::DEQUANTIZE:
+      op_codec_us_ += t1_us - t0_us;
+      break;
+    default:
+      break;
+  }
   if (flight_ != nullptr) {
-    flight_->Record(FlightHopEvent(name), /*name_id=*/-1, bytes, send_peer,
+    flight_->Record(fev, /*name_id=*/-1, bytes, send_peer,
                     recv_peer, t0_us, t1_us, wait_us, FlightLaneCode(lane));
   }
   if (!trace_op_) return;
@@ -965,7 +1002,13 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   op_wire_bytes_ = 0;
   last_algo_label_ = "none";
   trace_op_ = false;  // never inherit the previous op's sampling decision
-  if (size_ == 1 || count == 0) return Status::OK();
+  if (size_ == 1 || count == 0) {
+    // No hops will run, but ObserveOp still reads the phase accumulators:
+    // a skipped BeginOpTrace must not leak the PREVIOUS op's buckets into
+    // this op's perf baseline.
+    ResetOpPhaseAccum();
+    return Status::OK();
+  }
   BeginOpTrace();
   MaybeChaosOp();
   Status st;
@@ -1240,6 +1283,9 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
           elem);
       if (!st.ok()) return st;
       if (rec_hops_ && reduce_first_us != 0) {
+        // Perf attribution: the segmented reduce's actual busy time (the
+        // first-to-last span overlaps the wire and would double-count).
+        op_reduce_us_ += reduce_busy_us;
         if (flight_ != nullptr) {
           // busy_us in arg: the span is first-to-last segment, the actual
           // reduction time is what the analyzer attributes.
@@ -1502,7 +1548,10 @@ Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
 
 Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
   trace_op_ = false;
-  if (size_ == 1 || bytes == 0) return Status::OK();
+  if (size_ == 1 || bytes == 0) {
+    ResetOpPhaseAccum();  // ObserveOp reads the accumulators regardless
+    return Status::OK();
+  }
   BeginOpTrace();
   if (rank_ == root) {
     for (int r = 0; r < size_; ++r) {
@@ -1580,7 +1629,10 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
                          "Adasum supports float32/float64 only, got " +
                              std::string(DataTypeName(dtype)));
   }
-  if (size_ == 1 || count == 0) return Status::OK();
+  if (size_ == 1 || count == 0) {
+    ResetOpPhaseAccum();  // ObserveOp reads the accumulators regardless
+    return Status::OK();
+  }
   BeginOpTrace();
   MaybeChaosOp();
   const size_t elem = DataTypeSize(dtype);

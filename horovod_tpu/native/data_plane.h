@@ -258,6 +258,18 @@ class DataPlane {
   // True while the CURRENT op is being sampled (core gates its own
   // tensor-level FUSION-WAIT spans on the same decision).
   bool trace_sampling_op() const { return trace_op_; }
+  // Always-on perf attribution (perfstats.h): when enabled, TraceHop also
+  // accumulates this op's wait/wire/reduce/codec phase buckets (and the
+  // slowest hop peer) unsampled — the core feeds them into PerfStats at op
+  // completion. Same timestamping gate the flight recorder already pays.
+  void set_perf_enabled(bool on) { perf_on_ = on; }
+  int64_t op_wait_us() const { return op_wait_us_; }
+  int64_t op_wire_us() const { return op_wire_us_; }
+  int64_t op_reduce_us() const { return op_reduce_us_; }
+  int64_t op_codec_us() const { return op_codec_us_; }
+  // Hop peer this op spent the most wait time on (-1 none): the wire-slow
+  // anomaly's named suspect. Background thread only, like the accumulators.
+  int op_slow_peer() const { return op_slow_peer_; }
   // Label of the algorithm the LAST Allreduce actually ran ("ring",
   // "recursive_doubling", "tree", with AUTO resolved by size; "hier" phases
   // report the top-level "hierarchical"). Background thread only — set by
@@ -458,6 +470,20 @@ class DataPlane {
   bool rec_hops_ = false;
   int64_t trace_hop_seq_ = 0;
   FlightRecorder* flight_ = nullptr;
+  // Zero the per-op phase accumulators. Called by BeginOpTrace and by the
+  // early returns that skip it (size_==1 / empty ops still reach
+  // ObserveOp, which reads the accumulators unconditionally).
+  void ResetOpPhaseAccum();
+  // Per-op phase accumulation for the perf-attribution subsystem
+  // (perfstats.h): reset by BeginOpTrace, fed by TraceHop and the
+  // segmented-ring reduce callback, read by the core after each op.
+  bool perf_on_ = false;
+  int64_t op_wait_us_ = 0;
+  int64_t op_wire_us_ = 0;
+  int64_t op_reduce_us_ = 0;
+  int64_t op_codec_us_ = 0;
+  int op_slow_peer_ = -1;
+  int64_t op_slow_peer_wait_us_ = 0;
 
   // Per-op wire compression state (background thread only) + payload
   // accounting (cumulative totals live in the metrics registry, readable

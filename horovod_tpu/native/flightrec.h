@@ -52,6 +52,8 @@ enum class FlightEvent : int32_t {
   STALL = 11,        // coordinator stall warning / escalation
   ABORT = 12,        // data plane aborted (cascade reached this rank)
   MARK = 13,         // user marker (reserved for the Python API)
+  ANOMALY = 14,      // perf sentry: op past its baseline (arg = PerfPhase
+                     // code, send_peer = slow hop peer for wire-slow)
 };
 
 // Why a dump was written. Mirrored in horovod_tpu/flightrec.py DUMP_REASONS.

@@ -39,6 +39,7 @@
 #include "flightrec.h"
 #include "message.h"
 #include "metrics.h"
+#include "perfstats.h"
 #include "shm_transport.h"
 #include "socket_util.h"
 #include "timeline.h"
@@ -2188,6 +2189,230 @@ void TestIoControlWaitAccounting() {
   close(sv[1]);
 }
 
+void TestP2QuantileTracksSortedQuantiles() {
+  // Deterministic LCG stream; the P² estimates must land near the exact
+  // sorted quantiles (P² error on smooth distributions is a few percent).
+  uint64_t seed = 42;
+  auto next = [&seed]() {
+    seed = seed * 6364136223846793005ULL + 1442695040888963407ULL;
+    return static_cast<double>((seed >> 33) % 100000);
+  };
+  P2Quantile p50, p99;
+  p50.Init(0.5);
+  p99.Init(0.99);
+  std::vector<double> all;
+  for (int i = 0; i < 20000; ++i) {
+    const double x = next();
+    all.push_back(x);
+    p50.Observe(x);
+    p99.Observe(x);
+  }
+  std::sort(all.begin(), all.end());
+  const double exact50 = all[all.size() / 2];
+  const double exact99 = all[static_cast<size_t>(all.size() * 0.99)];
+  CHECK_TRUE(std::abs(p50.Value() - exact50) < 0.05 * exact50 + 1);
+  CHECK_TRUE(std::abs(p99.Value() - exact99) < 0.05 * exact99 + 1);
+  // Tiny streams are exact (sorted initial buffer).
+  P2Quantile small;
+  small.Init(0.5);
+  small.Observe(30);
+  small.Observe(10);
+  small.Observe(20);
+  CHECK_TRUE(small.Value() == 20);
+}
+
+void TestPerfStatsBaselineAndSentry() {
+  PerfStats ps;
+  ps.Configure(true, 50.0, 5);
+  const int slot = ps.KeySlot("grad/0|ring|shm|0|none");
+  CHECK_TRUE(slot >= 1);
+  CHECK_TRUE(ps.KeySlot("grad/0|ring|shm|0|none") == slot);  // stable
+  PerfStats::OpSample s;
+  s.wall_us = 1000;
+  s.wait_us = 100;
+  s.wire_us = 700;
+  s.reduce_us = 150;
+  s.codec_us = 0;
+  for (int i = 0; i < 10; ++i) {
+    PerfStats::Anomaly a = ps.RecordOp(slot, s);
+    CHECK_TRUE(!a.fired);  // steady state never trips the sentry
+  }
+  // 10% slower: inside the 50% threshold.
+  PerfStats::OpSample mild = s;
+  mild.wall_us = 1100;
+  CHECK_TRUE(!ps.RecordOp(slot, mild).fired);
+  // 3x slower, excess in the wire bucket, slow peer named.
+  PerfStats::OpSample slow = s;
+  slow.wall_us = 3000;
+  slow.wire_us = 2700;
+  slow.slow_peer = 3;
+  PerfStats::Anomaly a = ps.RecordOp(slot, slow);
+  CHECK_TRUE(a.fired);
+  CHECK_TRUE(a.phase == PerfPhase::WIRE);
+  CHECK_TRUE(a.slow_peer == 3);
+  CHECK_TRUE(a.ratio > 2.0);
+  CHECK_TRUE(ps.anomalies_total() == 1);
+  const PerfSlot* sl = ps.slot(slot);
+  CHECK_TRUE(sl != nullptr &&
+             sl->anomalies.load(std::memory_order_relaxed) == 1);
+  // A reduce-bound slowdown attributes REDUCE, not WIRE.
+  PerfStats::OpSample rslow = s;
+  rslow.wall_us = 3000;
+  rslow.reduce_us = 2200;
+  PerfStats::Anomaly ra = ps.RecordOp(slot, rslow);
+  CHECK_TRUE(ra.fired && ra.phase == PerfPhase::REDUCE);
+  CHECK_TRUE(ra.slow_peer == -1);  // only wait/wire name a peer
+  // Warmup gate: a fresh key never fires before min_samples.
+  const int fresh = ps.KeySlot("other|ring|shm|0|none");
+  PerfStats::OpSample burst = s;
+  burst.wall_us = 100;
+  ps.RecordOp(fresh, burst);
+  burst.wall_us = 100000;
+  CHECK_TRUE(!ps.RecordOp(fresh, burst).fired);
+}
+
+void TestPerfStatsKeyOverflowSharesSlotZero() {
+  PerfStats ps;
+  ps.Configure(true, 50.0, 5);
+  for (int i = 0; i < kPerfMaxKeys + 16; ++i) {
+    const int slot = ps.KeySlot("key" + std::to_string(i));
+    if (i < kPerfMaxKeys - 1) {
+      CHECK_TRUE(slot == i + 1);
+    } else {
+      CHECK_TRUE(slot == 0);  // table full: the shared overflow slot
+    }
+  }
+  CHECK_TRUE(ps.slot_count() == kPerfMaxKeys);
+  // The overflow slot streams stats but never sentries: its baseline
+  // mixes every overflowed key, so a small op judged against big-op
+  // history would fire forever.
+  PerfStats::OpSample warm;
+  warm.wall_us = 100;
+  for (int i = 0; i < 8; ++i) CHECK_TRUE(!ps.RecordOp(0, warm).fired);
+  PerfStats::OpSample spike;
+  spike.wall_us = 100000;  // 1000x the slot-0 baseline
+  CHECK_TRUE(!ps.RecordOp(0, spike).fired);
+  CHECK_TRUE(ps.slot(0)->count.load(std::memory_order_relaxed) == 9);
+  // Disabled stats hand every key slot 0 and never fire.
+  PerfStats off;
+  off.Configure(false, 50.0, 5);
+  CHECK_TRUE(off.KeySlot("anything") == 0);
+  PerfStats::OpSample s;
+  s.wall_us = 100;
+  CHECK_TRUE(!off.RecordOp(0, s).fired);
+  CHECK_TRUE(off.SnapshotJson().find("\"enabled\": false") !=
+             std::string::npos);
+}
+
+void TestPerfStatsConcurrentWritersAndReader() {
+  // The production contract is single-writer, but the hot path must stay
+  // correct (and TSan-clean) under explicitly concurrent writers plus a
+  // mid-flight snapshot reader.
+  PerfStats ps;
+  ps.Configure(true, 1e12, 1);  // sentry effectively off: count integrity
+  const int slot_a = ps.KeySlot("a");
+  const int slot_b = ps.KeySlot("b");
+  constexpr int kWriters = 4;
+  constexpr int kPerWriter = 4000;
+  std::atomic<bool> stop{false};
+  std::thread reader([&] {
+    while (!stop.load(std::memory_order_acquire)) {
+      std::string json = ps.SnapshotJson();
+      CHECK_TRUE(json.find("\"keys\"") != std::string::npos);
+    }
+  });
+  std::vector<std::thread> writers;
+  for (int w = 0; w < kWriters; ++w) {
+    writers.emplace_back([&ps, slot_a, slot_b, w] {
+      PerfStats::OpSample s;
+      for (int i = 0; i < kPerWriter; ++i) {
+        s.wall_us = 100 + (i % 7);
+        s.wire_us = 50;
+        s.wait_us = 25;
+        ps.RecordOp(w % 2 == 0 ? slot_a : slot_b, s);
+      }
+    });
+  }
+  for (auto& t : writers) t.join();
+  stop.store(true, std::memory_order_release);
+  reader.join();
+  const PerfSlot* a = ps.slot(slot_a);
+  const PerfSlot* b = ps.slot(slot_b);
+  CHECK_TRUE(a->count.load(std::memory_order_relaxed) ==
+             kWriters / 2 * kPerWriter);
+  CHECK_TRUE(b->count.load(std::memory_order_relaxed) ==
+             kWriters / 2 * kPerWriter);
+  const double ew = a->pub_ewma[0].load(std::memory_order_relaxed);
+  CHECK_TRUE(ew >= 100 && ew <= 107);
+}
+
+void TestPerfStatsSnapshotJsonShape() {
+  PerfStats ps;
+  ps.Configure(true, 50.0, 20);
+  const int slot = ps.KeySlot("grad\"quote\\slash|ring|tcp|0|int8");
+  PerfStats::OpSample s;
+  s.wall_us = 1234;
+  s.wait_us = 100;
+  s.wire_us = 1000;
+  s.reduce_us = 100;
+  s.codec_us = 34;
+  ps.RecordOp(slot, s);
+  const std::string json = ps.SnapshotJson();
+  // Escaped key, all five phase buckets, count, and the sample ring.
+  CHECK_TRUE(json.find("grad\\\"quote\\\\slash|ring|tcp|0|int8") !=
+             std::string::npos);
+  for (const char* phase : {"wall", "wait", "wire", "reduce", "codec"}) {
+    CHECK_TRUE(json.find("\"" + std::string(phase) + "\": ") !=
+               std::string::npos);
+  }
+  CHECK_TRUE(json.find("\"count\": 1") != std::string::npos);
+  CHECK_TRUE(json.find("\"samples_us\": [1234]") != std::string::npos);
+  CHECK_TRUE(json.find("\"p50_us\"") != std::string::npos);
+  CHECK_TRUE(json.find("\"p99_us\"") != std::string::npos);
+}
+
+void TestDataPlanePerfPhaseAccumulation() {
+  // A 2-rank in-process world with perf enabled and NO tracer/recorder:
+  // the phase accumulators alone must light up for an unsampled op.
+  DataPlane a(0, 2), b(1, 2);
+  a.set_perf_enabled(true);
+  b.set_perf_enabled(true);
+  CHECK_TRUE(a.Listen().ok());
+  CHECK_TRUE(b.Listen().ok());
+  std::vector<PeerAddr> peers = {{"127.0.0.1", a.port()},
+                                 {"127.0.0.1", b.port()}};
+  Status sa, sb;
+  std::thread tb([&] { sb = b.Connect(peers); });
+  sa = a.Connect(peers);
+  tb.join();
+  CHECK_TRUE(sa.ok() && sb.ok());
+  constexpr int64_t kCount = 1 << 18;  // 1 MB: big enough to see wire time
+  std::vector<float> va(kCount, 1.0f), vb(kCount, 2.0f);
+  std::thread tr([&] {
+    sb = b.Allreduce(vb.data(), kCount, DataType::FLOAT32, ReduceOp::SUM);
+  });
+  sa = a.Allreduce(va.data(), kCount, DataType::FLOAT32, ReduceOp::SUM);
+  tr.join();
+  CHECK_TRUE(sa.ok() && sb.ok());
+  CHECK_TRUE(va[0] == 3.0f);
+  // Hop phases were accumulated (wait or wire — scheduling decides the
+  // split) and the slow-peer tracker names the only peer when any wait
+  // was seen at all.
+  CHECK_TRUE(a.op_wait_us() + a.op_wire_us() > 0);
+  CHECK_TRUE(a.op_wait_us() >= 0 && a.op_wire_us() >= 0);
+  CHECK_TRUE(a.op_reduce_us() >= 0 && a.op_codec_us() >= 0);
+  if (a.op_slow_peer() != -1) CHECK_TRUE(a.op_slow_peer() == 1);
+  // An empty op early-returns before any hop runs — it must NOT inherit
+  // the previous op's phase buckets (ObserveOp reads them regardless).
+  CHECK_TRUE(a.Allreduce(va.data(), 0, DataType::FLOAT32,
+                         ReduceOp::SUM).ok());
+  CHECK_TRUE(a.op_wait_us() == 0 && a.op_wire_us() == 0);
+  CHECK_TRUE(a.op_reduce_us() == 0 && a.op_codec_us() == 0);
+  CHECK_TRUE(a.op_slow_peer() == -1);
+  a.Shutdown();
+  b.Shutdown();
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -2251,6 +2476,12 @@ int main() {
   TestFlightRecorderSigtermDoesNotBurnLatch();
   TestFlightLaneCodes();
   TestDataPlaneRecordsFlightHops();
+  TestP2QuantileTracksSortedQuantiles();
+  TestPerfStatsBaselineAndSentry();
+  TestPerfStatsKeyOverflowSharesSlotZero();
+  TestPerfStatsConcurrentWritersAndReader();
+  TestPerfStatsSnapshotJsonShape();
+  TestDataPlanePerfPhaseAccumulation();
   if (failures == 0) {
     std::printf("native unit tests: ALL OK\n");
     return 0;

@@ -89,6 +89,45 @@ def parse_prometheus_text(text: str) -> dict:
     return families
 
 
+def _escape(v: str) -> str:
+    return v.replace('\\', '\\\\').replace('"', '\\"').replace('\n', '\\n')
+
+
+def _render_value(v: float) -> str:
+    if v != v:  # NaN is legal exposition (promtool parity, metrics_agg)
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)  # shortest round-trip
+
+
+def render_exposition(families: dict) -> str:
+    """Re-render a :func:`parse_prometheus_text` result back into the text
+    exposition format (one # HELP/# TYPE header per family, samples in
+    parsed order). ``parse(render(parse(text)))`` equals ``parse(text)``
+    for every dump the native registry produces — the golden round-trip
+    contract tests/test_metrics.py pins against a live worker's full
+    ``/metrics`` catalog."""
+    out: List[str] = []
+    for name, fam in families.items():
+        if fam.get("help"):
+            out.append(f"# HELP {name} {fam['help']}")
+        if fam.get("type") and fam["type"] != "untyped":
+            out.append(f"# TYPE {name} {fam['type']}")
+        for suffix, labels, value in fam.get("samples", []):
+            sample_name = name + (f"_{suffix}" if suffix else "")
+            block = ""
+            if labels:
+                block = "{" + ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in labels.items()) + "}"
+            out.append(f"{sample_name}{block} {_render_value(value)}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
 def sample_value(parsed: dict, name: str, suffix: str = "",
                  **labels) -> Optional[float]:
     """First sample of ``name`` whose labels include ``labels`` (None if
@@ -138,6 +177,27 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/perfz":
+            # Live perf attribution (docs/observability.md): the streaming
+            # per-key baselines + anomaly counts as JSON, straight from the
+            # native snapshot. Secret-gated like /metrics.
+            fn = getattr(self.server, "metrics_perfz_fn", None)
+            if fn is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                body = fn().encode()
+            except Exception as exc:  # keep the endpoint alive
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(str(exc).encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path == "/debugz":
             # Flight-recorder live view (docs/fault-tolerance.md): the
             # in-flight op + last-N phase events, decoded from an in-memory
@@ -184,7 +244,8 @@ class MetricsServer:
     def __init__(self, dump_fn: Callable[[], str], port: int = 0,
                  secret: Optional[str] = None,
                  health: Optional[dict] = None,
-                 debugz_fn: Optional[Callable[[], str]] = None):
+                 debugz_fn: Optional[Callable[[], str]] = None,
+                 perfz_fn: Optional[Callable[[], str]] = None):
         self._server = ThreadingHTTPServer(("0.0.0.0", port),
                                            _MetricsHandler)
         self._server.metrics_dump_fn = dump_fn  # type: ignore[attr-defined]
@@ -192,6 +253,8 @@ class MetricsServer:
         self._server.metrics_health = health  # type: ignore[attr-defined]
         # /debugz JSON source (flight-recorder live view); None = 404.
         self._server.metrics_debugz_fn = debugz_fn  # type: ignore[attr-defined]
+        # /perfz JSON source (perf-attribution baselines); None = 404.
+        self._server.metrics_perfz_fn = perfz_fn  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -1,0 +1,218 @@
+"""Perf-attribution snapshot decoder + report helpers
+(docs/observability.md "Live perf attribution").
+
+The native core keeps always-on streaming statistics — EWMA plus P²-style
+p50/p99 of op wall time and the wait/wire/reduce/codec phase buckets —
+keyed by {tensor-set signature, algo, transport, hier, compression, op}
+(``native/perfstats.{h,cpp}``), and a slowdown sentry that flags ops past
+``HVDTPU_PERF_SLOWDOWN_PCT`` of their rolling baseline. This module is the
+Python half:
+
+* :func:`parse_snapshot` — decode one ``hvdtpu_perfstats_snapshot`` /
+  ``/perfz`` JSON payload (validates the shape so a truncated scrape fails
+  loudly);
+* :func:`rank_summary` / :func:`find_straggler` — per-rank busy/phase
+  aggregation and the live straggler pick, shared by ``hvdrun --top``
+  (:mod:`horovod_tpu.runner.hvdtop`) and ``hvd.perf_report()``;
+* :func:`format_report` — a human-readable rendering of one rank's
+  snapshot;
+* :func:`load_profile` / :func:`merge_profile_dir` — the
+  ``perf_profile.<rank>.json`` files each job persists at shutdown, merged
+  into one ``perf_profile.json`` for the cross-run regression sentry
+  (``scripts/perf_diff.py``).
+
+``PERF_PHASES`` mirrors ``hvdtpu::PerfPhase`` byte-for-byte
+(``scripts/check_invariants.py`` ENUM-MIRROR): the codes ride the ANOMALY
+flight record's arg word across the C++/Python boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+# Byte-for-byte mirror of hvdtpu::PerfPhase (native/perfstats.h).
+PERF_PHASES = {"wall": 0, "wait": 1, "wire": 2, "reduce": 3, "codec": 4}
+PHASE_NAMES = {v: k for k, v in PERF_PHASES.items()}
+
+# Dominant-phase -> human attribution, the same vocabulary the offline
+# trace analyzer uses (docs/tracing.md): a rank whose excess is WAIT is a
+# victim (someone ELSE is late); WIRE is the transport; REDUCE/CODEC are
+# this rank's own kernels; WALL is unattributed (e.g. descheduled).
+ATTRIBUTION = {
+    "wall": "compute-late",
+    "wait": "peer-wait (compute-late elsewhere)",
+    "wire": "wire-slow",
+    "reduce": "reduce-bound",
+    "codec": "quantize-bound",
+}
+
+
+def parse_snapshot(data) -> dict:
+    """Decode one perfstats snapshot (bytes/str JSON) into a dict, with
+    shape validation — a truncated or non-perfz payload raises
+    ``ValueError`` instead of surfacing as weird KeyErrors downstream."""
+    if isinstance(data, bytes):
+        data = data.decode()
+    try:
+        snap = json.loads(data)
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"not a perfstats snapshot: {exc}") from exc
+    if not isinstance(snap, dict) or "keys" not in snap or \
+            snap.get("version") != 1:
+        raise ValueError("not a perfstats snapshot (missing version/keys)")
+    for entry in snap["keys"]:
+        for field in ("key", "count", "ewma_us", "p50_us", "p99_us"):
+            if field not in entry:
+                raise ValueError(
+                    f"malformed perfstats key entry: missing {field!r}")
+    return snap
+
+
+def rank_summary(snap: dict) -> dict:
+    """Aggregate one rank's snapshot into count-weighted per-phase means:
+
+    ``{"ops": N, "busy_us": mean wall-wait, "phase_us": {phase: mean},
+       "anomalies": int, "dominant": phase-name, "attribution": str}``
+
+    ``dominant`` is the largest non-wall phase bucket (with the residual
+    wall - sum(buckets) competing as "wall" = plain compute); the busy
+    figure (own non-wait time per op) is what ranks are compared on — a
+    victim waiting on a straggler shows high wall but LOW busy.
+    """
+    total = 0
+    phase_sums = {name: 0.0 for name in PERF_PHASES}
+    p99_sums = {"wall": 0.0, "wait": 0.0}
+    anomalies = 0
+    for entry in snap.get("keys", []):
+        n = entry["count"]
+        total += n
+        for name in PERF_PHASES:
+            phase_sums[name] += n * float(entry["ewma_us"].get(name, 0.0))
+        for name in p99_sums:
+            p99_sums[name] += n * float(entry["p99_us"].get(name, 0.0))
+        anomalies += int(entry.get("anomalies", 0))
+    if total == 0:
+        return {"ops": 0, "busy_us": 0.0, "busy_p99_us": 0.0,
+                "phase_us": {name: 0.0 for name in PERF_PHASES},
+                "anomalies": anomalies, "dominant": "wall",
+                "attribution": ATTRIBUTION["wall"]}
+    phase_us = {name: phase_sums[name] / total for name in PERF_PHASES}
+    busy = max(phase_us["wall"] - phase_us["wait"], 0.0)
+    # p99-based busy: the EWMA forgets a one-off spike within ~tens of
+    # ops, but the P² p99 tracks the top tail for ~1/(1-q) ≈ 100 samples —
+    # so a RECENTLY slow rank stays visible to the console between
+    # refreshes. Victims' p99 wall spikes too, but so does their p99 wait,
+    # and the difference stays small.
+    busy_p99 = max(p99_sums["wall"] / total - p99_sums["wait"] / total, 0.0)
+    # Dominant: the biggest of the measured buckets vs the unexplained
+    # remainder (compute and everything uninstrumented).
+    other = max(phase_us["wall"] - sum(
+        phase_us[p] for p in ("wait", "wire", "reduce", "codec")), 0.0)
+    candidates = {"wire": phase_us["wire"], "reduce": phase_us["reduce"],
+                  "codec": phase_us["codec"], "wait": phase_us["wait"],
+                  "wall": other}
+    dominant = max(candidates, key=lambda k: candidates[k])
+    return {"ops": total, "busy_us": busy, "busy_p99_us": busy_p99,
+            "phase_us": phase_us, "anomalies": anomalies,
+            "dominant": dominant, "attribution": ATTRIBUTION[dominant]}
+
+
+def find_straggler(per_rank: Dict[int, dict]) -> Optional[dict]:
+    """The live straggler across per-rank snapshots: the rank with the
+    highest own non-wait time per op (victims blocked on it show as
+    waiting, docs/tracing.md). Returns ``{"rank", "busy_us", "dominant",
+    "attribution", "anomalies"}`` or None when nothing has run yet."""
+    best = None
+    for rank, snap in sorted(per_rank.items()):
+        summary = rank_summary(snap)
+        if summary["ops"] == 0:
+            continue
+        # Rank on the larger of steady-state busy (EWMA) and recent-peak
+        # busy (p99-based): a rank that was slow within the last ~100 ops
+        # stays the straggler between console refreshes.
+        score = max(summary["busy_us"], summary["busy_p99_us"])
+        if best is None or score > best["busy_us"]:
+            # The straggler's own excess is in its non-wait buckets; never
+            # attribute the straggler to "waiting on peers".
+            dominant = summary["dominant"] if summary["dominant"] != "wait" \
+                else "wall"
+            best = {"rank": rank, "busy_us": score,
+                    "dominant": dominant,
+                    "attribution": ATTRIBUTION[dominant],
+                    "anomalies": summary["anomalies"]}
+    return best
+
+
+def format_report(snap: dict, top: int = 10) -> str:
+    """Human-readable rendering of one rank's snapshot: the ``top`` keys by
+    count-weighted wall time, their phase split, and anomaly counts."""
+    lines = ["perf attribution (EWMA per op, microseconds):"]
+    entries = sorted(snap.get("keys", []),
+                     key=lambda e: e["count"] * e["ewma_us"].get("wall", 0),
+                     reverse=True)
+    header = (f"  {'key':<48} {'count':>7} {'wall':>9} {'wait':>8} "
+              f"{'wire':>8} {'reduce':>8} {'codec':>8} {'p99':>9} anom")
+    lines.append(header)
+    for e in entries[:top]:
+        ew = e["ewma_us"]
+        lines.append(
+            f"  {e['key'][:48]:<48} {e['count']:>7} "
+            f"{ew.get('wall', 0):>9.0f} {ew.get('wait', 0):>8.0f} "
+            f"{ew.get('wire', 0):>8.0f} {ew.get('reduce', 0):>8.0f} "
+            f"{ew.get('codec', 0):>8.0f} "
+            f"{e['p99_us'].get('wall', 0):>9.0f} "
+            f"{e.get('anomalies', 0):>4}")
+    if len(entries) > top:
+        lines.append(f"  ... {len(entries) - top} more key(s)")
+    summary = rank_summary(snap)
+    lines.append(
+        f"  ops={summary['ops']} busy={summary['busy_us']:.0f}us/op "
+        f"dominant={summary['dominant']} ({summary['attribution']}) "
+        f"anomalies={summary['anomalies']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Cross-run profiles (perf_profile.<rank>.json -> perf_profile.json)
+# ---------------------------------------------------------------------------
+
+_PROFILE_FILE_RE = re.compile(r"^perf_profile\.(\d+)\.json$")
+
+
+def load_profile(path: str) -> dict:
+    """One profile file — either a per-rank ``perf_profile.<rank>.json``
+    (native format: {"version", "rank", "size", "perfstats", "anomalies"})
+    or a merged ``perf_profile.json`` ({"version", "ranks": {...}})."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("version") != 1:
+        raise ValueError(f"{path}: not a perf profile (version != 1)")
+    return doc
+
+
+def profile_ranks(doc: dict) -> Dict[int, dict]:
+    """Normalize a profile document into {rank: per-rank profile}."""
+    if "ranks" in doc:
+        return {int(r): p for r, p in doc["ranks"].items()}
+    return {int(doc.get("rank", 0)): doc}
+
+
+def merge_profile_dir(path: str) -> Tuple[dict, List[int]]:
+    """Merge every ``perf_profile.<rank>.json`` under ``path`` into one
+    document; returns (merged, ranks found). Unparseable files are skipped
+    (a rank that died mid-write must not take the merge down)."""
+    ranks: Dict[str, dict] = {}
+    found: List[int] = []
+    for name in sorted(os.listdir(path)):
+        m = _PROFILE_FILE_RE.match(name)
+        if m is None:
+            continue
+        try:
+            ranks[m.group(1)] = load_profile(os.path.join(path, name))
+        except (ValueError, OSError, json.JSONDecodeError):
+            continue
+        found.append(int(m.group(1)))
+    return {"version": 1, "ranks": ranks}, found

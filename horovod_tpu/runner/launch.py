@@ -130,6 +130,32 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                    help="allreduce payloads below this many bytes stay "
                         "uncompressed (HVDTPU_COMPRESSION_MIN_BYTES; "
                         "default 1024)")
+    p.add_argument("--top", action="store_true",
+                   help="live fleet console (docs/observability.md): "
+                        "refresh a per-rank frame of ops/s, wire ratio, "
+                        "stall/anomaly flags, clock-sync quality, and the "
+                        "current straggler with its phase attribution, "
+                        "scraped from each worker's /metrics + /perfz "
+                        "(requires --metrics-port; scripts/hvdtop.py is "
+                        "the standalone equivalent)")
+    p.add_argument("--top-once", action="store_true",
+                   help="with --top: print ONE frame once every rank "
+                        "answers (non-interactive; the CI smoke mode) "
+                        "instead of refreshing")
+    p.add_argument("--perf-profile", default=None, metavar="DIR",
+                   help="cross-run regression sentry "
+                        "(HVDTPU_PERF_PROFILE_DIR; docs/observability.md): "
+                        "each rank persists its perf baselines as "
+                        "DIR/perf_profile.<rank>.json at shutdown; the "
+                        "driver merges them into DIR/perf_profile.json — "
+                        "compare two runs with scripts/perf_diff.py")
+    p.add_argument("--perf-slowdown-pct", type=float, default=None,
+                   help="slowdown-sentry threshold in percent over each "
+                        "op's rolling baseline (HVDTPU_PERF_SLOWDOWN_PCT; "
+                        "default 50, 0 disables the sentry)")
+    p.add_argument("--no-perfstats", action="store_true",
+                   help="disable the always-on perf-attribution baselines "
+                        "entirely (HVDTPU_PERFSTATS=0)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="base port for the live-metrics endpoints "
                         "(HVDTPU_METRICS_PORT): worker rank r serves "
@@ -370,6 +396,22 @@ def _apply_tuning_env(env: dict, args) -> dict:
         if args.trace_sample < 0:
             raise SystemExit("hvdrun: --trace-sample must be >= 0")
         env[ev.HVDTPU_TRACE_SAMPLE] = str(args.trace_sample)
+    # Perf attribution (docs/observability.md): the flags own the knobs
+    # only when passed (a user-exported HVDTPU_PERFSTATS/... wins
+    # otherwise, like HVDTPU_SHM).
+    if args.no_perfstats:
+        env[ev.HVDTPU_PERFSTATS] = "0"
+    if args.perf_slowdown_pct is not None:
+        if args.perf_slowdown_pct < 0:
+            raise SystemExit("hvdrun: --perf-slowdown-pct must be >= 0")
+        env[ev.HVDTPU_PERF_SLOWDOWN_PCT] = str(args.perf_slowdown_pct)
+    if args.perf_profile:
+        # Same per-run hygiene as --trace/--postmortem: stale per-rank
+        # profiles would silently diff a previous run.
+        args.perf_profile = os.path.abspath(args.perf_profile)
+        _prepare_artifact_dir(args.perf_profile, "perf_profile.*.json",
+                              "perf_profile.json")
+        env[ev.HVDTPU_PERF_PROFILE_DIR] = args.perf_profile
     if getattr(args, "_chaos_spec", None):
         env[ev.HVDTPU_CHAOS] = args._chaos_spec
         if getattr(args, "_chaos_marker", None):
@@ -476,6 +518,13 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
 
     metrics_base_pre = args.metrics_port if args.metrics_port is not None \
         else ev.get_int(ev.HVDTPU_METRICS_PORT, 0)
+    if args.top:
+        # Elastic re-rendezvous moves ranks between hosts round to round;
+        # a static endpoint table would silently watch the wrong workers.
+        raise SystemExit(
+            "hvdrun: --top is not supported with elastic jobs yet — run "
+            "scripts/hvdtop.py --endpoints ... against the current world "
+            "(rank r serves on metrics-port + r on its host)")
     if args.debugz:
         if metrics_base_pre <= 0:
             raise SystemExit("hvdrun: --debugz requires --metrics-port (the "
@@ -514,6 +563,8 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
         _merge_trace_dir(args.trace)
     if args.postmortem and rc != 0:
         _postmortem_report(args.postmortem)
+    if args.perf_profile:
+        _merge_perf_profiles(args.perf_profile)
     return rc
 
 
@@ -582,7 +633,14 @@ def run_launcher(args: argparse.Namespace) -> int:
         raise SystemExit("hvdrun: --debugz requires --metrics-port (the "
                          "/debugz endpoint rides each worker's metrics "
                          "server)")
+    if args.top and metrics_base <= 0:
+        raise SystemExit("hvdrun: --top requires --metrics-port (the "
+                         "console scrapes each worker's /metrics + /perfz "
+                         "endpoints)")
+    if args.top_once and not args.top:
+        raise SystemExit("hvdrun: --top-once only makes sense with --top")
     aggregator = None
+    console = None
     if metrics_base > 0:
         from .preflight import check_metrics_ports
         agg_port = metrics_base + args.num_proc
@@ -607,9 +665,19 @@ def run_launcher(args: argparse.Namespace) -> int:
               file=sys.stderr)
         interval = (args.metrics_interval if args.metrics_interval is not None
                     else ev.get_float(ev.HVDTPU_METRICS_INTERVAL, 10.0))
+        # With the --top console on, the aggregator keeps serving the
+        # merged /metrics but stops printing its one-liner — two writers
+        # interleaving on stderr would garble both.
         aggregator = MetricsAggregator(endpoints, port=agg_port,
                                        secret=_ensure_job_secret(args),
-                                       interval_s=interval)
+                                       interval_s=interval,
+                                       print_summary=not args.top)
+        if args.top:
+            from .hvdtop import TopConsole
+            console = TopConsole(endpoints,
+                                 secret=_ensure_job_secret(args),
+                                 interval_s=min(interval, 2.0),
+                                 once=args.top_once)
 
     commands, envs, names, stdins = [], [], [], []
     for slot in slots:
@@ -634,15 +702,21 @@ def run_launcher(args: argparse.Namespace) -> int:
                   file=sys.stderr)
     if aggregator is not None:
         aggregator.start()
+    if console is not None:
+        console.start()
     try:
         rc = safe_exec.run_workers(commands, envs, names,
                                    verbose=args.verbose,
                                    stdin_datas=stdins)
     finally:
+        if console is not None:
+            console.stop()
         if aggregator is not None:
             aggregator.stop()
     if args.trace:
         _merge_trace_dir(args.trace)
+    if args.perf_profile:
+        _merge_perf_profiles(args.perf_profile)
     if args.postmortem and rc != 0:
         # The launcher knows which ranks ran on THIS host — their dumps are
         # the only ones expected locally; remote ranks' missing dumps read
@@ -683,6 +757,34 @@ def _merge_trace_dir(trace_dir: str) -> None:
               file=sys.stderr)
     except Exception as exc:  # observability must never fail the job
         print(f"hvdrun: trace: merge failed: {exc}", file=sys.stderr)
+
+
+def _merge_perf_profiles(profile_dir: str) -> None:
+    """End-of-job profile collection (hvdrun --perf-profile): merge the
+    per-rank ``perf_profile.<rank>.json`` files into one
+    ``perf_profile.json`` for scripts/perf_diff.py. Best-effort like the
+    trace merge — remote workers' profiles live on their own hosts — and
+    never fails the job."""
+    try:
+        import json
+
+        from ..perfstats import merge_profile_dir
+        merged, found = merge_profile_dir(profile_dir)
+        if not found:
+            print(f"hvdrun: perf-profile: no perf_profile.<rank>.json in "
+                  f"{profile_dir} (remote workers keep theirs on their own "
+                  "hosts; copy them here and re-merge with "
+                  "horovod_tpu.perfstats.merge_profile_dir)",
+                  file=sys.stderr)
+            return
+        merged_path = os.path.join(profile_dir, "perf_profile.json")
+        with open(merged_path, "w") as f:
+            json.dump(merged, f)
+        print(f"hvdrun: perf-profile: merged {len(found)} rank profile(s) "
+              f"-> {merged_path} (compare runs with "
+              "scripts/perf_diff.py OLD NEW)", file=sys.stderr)
+    except Exception as exc:  # observability must never fail the job
+        print(f"hvdrun: perf-profile: merge failed: {exc}", file=sys.stderr)
 
 
 def _postmortem_report(dump_dir: str, local_ranks=None) -> None:

@@ -143,7 +143,9 @@ def histogram_quantile(parsed_by_rank: Dict[int, dict], name: str,
 
 def summarize(parsed_by_rank: Dict[int, dict],
               prev: Optional[SummaryPrev],
-              now: float) -> Tuple[str, SummaryPrev]:
+              now: float,
+              unreachable: Optional[List[int]] = None
+              ) -> Tuple[str, SummaryPrev]:
     """One-line job summary from per-rank parsed metrics.
 
     The op rate and the slowest-rank ms/op are INTERVAL deltas against
@@ -216,6 +218,18 @@ def summarize(parsed_by_rank: Dict[int, dict],
     p50 = histogram_quantile(parsed_by_rank, "hvdtpu_recovery_seconds", 0.5)
     if p50 is not None:
         parts.append(f"recovery_p50={p50:.2f}s")
+    # Skip-and-flag, never lose the cycle: a worker that died (or is being
+    # replaced by elastic re-rendezvous) mid-scrape is NAMED while the
+    # reachable ranks' summary keeps flowing (docs/metrics.md).
+    if unreachable:
+        parts.append(f"unreachable={sorted(unreachable)}")
+    anomalies = sum(
+        v for parsed in parsed_by_rank.values()
+        for (suf, _l, v) in parsed.get("hvdtpu_perf_anomalies_total",
+                                       {}).get("samples", [])
+        if suf == "")
+    if anomalies:
+        parts.append(f"perf_anomalies={int(anomalies)}")
     zc_total = zc_sends + zc_fallbacks
     parts.append(
         f"zc={100.0 * zc_sends / zc_total:.0f}%"
@@ -247,6 +261,7 @@ class MetricsAggregator:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._prev: Optional[SummaryPrev] = None
+        self._unreachable: List[int] = []
         self._server = MetricsServer(dump_fn=self.merged, port=port,
                                      secret=secret,
                                      health={"role": "driver",
@@ -259,6 +274,20 @@ class MetricsAggregator:
     def merged(self) -> str:
         with self._lock:
             return self._merged
+
+    def unreachable(self) -> List[int]:
+        """Ranks whose endpoint did not answer the LAST scrape round —
+        dead, mid-exit, or being replaced by elastic re-rendezvous."""
+        with self._lock:
+            return list(self._unreachable)
+
+    def update_endpoints(self, endpoints: Dict[int, Tuple[str, int]]) -> None:
+        """Swap the scrape targets (elastic re-rendezvous moves ranks to
+        new hosts/ports); takes effect on the next round. The summary's
+        interval deltas only compare ranks present in consecutive rounds,
+        so a replaced rank restarts its rate cleanly instead of spiking."""
+        with self._lock:
+            self._endpoints = dict(endpoints)
 
     def scrape_once(self) -> Dict[int, str]:
         """One pass over every worker; refreshes the merged dump and
@@ -276,17 +305,31 @@ class MetricsAggregator:
             except Exception:
                 return rank, None  # not up yet / mid-exit: skip this round
 
+        with self._lock:
+            endpoints = dict(self._endpoints)
         with ThreadPoolExecutor(
-                max_workers=min(16, max(1, len(self._endpoints)))) as pool:
-            results = list(pool.map(one, self._endpoints.items()))
+                max_workers=min(16, max(1, len(endpoints)))) as pool:
+            results = list(pool.map(one, endpoints.items()))
         dumps = {rank: text for rank, text in results if text is not None}
         with self._lock:
             self._merged = merge_dumps(dumps)
+            self._unreachable = sorted(set(endpoints) - set(dumps))
         return dumps
 
     def summary_line(self, dumps: Dict[int, str]) -> str:
-        parsed = {r: parse_prometheus_text(t) for r, t in dumps.items()}
-        line, self._prev = summarize(parsed, self._prev, time.monotonic())
+        parsed = {}
+        for r, t in dumps.items():
+            try:
+                parsed[r] = parse_prometheus_text(t)
+            except ValueError:
+                # A worker dying MID-RESPONSE hands us a truncated dump:
+                # flag it like an unreachable rank instead of losing the
+                # whole cycle to one parse error.
+                with self._lock:
+                    if r not in self._unreachable:
+                        self._unreachable.append(r)
+        line, self._prev = summarize(parsed, self._prev, time.monotonic(),
+                                     unreachable=self.unreachable())
         return line
 
     def _loop(self) -> None:

@@ -434,12 +434,21 @@ def init(comm: Optional[Sequence[int]] = None,
                             if hasattr(core, "flightrec_snapshot") else b"")
                     return debugz_json(snap)
 
+                def _perfz(core=st.core):
+                    # Live perf attribution next to /metrics: the streaming
+                    # per-key baselines + anomaly counts as JSON
+                    # (docs/observability.md).
+                    snap = (core.perfstats_snapshot()
+                            if hasattr(core, "perfstats_snapshot") else b"")
+                    return snap.decode() if snap else \
+                        '{"version": 1, "enabled": false, "keys": []}'
+
                 try:
                     st.metrics_server = MetricsServer(
                         dump_fn=st.core.metrics_dump, port=port,
                         secret=ev.get_str(ev.HVDTPU_SECRET) or None,
                         health={"rank": st.rank, "size": st.size},
-                        debugz_fn=_debugz)
+                        debugz_fn=_debugz, perfz_fn=_perfz)
                 except OSError as exc:
                     # The core already joined the world — tear it down
                     # before failing or it would linger as a zombie rank
@@ -620,6 +629,25 @@ def debugz(last_n: int = 50) -> dict:
     if st.core is None or not hasattr(st.core, "flightrec_snapshot"):
         return {"flightrec": "disabled"}
     return debugz_dict(st.core.flightrec_snapshot(), last_n=last_n)
+
+
+def perf_report(parsed: bool = True):
+    """Live perf-attribution snapshot (docs/observability.md "Live perf
+    attribution"): this rank's streaming per-key baselines — EWMA + p50/p99
+    of op wall time and the wait/wire/reduce/codec phase buckets — plus
+    anomaly counts, the same JSON the worker's ``/perfz`` endpoint serves.
+    ``parsed=False`` returns the human-readable table instead
+    (:func:`horovod_tpu.perfstats.format_report`). ``{"perfstats":
+    "disabled"}`` outside process mode or without the native core."""
+    from .perfstats import format_report, parse_snapshot
+    st = _require_init()
+    if st.core is None or not hasattr(st.core, "perfstats_snapshot"):
+        return {"perfstats": "disabled"}
+    snap = st.core.perfstats_snapshot()
+    if not snap:
+        return {"perfstats": "disabled"}
+    doc = parse_snapshot(snap)
+    return doc if parsed else format_report(doc)
 
 
 def flightrec_dump(path: Optional[str] = None) -> bool:

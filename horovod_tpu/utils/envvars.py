@@ -147,6 +147,28 @@ HVDTPU_FLIGHTREC_DIR = "HVDTPU_FLIGHTREC_DIR"
 DEFAULT_FLIGHTREC_EVENTS = 4096
 MAX_FLIGHTREC_EVENTS = 16 * 1024 * 1024
 
+# Always-on perf attribution (native/perfstats.{h,cpp} +
+# horovod_tpu/perfstats.py; docs/observability.md "Live perf
+# attribution"). PERFSTATS: "1" (default) streams per-op EWMA + P² p50/p99
+# baselines of wall time and the wait/wire/reduce/codec phase buckets,
+# keyed by {tensor-set signature, algo, transport, hier, compression, op} —
+# unsampled, allocation-free, inside the shared <2% observability budget;
+# "0" disables. PERF_SLOWDOWN_PCT: the slowdown sentry flags a completed
+# op this many percent over its key's rolling baseline (ANOMALY flight
+# event + hvdtpu_perf_anomalies_total{phase=...}); 0 disables the sentry,
+# baselines keep streaming. PERF_MIN_SAMPLES: per-key warmup before the
+# sentry may fire. PERF_PROFILE_DIR: directory where each rank persists
+# perf_profile.<rank>.json at shutdown for the cross-run regression sentry
+# (`hvdrun --perf-profile DIR` sets it and merges at job end;
+# scripts/perf_diff.py compares two profiles).
+HVDTPU_PERFSTATS = "HVDTPU_PERFSTATS"
+HVDTPU_PERF_SLOWDOWN_PCT = "HVDTPU_PERF_SLOWDOWN_PCT"
+HVDTPU_PERF_MIN_SAMPLES = "HVDTPU_PERF_MIN_SAMPLES"
+HVDTPU_PERF_PROFILE_DIR = "HVDTPU_PERF_PROFILE_DIR"
+
+DEFAULT_PERF_SLOWDOWN_PCT = 50.0
+DEFAULT_PERF_MIN_SAMPLES = 20
+
 # Autotune (reference: HOROVOD_AUTOTUNE, HOROVOD_AUTOTUNE_LOG,
 # horovod/common/operations.cc:474-532)
 HVDTPU_AUTOTUNE = "HVDTPU_AUTOTUNE"

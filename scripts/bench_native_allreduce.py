@@ -45,7 +45,7 @@ NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
 # unpaired sweeps, ±10% drift windows apart, on this box).
 AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
             "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace",
-            "flightrec")
+            "flightrec", "perfstats")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -127,6 +127,13 @@ def load_lib(path: str) -> ctypes.CDLL:
                                              ctypes.c_char_p]
     except AttributeError:
         pass  # pre-flight-recorder build
+    try:
+        lib.hvdtpu_set_perfstats.restype = ctypes.c_int
+        lib.hvdtpu_set_perfstats.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_double,
+            ctypes.c_longlong, ctypes.c_char_p]
+    except AttributeError:
+        pass  # pre-perfstats build
     return lib
 
 
@@ -216,6 +223,19 @@ def run_worker(args) -> int:
                 core, 4096 if args.flightrec == "on" else 0, b"")
         else:
             print("SKIP flightrec config: library has no flight recorder",
+                  file=sys.stderr)
+            return 0
+    if args.perfstats != "default":
+        # Same tri-state contract as --flightrec: "default" never calls
+        # the API (keeps --ab lib=old:new runnable against pre-perfstats
+        # .so builds); on = production defaults (sentry at 50%/20 samples,
+        # no profile). `--ab perfstats=off:on` is the always-on
+        # attribution observability-budget gate (docs/benchmarks.md).
+        if hasattr(lib, "hvdtpu_set_perfstats"):
+            lib.hvdtpu_set_perfstats(
+                core, 1 if args.perfstats == "on" else 0, 50.0, 20, b"")
+        else:
+            print("SKIP perfstats config: library has no perf attribution",
                   file=sys.stderr)
             return 0
     if hasattr(lib, "hvdtpu_set_transport_ext"):
@@ -326,7 +346,7 @@ def run_config(args, world: int, algo: str, sizes: list,
            "doorbell-batch": args.doorbell_batch,
            "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
            "lib": args.lib, "trace": args.trace,
-           "flightrec": args.flightrec}
+           "flightrec": args.flightrec, "perfstats": args.perfstats}
     if overrides:
         cfg.update(overrides)
     port = free_port()
@@ -348,6 +368,7 @@ def run_config(args, world: int, algo: str, sizes: list,
                "--trace", str(cfg["trace"]),
                "--trace-sample", str(args.trace_sample),
                "--flightrec", str(cfg["flightrec"]),
+               "--perfstats", str(cfg["perfstats"]),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -380,7 +401,8 @@ def run_config(args, world: int, algo: str, sizes: list,
                     "shm_numa": cfg["shm-numa"],
                     "doorbell_batch": cfg["doorbell-batch"],
                     "trace": cfg["trace"],
-                    "flightrec": cfg["flightrec"]})
+                    "flightrec": cfg["flightrec"],
+                    "perfstats": cfg["perfstats"]})
     return rows, failed
 
 
@@ -561,6 +583,13 @@ def main(argv=None) -> int:
                         "this build, absent on older .so builds — keeps "
                         "--ab lib=old:new runnable); --ab flightrec=off:on "
                         "is the observability-budget gate")
+    p.add_argument("--perfstats", default="default",
+                   choices=["default", "on", "off"],
+                   help="always-on perf attribution (HVDTPU_PERFSTATS): "
+                        "'default' leaves the library's default (on for "
+                        "this build, absent on older .so builds); --ab "
+                        "perfstats=off:on is the attribution "
+                        "observability-budget gate")
     p.add_argument("--ab", default=None, metavar="FLAG=A:B",
                    help="paired interleaved A/B over one knob, e.g. "
                         "'doorbell-batch=1:0' or 'tcp-zerocopy=off:on': "

@@ -542,6 +542,11 @@ def check_enum_mirrors(root: Path, findings, ran):
               "horovod_tpu/flightrec.py", "FLIGHT_EVENTS")
     dict_pair("DumpReason", f"{NATIVE_DIR}/flightrec.h", "DumpReason",
               "horovod_tpu/flightrec.py", "DUMP_REASONS")
+    # Perf-attribution phase buckets (ISSUE 13): the codes ride the /perfz
+    # JSON and the ANOMALY flight record's arg word — a drifted value
+    # misattributes a slowdown instead of crashing.
+    dict_pair("PerfPhase", f"{NATIVE_DIR}/perfstats.h", "PerfPhase",
+              "horovod_tpu/perfstats.py", "PERF_PHASES")
     # postmortem.py keeps its own OpType literal (no runtime import) to
     # label the fatal op; a drifted code misnames the collective in the
     # verdict, so it is pinned like the others.

@@ -82,6 +82,58 @@ postmortem_smoke() {
   return 0
 }
 run_check "postmortem-smoke" postmortem_smoke
+# Live-console smoke (docs/observability.md): a real 2-rank job with the
+# --top console in --top-once mode must print one well-formed frame naming
+# BOTH ranks (scraped live from /metrics + /perfz mid-job) — the live
+# "why is rank N slow" surface cannot silently regress into empty frames.
+hvdtop_smoke() {
+  local out
+  # Paced iterations keep the job alive past the console's first
+  # successful scrape — and the frame must say 2/2: a "0/2 ranks up"
+  # frame also names both ranks (as UNREACHABLE), which is exactly the
+  # regression this smoke exists to catch.
+  out=$(env JAX_PLATFORMS=cpu TEST_PERF_ITERS=400 \
+    TEST_PERF_ITER_SLEEP_MS=20 "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 --metrics-port 19590 \
+    --top --top-once python3 tests/data/perf_worker.py 2>&1) || return 1
+  echo "${out}" | grep -q "hvdtop — 2/2 ranks up" || return 1
+  echo "${out}" | grep -qE "^ +0 " || return 1
+  echo "${out}" | grep -qE "^ +1 " || return 1
+  echo "${out}" | grep -q "straggler: rank" || return 1
+  return 0
+}
+run_check "hvdtop-smoke" hvdtop_smoke
+# Cross-run regression-sentry smoke (docs/observability.md): a job writes
+# merged perf profiles; perf_diff must pass a profile against itself
+# (exit 0) and CONFIRM a doctored 3x slowdown (exit 1) — so the perf
+# trajectory stays machine-gated.
+perf_diff_smoke() {
+  local dir
+  dir=$(mktemp -d /tmp/hvdtpu_pd_smoke.XXXXXX) || return 1
+  env JAX_PLATFORMS=cpu TEST_PERF_ITERS=40 "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 \
+    --perf-profile "${dir}" python3 tests/data/perf_worker.py \
+    > /dev/null 2>&1 || return 1
+  [ -f "${dir}/perf_profile.json" ] || return 1
+  python3 scripts/perf_diff.py "${dir}/perf_profile.json" \
+    "${dir}/perf_profile.json" > /dev/null || return 1
+  python3 - "${dir}" <<'EOF' || return 1
+import json, sys
+path = sys.argv[1] + "/perf_profile.json"
+doc = json.load(open(path))
+for prof in doc["ranks"].values():
+    for e in prof["perfstats"]["keys"]:
+        e["samples_us"] = [int(s * 3) for s in e["samples_us"]]
+json.dump(doc, open(sys.argv[1] + "/doctored.json", "w"))
+EOF
+  if python3 scripts/perf_diff.py "${dir}/perf_profile.json" \
+      "${dir}/doctored.json" > /dev/null; then
+    return 1  # a 3x slowdown MUST be confirmed
+  fi
+  rm -rf "${dir}"
+  return 0
+}
+run_check "perf_diff-smoke" perf_diff_smoke
 
 echo
 echo "============ CI summary ============"

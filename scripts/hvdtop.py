@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""Live per-rank fleet console for a running horovod_tpu job.
+
+Thin CLI over :mod:`horovod_tpu.runner.hvdtop` (docs/observability.md):
+scrapes every worker's ``/metrics`` + ``/perfz`` endpoints and renders a
+refreshing frame of ops/s, wire ratio, stall/anomaly flags, clock-sync
+quality, and the current straggler with its phase attribution.
+
+    # job launched with: hvdrun -np 4 --metrics-port 9090 python train.py
+    export HVDTPU_SECRET=...   # the job secret (hvdrun prints scrape URLs)
+    python scripts/hvdtop.py --port 9090 -np 4
+
+``hvdrun --top`` embeds the same console in the launcher; ``--once``
+prints a single frame and exits (the CI smoke mode).
+"""
+
+import os
+import sys
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.runner.hvdtop import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

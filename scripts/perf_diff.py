@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""Cross-run perf regression sentry (docs/observability.md).
+
+Compares two ``perf_profile`` artifacts — the per-key streaming baselines
+plus recent raw wall samples each job persists at shutdown
+(``HVDTPU_PERF_PROFILE_DIR`` / ``hvdrun --perf-profile DIR``) — and exits
+non-zero on a CONFIRMED regression, so the perf trajectory is machine-gated
+in CI (scripts/ci_checks.sh perf_diff-smoke) instead of eyeballed across
+benchmark JSONs.
+
+    python scripts/perf_diff.py OLD NEW [--threshold-pct 10]
+
+OLD/NEW each name a merged ``perf_profile.json``, a per-rank
+``perf_profile.<rank>.json``, or a directory of per-rank files (merged on
+the fly). Keys are matched per (rank, tensor-set signature, algo,
+transport, hier, compression, op); a key is compared only when both runs
+hold enough raw samples.
+
+Statistics: per key, the ratio of median wall times (new/old) with a 95%
+bootstrap CI from resampling both sides; across keys, the bench harness's
+deterministic bootstrap-CI machinery (scripts/bench_native_allreduce.py
+``bootstrap_ci``) over the per-key ratios. "Confirmed" means the CI's LOWER
+bound clears the threshold — noisy single-key flukes stay warnings.
+
+Exit status: 0 = no confirmed regression, 1 = confirmed regression,
+2 = bad arguments / unreadable profiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.perfstats import (load_profile, merge_profile_dir,  # noqa: E402
+                                   profile_ranks)
+from scripts.bench_native_allreduce import bootstrap_ci  # noqa: E402
+
+
+def load_any(path: str) -> dict:
+    """Profile file OR directory of perf_profile.<rank>.json files."""
+    if os.path.isdir(path):
+        merged, found = merge_profile_dir(path)
+        if not found:
+            raise ValueError(f"{path}: no perf_profile.<rank>.json files")
+        return merged
+    return load_profile(path)
+
+
+def key_samples(doc: dict) -> Dict[Tuple[int, str], dict]:
+    """{(rank, key): key-entry} across every rank in a profile document."""
+    out: Dict[Tuple[int, str], dict] = {}
+    for rank, prof in profile_ranks(doc).items():
+        snap = prof.get("perfstats", {})
+        for entry in snap.get("keys", []):
+            out[(rank, entry["key"])] = entry
+    return out
+
+
+def ratio_ci(old: List[float], new: List[float], resamples: int = 2000,
+             seed: int = 12345) -> Tuple[float, float, float]:
+    """(median ratio, ci_lo, ci_hi) of median(new)/median(old), bootstrap
+    over both sides. Deterministic seed: a CI gate must be reproducible."""
+    rng = random.Random(seed)
+    point = statistics.median(new) / max(statistics.median(old), 1e-9)
+    ratios = sorted(
+        statistics.median(rng.choices(new, k=len(new))) /
+        max(statistics.median(rng.choices(old, k=len(old))), 1e-9)
+        for _ in range(resamples))
+    lo = ratios[max(0, int(0.025 * resamples) - 1)]
+    hi = ratios[min(resamples - 1, int(0.975 * resamples))]
+    return point, lo, hi
+
+
+def anomaly_count(doc: dict) -> int:
+    return sum(len(prof.get("anomalies", []))
+               for prof in profile_ranks(doc).values())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("old", help="baseline profile (file or directory)")
+    p.add_argument("new", help="candidate profile (file or directory)")
+    p.add_argument("--threshold-pct", type=float, default=10.0,
+                   help="confirmed regression = CI lower bound above "
+                        "1 + this percent (default 10)")
+    p.add_argument("--min-samples", type=int, default=5,
+                   help="per-key raw-sample floor on BOTH sides before the "
+                        "key is compared (default 5)")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="write the machine-readable comparison here")
+    args = p.parse_args(argv)
+
+    try:
+        old_doc = load_any(args.old)
+        new_doc = load_any(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"perf_diff: {exc}", file=sys.stderr)
+        return 2
+
+    old_keys = key_samples(old_doc)
+    new_keys = key_samples(new_doc)
+    threshold = 1.0 + args.threshold_pct / 100.0
+
+    rows = []
+    per_key_ratios = []
+    confirmed: List[str] = []
+    warned: List[str] = []
+    for ident in sorted(set(old_keys) & set(new_keys)):
+        o, n = old_keys[ident], new_keys[ident]
+        so = [float(x) for x in o.get("samples_us", []) if x > 0]
+        sn = [float(x) for x in n.get("samples_us", []) if x > 0]
+        if len(so) < args.min_samples or len(sn) < args.min_samples:
+            continue
+        point, lo, hi = ratio_ci(so, sn)
+        per_key_ratios.append(point)
+        label = f"rank{ident[0]}:{ident[1]}"
+        row = {"rank": ident[0], "key": ident[1], "ratio": round(point, 4),
+               "ci95": [round(lo, 4), round(hi, 4)],
+               "old_samples": len(so), "new_samples": len(sn),
+               "old_p50_us": statistics.median(so),
+               "new_p50_us": statistics.median(sn)}
+        if lo > threshold:
+            row["verdict"] = "REGRESSION"
+            confirmed.append(label)
+        elif point > threshold:
+            row["verdict"] = "warn"  # slower, but the CI straddles
+            warned.append(label)
+        else:
+            row["verdict"] = "ok"
+        rows.append(row)
+
+    overall = None
+    if per_key_ratios:
+        med = statistics.median(per_key_ratios)
+        glo, ghi = bootstrap_ci(per_key_ratios)
+        overall = {"median_ratio": round(med, 4),
+                   "ci95": [round(glo, 4), round(ghi, 4)],
+                   "keys": len(per_key_ratios)}
+        if glo > threshold:
+            confirmed.append("overall")
+
+    old_anom, new_anom = anomaly_count(old_doc), anomaly_count(new_doc)
+    report = {"threshold_pct": args.threshold_pct, "keys": rows,
+              "overall": overall, "confirmed": confirmed, "warned": warned,
+              "anomalies": {"old": old_anom, "new": new_anom}}
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+
+    for row in rows:
+        print(f"  [{row['verdict']:>10}] rank{row['rank']} {row['key']}: "
+              f"{row['ratio']:.3f}x (CI {row['ci95'][0]:.3f}.."
+              f"{row['ci95'][1]:.3f}, p50 {row['old_p50_us']:.0f} -> "
+              f"{row['new_p50_us']:.0f} us)")
+    if overall is not None:
+        print(f"  overall: {overall['median_ratio']:.3f}x over "
+              f"{overall['keys']} key(s) (CI {overall['ci95'][0]:.3f}.."
+              f"{overall['ci95'][1]:.3f})")
+    else:
+        print("  overall: no comparable keys (profiles too short or "
+              "disjoint)")
+    if new_anom > old_anom:
+        print(f"  note: anomaly log grew {old_anom} -> {new_anom} "
+              "(see the profiles' \"anomalies\" entries)")
+    if confirmed:
+        print(f"perf_diff: CONFIRMED regression past "
+              f"{args.threshold_pct:.0f}%: {', '.join(confirmed)}")
+        return 1
+    if warned:
+        print(f"perf_diff: slower but unconfirmed (CI straddles): "
+              f"{', '.join(warned)}")
+    print("perf_diff: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Worker: drive steady-state named allreduces for the perf-attribution
+subsystem (docs/observability.md "Live perf attribution").
+
+A fixed set of tensor names iterated many times — the streaming baselines
+key on the tensor-set signature, so (unlike algo_worker's fresh-per-iter
+names) every iteration lands on the same keys, the way a training loop's
+gradients do. Optionally:
+
+* ``TEST_PERF_ITERS`` — loop count (default 60);
+* ``TEST_PERF_ITER_SLEEP_MS`` — sleep between iterations (paces the job so
+  a driver-side test can scrape /perfz mid-run);
+* ``TEST_PERF_ASSERT_ANOMALY_RANK`` — on that rank, assert the sentry
+  fired at least one ANOMALY (chaos-delay acceptance: HVDTPU_CHAOS
+  rankN:delay=... must surface as a flight-recorder ANOMALY + a non-zero
+  hvdtpu_perf_anomalies_total + a perf_report() entry);
+* ``TEST_PERF_REPORT_JSON`` — write this rank's ``hvd.perf_report()`` dict
+  there at the end (the acceptance test inspects it).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert hvd.mode() == "process", hvd.mode()
+
+iters = int(os.environ.get("TEST_PERF_ITERS", "60"))
+sleep_ms = float(os.environ.get("TEST_PERF_ITER_SLEEP_MS", "0"))
+for it in range(iters):
+    g0 = np.full((64 * 1024,), float(r + 1), np.float32)
+    out = np.asarray(hvd.allreduce(g0, name="grad/0", op=hvd.Sum))
+    np.testing.assert_allclose(out[0], n * (n + 1) / 2.0, rtol=1e-6)
+    g1 = np.full((4096,), float(it), np.float32)
+    out = np.asarray(hvd.allreduce(g1, name="grad/1", op=hvd.Sum))
+    np.testing.assert_allclose(out[0], n * it, rtol=1e-6)
+    if sleep_ms > 0:
+        time.sleep(sleep_ms / 1e3)
+
+report = hvd.perf_report()
+assert report.get("keys"), f"no perf keys streamed: {report}"
+keys = {e["key"].split("|")[0] for e in report["keys"]}
+assert "grad/0" in keys, f"grad/0 baseline missing: {sorted(keys)}"
+for e in report["keys"]:
+    assert e["count"] > 0 and e["ewma_us"]["wall"] >= 0, e
+
+anomalies = sum(e.get("anomalies", 0) for e in report["keys"])
+assert_rank = os.environ.get("TEST_PERF_ASSERT_ANOMALY_RANK")
+if assert_rank is not None and int(assert_rank) == r:
+    # The chaos-delayed op must have tripped the sentry on the delayed
+    # rank (its own wall spikes by the full delay).
+    assert anomalies >= 1, f"sentry never fired: {report}"
+    # ... and the ANOMALY must be in the flight ring too (arg carries the
+    # PerfPhase code).
+    dz = hvd.debugz(last_n=10_000)
+    kinds = {ev["type"] for ev in dz.get("last_events", [])}
+    assert "anomaly" in kinds, f"no ANOMALY flight event: {sorted(kinds)}"
+
+out_path = os.environ.get("TEST_PERF_REPORT_JSON")
+if out_path:
+    with open(f"{out_path}.{r}", "w") as f:
+        json.dump({"rank": r, "anomalies": anomalies, "report": report}, f)
+
+hvd.shutdown()
+print("ALL OK")
+sys.exit(0)

@@ -117,6 +117,22 @@ class TestExpositionParser:
         assert sample_value(parsed, "hvdtpu_cycle_seconds", suffix="bucket",
                             le="0.0001", rank="3") == 5
 
+    def test_render_special_values(self):
+        """NaN and ±Inf are legal exposition values (promtool parity):
+        re-rendering must emit them, not crash on int(NaN)."""
+        import math
+
+        from horovod_tpu.observability import (parse_prometheus_text,
+                                               render_exposition)
+        text = ("# TYPE odd gauge\nodd NaN\n"
+                "# TYPE pos gauge\npos +Inf\n"
+                "# TYPE neg gauge\nneg -Inf\n")
+        rendered = render_exposition(parse_prometheus_text(text))
+        assert "odd NaN" in rendered
+        assert "pos +Inf" in rendered and "neg -Inf" in rendered
+        reparsed = parse_prometheus_text(rendered)
+        assert math.isnan(reparsed["odd"]["samples"][0][2])
+
 
 class TestHistogramQuantile:
     """ISSUE 12 satellite: the merged-histogram quantile helper's edge
@@ -354,6 +370,82 @@ class TestAggregator:
         try:
             assert agg.scrape_once() == {}
             assert agg.merged() == ""
+            assert agg.unreachable() == [0]
+        finally:
+            agg._server.stop()
+
+    def test_killed_worker_flagged_not_fatal(self):
+        """ISSUE 13 satellite: a worker dying mid-scrape is skipped AND
+        named in the summary line; the reachable ranks' cycle survives."""
+        from horovod_tpu.observability import MetricsServer
+        from horovod_tpu.runner.metrics_agg import MetricsAggregator
+
+        servers = [MetricsServer(dump_fn=lambda: SAMPLE, port=0)
+                   for _ in range(2)]
+        for s in servers:
+            s.start()
+        agg = MetricsAggregator(
+            {0: ("127.0.0.1", servers[0].port),
+             1: ("127.0.0.1", servers[1].port)},
+            port=0, print_summary=False)
+        try:
+            dumps = agg.scrape_once()
+            assert sorted(dumps) == [0, 1] and agg.unreachable() == []
+            line = agg.summary_line(dumps)
+            assert "unreachable" not in line
+            # Rank 1 dies (endpoint gone, connection refused).
+            servers[1].stop()
+            dumps = agg.scrape_once()
+            assert sorted(dumps) == [0]
+            assert agg.unreachable() == [1]
+            line = agg.summary_line(dumps)
+            assert line.startswith("hvdrun metrics:")
+            assert "unreachable=[1]" in line
+            # The merged view keeps serving the survivor.
+            assert 'rank="0"' in agg.merged()
+            assert 'rank="1"' not in agg.merged()
+        finally:
+            agg._server.stop()
+            servers[0].stop()
+
+    def test_elastic_replacement_endpoint_update(self):
+        """ISSUE 13 satellite: elastic re-rendezvous replaces a dead
+        worker's endpoint; update_endpoints() swaps the target live and
+        the replacement is scraped on the next round without a restart."""
+        from horovod_tpu.observability import MetricsServer
+        from horovod_tpu.runner.metrics_agg import MetricsAggregator
+        from conftest import free_port
+
+        alive = MetricsServer(dump_fn=lambda: SAMPLE, port=0)
+        alive.start()
+        replacement = MetricsServer(dump_fn=lambda: SAMPLE, port=0)
+        replacement.start()
+        agg = MetricsAggregator(
+            {0: ("127.0.0.1", alive.port),
+             1: ("127.0.0.1", free_port())},  # dead slot
+            port=0, print_summary=False)
+        try:
+            dumps = agg.scrape_once()
+            assert sorted(dumps) == [0] and agg.unreachable() == [1]
+            agg.update_endpoints({0: ("127.0.0.1", alive.port),
+                                  1: ("127.0.0.1", replacement.port)})
+            dumps = agg.scrape_once()
+            assert sorted(dumps) == [0, 1]
+            assert agg.unreachable() == []
+        finally:
+            agg._server.stop()
+            alive.stop()
+            replacement.stop()
+
+    def test_truncated_dump_flagged_not_fatal(self):
+        """A worker dying MID-RESPONSE hands the aggregator a malformed
+        exposition: the rank is flagged, the cycle completes."""
+        from horovod_tpu.runner.metrics_agg import MetricsAggregator
+        agg = MetricsAggregator({}, port=0, print_summary=False)
+        try:
+            line = agg.summary_line({0: SAMPLE, 1: "hvdtpu_{oops 1 2 3"})
+            assert line.startswith("hvdrun metrics:")
+            assert "unreachable=[1]" in line
         finally:
             agg._server.stop()
 
@@ -455,6 +547,61 @@ def test_stall_warning_and_gauge():
     assert "tensor 'withheld'" in err0, err0
     assert "waiting on ranks [1]" in err0, err0
     assert "ready on ranks [0]" in err0, err0
+
+
+def test_golden_exposition_roundtrip():
+    """ISSUE 13 satellite: scrape a LIVE worker's full /metrics, parse it
+    with observability.py, re-render, re-parse, and diff — pins the parser
+    against the entire current metric catalog (every family the native
+    registry emits), not a hand-picked sample."""
+    import numpy as np
+
+    from horovod_tpu.observability import (MetricsServer,
+                                           parse_prometheus_text,
+                                           render_exposition, scrape)
+    from tests.test_flightrec import _single_rank_core
+
+    core = _single_rank_core()
+    server = None
+    try:
+        # Touch every instrumented subsystem so the dump carries the full
+        # catalog: ops (histogram labels), fusion, gauges, perf counters.
+        for i in range(30):
+            core.collective("allreduce", "rt", np.ones(2048, np.float32))
+        core.collective("allgather", "rt2", np.ones(8, np.float32))
+        server = MetricsServer(dump_fn=core.metrics_dump, port=0)
+        server.start()
+        text = scrape("127.0.0.1", server.port)
+        assert "hvdtpu_op_seconds" in text  # a real, full dump
+        assert "hvdtpu_clock_offset_us" in text
+        parsed = parse_prometheus_text(text)
+        reparsed = parse_prometheus_text(render_exposition(parsed))
+        assert set(parsed) == set(reparsed)
+        for fam, doc in parsed.items():
+            assert doc["type"] == reparsed[fam]["type"], fam
+            assert doc["help"] == reparsed[fam]["help"], fam
+            assert doc["samples"] == reparsed[fam]["samples"], fam
+    finally:
+        if server is not None:
+            server.stop()
+        core.shutdown()
+
+
+def test_clock_sync_gauges_exposed():
+    """ISSUE 13 satellite: clock-sync quality rides /metrics as gauges.
+    A single-rank world IS rank 0 (offset 0, err 0); a never-synced
+    worker's err reads -1 — either way the series exist for the
+    aggregator/console to flag degraded alignment."""
+    from horovod_tpu.observability import parse_prometheus_text, sample_value
+    from tests.test_flightrec import _single_rank_core
+
+    core = _single_rank_core()
+    try:
+        parsed = parse_prometheus_text(core.metrics_dump())
+        assert sample_value(parsed, "hvdtpu_clock_offset_us") == 0
+        assert sample_value(parsed, "hvdtpu_clock_err_us") == 0
+    finally:
+        core.shutdown()
 
 
 def test_hvdrun_metrics_flags_and_aggregator(tmp_path):
