@@ -169,6 +169,19 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_perfstats_snapshot.restype = ctypes.c_longlong
     lib.hvdtpu_perfstats_snapshot.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.hvdtpu_set_profiler.restype = ctypes.c_int
+    lib.hvdtpu_set_profiler.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+        ctypes.c_int, ctypes.c_char_p]
+    lib.hvdtpu_profiler_start.restype = ctypes.c_int
+    lib.hvdtpu_profiler_start.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_profiler_stop.restype = ctypes.c_int
+    lib.hvdtpu_profiler_stop.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_profiler_running.restype = ctypes.c_int
+    lib.hvdtpu_profiler_running.argtypes = [ctypes.c_void_p]
+    lib.hvdtpu_profiler_snapshot.restype = ctypes.c_longlong
+    lib.hvdtpu_profiler_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvdtpu_flightrec_dump.restype = ctypes.c_int
     lib.hvdtpu_flightrec_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.hvdtpu_flightrec_snapshot.restype = ctypes.c_longlong
@@ -320,6 +333,32 @@ class NativeCore:
                                         f"perf_profile.{rank}.json")
         self._lib.hvdtpu_set_perfstats(self._core, int(perf_on), perf_pct,
                                        perf_min, profile_path.encode())
+        # In-process sampling profiler (docs/profiling.md): armed by
+        # default, sampling only while a window runs. HVDTPU_PROF_DIR (set
+        # by `hvdrun --profile`) runs the window for the whole job and
+        # writes prof.<rank>.folded at shutdown — absolute for the same
+        # chdir() reason as the dirs above.
+        prof_on = ev.get_bool(ev.HVDTPU_PROF, default=True)
+        prof_hz = ev.get_int(ev.HVDTPU_PROF_HZ, ev.DEFAULT_PROF_HZ)
+        if prof_hz < 1 or prof_hz > ev.MAX_PROF_HZ:
+            raise ValueError(
+                f"{ev.HVDTPU_PROF_HZ} must be 1..{ev.MAX_PROF_HZ} Hz, "
+                f"got {prof_hz}")
+        prof_clock = (ev.get_str(ev.HVDTPU_PROF_CLOCK, "cpu") or
+                      "cpu").strip().lower()
+        if prof_clock not in ev.PROF_CLOCK_MODES:
+            raise ValueError(
+                f"{ev.HVDTPU_PROF_CLOCK} must be one of "
+                f"{sorted(ev.PROF_CLOCK_MODES)}, got {prof_clock!r}")
+        prof_folded = ""
+        prof_dir = ev.get_str(ev.HVDTPU_PROF_DIR, "") or ""
+        if prof_dir and prof_on:
+            prof_dir = os.path.abspath(prof_dir)
+            os.makedirs(prof_dir, exist_ok=True)
+            prof_folded = os.path.join(prof_dir, f"prof.{rank}.folded")
+        self._lib.hvdtpu_set_profiler(
+            self._core, int(prof_on), prof_hz, 0,
+            ev.PROF_CLOCK_MODES[prof_clock], prof_folded.encode())
         # Response cache (reference: HOROVOD_CACHE_CAPACITY; 0 disables).
         self._lib.hvdtpu_set_cache_capacity(
             self._core, ev.get_int(ev.HVDTPU_CACHE_CAPACITY, 1024))
@@ -637,6 +676,32 @@ class NativeCore:
         The same payload the ``/perfz`` endpoint serves. ``b""`` when the
         core is shut down."""
         return self._probe_then_copy(self._lib.hvdtpu_perfstats_snapshot)
+
+    def profiler_start(self) -> None:
+        """Open a sampling window (docs/profiling.md): clears the sample
+        ring and arms every registered thread's SIGPROF timer. No-op when
+        ``HVDTPU_PROF=0``. Idempotent."""
+        if self._core:
+            self._lib.hvdtpu_profiler_start(self._core)
+
+    def profiler_stop(self) -> None:
+        """Close the sampling window (timers disarmed; the ring keeps the
+        window's samples for :meth:`profiler_snapshot`). Idempotent."""
+        if self._core:
+            self._lib.hvdtpu_profiler_stop(self._core)
+
+    def profiler_running(self) -> bool:
+        """True while a sampling window is open."""
+        return bool(self._core and
+                    self._lib.hvdtpu_profiler_running(self._core))
+
+    def profiler_snapshot(self) -> bytes:
+        """Folded-stacks JSON bytes (decode with
+        :mod:`horovod_tpu.profiler` / ``json.loads``): aggregated
+        {phase, op, frames} -> count, dladdr-symbolized at snapshot time.
+        The same payload the ``/profz`` endpoint serves. ``b""`` when the
+        core is shut down."""
+        return self._probe_then_copy(self._lib.hvdtpu_profiler_snapshot)
 
     def flightrec_snapshot(self) -> bytes:
         """Serialized flight-recorder dump image (binary; decode with

@@ -97,6 +97,16 @@ class ResidualStore {
   // per stale signature (EF restarts from zero; it is best-effort state).
   float* Get(const std::string& key, int64_t count);
   size_t size() const { return buf_.size(); }
+  // Total bytes held across every residual buffer — the memory-occupancy
+  // telemetry's hvdtpu_residual_store_bytes gauge. O(entries), entries are
+  // capped at kMaxEntries; background thread only, like Get.
+  int64_t bytes() const {
+    int64_t total = 0;
+    for (const auto& kv : buf_) {
+      total += static_cast<int64_t>(kv.second.size() * sizeof(float));
+    }
+    return total;
+  }
 
   static constexpr size_t kMaxEntries = 256;
 

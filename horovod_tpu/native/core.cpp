@@ -42,12 +42,14 @@
 #include "message.h"
 #include "metrics.h"
 #include "perfstats.h"
+#include "profiler.h"
 #include "socket_util.h"
 #include "timeline.h"
 #include "tracing.h"
 
 #include <execinfo.h>
 #include <poll.h>
+#include <sys/resource.h>
 #include <unistd.h>
 #include <fcntl.h>
 
@@ -264,6 +266,19 @@ struct CoreConfig {
   double perf_slowdown_pct = 50.0;
   int64_t perf_min_samples = 20;
   std::string perf_profile_path;
+  // Always-available sampling profiler (profiler.h; docs/profiling.md).
+  // Enabled by default: the subsystem costs nothing until a window runs
+  // (HVDTPU_PROF=0 compiles it down to one branch per entry point).
+  // prof_hz/prof_capacity <= 0 keep the defaults; prof_clock: 0 cpu,
+  // 1 wall. prof_path: where Shutdown writes prof.<rank>.folded
+  // (HVDTPU_PROF_DIR -> hvdrun --profile; empty = skip); a non-empty path
+  // also starts the window at Start — the whole-job profile the runner
+  // collects.
+  bool prof = true;
+  int prof_hz = 0;
+  int64_t prof_capacity = 0;
+  int32_t prof_clock = 0;
+  std::string prof_path;
   double stall_warn_secs = 60.0;  // reference HOROVOD_STALL_CHECK_TIME
   // Shared job secret (reference: runner/common/util/secret.py). When set,
   // every HELLO must carry an HMAC proof; unauthenticated connections are
@@ -403,6 +418,14 @@ class Core {
   // Keyed-baseline snapshot as JSON — lock-free reads, callable from any
   // thread at any point in the core lifecycle.
   std::string PerfSnapshot() { return perfstats_.SnapshotJson(); }
+  // Sampling-profiler surface (C API hvdtpu_profiler_*; /profz /
+  // hvd.profile()). All callable from any thread at any point in the core
+  // lifecycle — a disabled profiler starts/stops as no-ops and snapshots
+  // the "enabled: false" stub.
+  void ProfilerStart() { profiler_.Start(); }
+  void ProfilerStop() { profiler_.Stop(); }
+  bool ProfilerRunning() const { return profiler_.running(); }
+  std::string ProfilerSnapshot() const { return profiler_.FoldedJson(); }
   CoreConfig* mutable_config() { return &cfg_; }  // pre-Start() only
 
  private:
@@ -472,11 +495,25 @@ class Core {
   // bounded; written out by Shutdown after the loop is joined).
   std::vector<std::string> perf_anomaly_log_;
   bool perf_profile_written_ = false;
-  // Sentry log throttle: anomalies can cluster (every op of a slow phase
-  // fires) — the counter and flight ring record each one, the LOG warns at
-  // most once per second (background thread only).
-  double last_perf_warn_at_ = 0;
   void WritePerfProfile();
+  // Always-available sampling profiler (profiler.h; docs/profiling.md):
+  // the background loop registers itself for SIGPROF sampling, the data
+  // plane publishes the phase thread-local the samples are tagged with,
+  // and /profz / hvd.profile() / hvdrun --profile drive the window.
+  SamplingProfiler profiler_;
+  bool prof_written_ = false;
+  // Memory-occupancy telemetry (docs/profiling.md "Memory telemetry"):
+  // refreshed by the background loop at most once per second. Fusion
+  // high-water is tracked here (the per-batch gauge is set at execution).
+  double last_mem_update_at_ = 0;
+  int64_t fusion_highwater_bytes_ = 0;
+  Gauge* m_fusion_buffer_gauge_ = nullptr;
+  Gauge* m_fusion_highwater_gauge_ = nullptr;
+  Gauge* m_residual_bytes_gauge_ = nullptr;
+  Gauge* m_rss_gauge_ = nullptr;
+  Gauge* m_rss_peak_gauge_ = nullptr;
+  std::vector<std::pair<int, int64_t>> shm_occupancy_scratch_;
+  void UpdateMemoryGauges(bool force = false);
 
   // One histogram-pair + counter observation per completed data-plane op,
   // plus the perf-attribution sentry: `perf_sig` is the tensor-set
@@ -759,8 +796,8 @@ void Core::ObserveOp(const char* op, double secs, int64_t bytes,
   sample.reduce_us = data_plane_.op_reduce_us();
   sample.codec_us = data_plane_.op_codec_us();
   sample.slow_peer = data_plane_.op_slow_peer();
-  const PerfStats::Anomaly an =
-      perfstats_.RecordOp(perfstats_.KeySlot(key), sample);
+  const int perf_slot = perfstats_.KeySlot(key);
+  const PerfStats::Anomaly an = perfstats_.RecordOp(perf_slot, sample);
   if (!an.fired) return;
   metrics_
       .GetCounter(
@@ -777,9 +814,11 @@ void Core::ObserveOp(const char* op, double secs, int64_t bytes,
                       bytes, an.slow_peer, -1, now - sample.wall_us, now,
                       static_cast<int64_t>(an.phase), 0);
   }
-  const double warn_now = NowSeconds();
-  if (warn_now - last_perf_warn_at_ >= 1.0) {
-    last_perf_warn_at_ = warn_now;
+  // Per-KEY log throttle (PerfStats::ShouldWarn): each slow key warns at
+  // most once per second, but a chatty key can no longer starve a second,
+  // different key's first warning — that second key appearing IS the
+  // signal ("now codec-bound too").
+  if (perfstats_.ShouldWarn(perf_slot, Timeline::SteadyAbsUs())) {
     LogWarn(cfg_.rank,
             "perf sentry: op '%s' ran %.2fx its baseline (%.2f ms vs "
             "%.2f ms), dominant phase %s%s",
@@ -985,6 +1024,33 @@ Status Core::Start() {
   perfstats_.Configure(cfg_.perfstats, cfg_.perf_slowdown_pct,
                        cfg_.perf_min_samples);
   data_plane_.set_perf_enabled(perfstats_.enabled());
+  // Always-available sampling profiler (docs/profiling.md): the background
+  // loop registers itself once it starts; a window runs only on demand
+  // (/profz, hvd.profile()) — except under hvdrun --profile, whose
+  // prof_path arms a whole-job window right here.
+  profiler_.Configure(cfg_.prof, cfg_.prof_hz, cfg_.prof_capacity,
+                      static_cast<ProfClock>(cfg_.prof_clock), cfg_.rank);
+  if (profiler_.enabled() && !cfg_.prof_path.empty()) profiler_.Start();
+  // Memory-occupancy telemetry (docs/profiling.md "Memory telemetry"):
+  // fusion-buffer occupancy/high-water, ResidualStore bytes, per-lane shm
+  // ring occupancy, and process RSS/peak-RSS — refreshed by the background
+  // loop once per second.
+  m_fusion_buffer_gauge_ = metrics_.GetGauge(
+      "hvdtpu_fusion_buffer_bytes",
+      "Payload bytes of the most recent fused allreduce batch (the live "
+      "fusion-buffer occupancy)");
+  m_fusion_highwater_gauge_ = metrics_.GetGauge(
+      "hvdtpu_fusion_buffer_highwater_bytes",
+      "Largest fused batch this core has executed (fusion-buffer "
+      "high-water mark)");
+  m_residual_bytes_gauge_ = metrics_.GetGauge(
+      "hvdtpu_residual_store_bytes",
+      "Bytes held by the wire-compression error-feedback ResidualStore");
+  m_rss_gauge_ = metrics_.GetGauge(
+      "hvdtpu_rss_bytes", "Resident set size of this worker process");
+  m_rss_peak_gauge_ = metrics_.GetGauge(
+      "hvdtpu_rss_peak_bytes",
+      "Peak resident set size of this worker process (getrusage ru_maxrss)");
 
   data_plane_.set_allreduce_algo(
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
@@ -1350,6 +1416,17 @@ void Core::Shutdown() {
   // rank's per-key baselines + anomaly log. After the join, the
   // background thread's perf state is quiescent.
   WritePerfProfile();
+  // Whole-job profile (hvdrun --profile): stop the window and persist
+  // prof.<rank>.folded for scripts/prof_report.py. The background thread
+  // has unregistered its timer by now; the ring is quiescent.
+  profiler_.Stop();
+  if (!cfg_.prof_path.empty() && profiler_.enabled() && !prof_written_) {
+    prof_written_ = true;
+    if (!profiler_.WriteFolded(cfg_.prof_path)) {
+      LogWarn(cfg_.rank, "profiler: cannot write %s",
+              cfg_.prof_path.c_str());
+    }
+  }
   // Fail any still-outstanding handles.
   {
     MutexLock lk(mu_);
@@ -1505,6 +1582,10 @@ void Core::WaitForWork() {
 }
 
 void Core::BackgroundLoop() {
+  // Sampling profiler: this is the collective-driving thread — the one the
+  // flamegraphs are about. Registration creates its (disarmed) per-thread
+  // timer; the unregister below pairs with it before the thread exits.
+  profiler_.RegisterThread();
   while (!shutdown_) {
     if (worker_failover_pending_.exchange(false)) {
       // A data-plane failure was detected last cycle; the entry walk that
@@ -1535,6 +1616,50 @@ void Core::BackgroundLoop() {
     {
       MutexLock lk(mu_);
       m_outstanding_->Set(static_cast<double>(outstanding_.size()));
+    }
+    UpdateMemoryGauges();
+  }
+  profiler_.UnregisterThread();
+}
+
+void Core::UpdateMemoryGauges(bool force) {
+  // Once per second: /proc and the per-lane walks are microseconds, but the
+  // loop can cycle every millisecond under load.
+  const double now = NowSeconds();
+  if (!force && now - last_mem_update_at_ < 1.0) return;
+  last_mem_update_at_ = now;
+  if (m_residual_bytes_gauge_ != nullptr) {
+    m_residual_bytes_gauge_->Set(
+        static_cast<double>(residual_store_.bytes()));
+  }
+  // Per-lane shm-ring occupancy. The gauge handle resolution is a mutex-map
+  // lookup per lane — fine at this cadence; lanes are fixed after Connect.
+  data_plane_.ShmOccupancy(&shm_occupancy_scratch_);
+  for (const auto& lane : shm_occupancy_scratch_) {
+    metrics_
+        .GetGauge("hvdtpu_shm_ring_occupancy_bytes",
+                  "Bytes buffered in the shared-memory rings to one peer "
+                  "(both directions; head minus tail)",
+                  MetricLabels{{"peer", std::to_string(lane.first)}})
+        ->Set(static_cast<double>(lane.second));
+  }
+  // RSS (current from /proc/self/statm, peak from getrusage): the gauges
+  // that catch a fusion-buffer or ring leak growing the process.
+  if (m_rss_gauge_ != nullptr) {
+    FILE* f = fopen("/proc/self/statm", "r");
+    if (f != nullptr) {
+      long total = 0, resident = 0;
+      if (fscanf(f, "%ld %ld", &total, &resident) == 2) {
+        m_rss_gauge_->Set(static_cast<double>(resident) *
+                          static_cast<double>(sysconf(_SC_PAGESIZE)));
+      }
+      fclose(f);
+    }
+  }
+  if (m_rss_peak_gauge_ != nullptr) {
+    struct rusage ru;
+    if (getrusage(RUSAGE_SELF, &ru) == 0) {
+      m_rss_peak_gauge_->Set(static_cast<double>(ru.ru_maxrss) * 1024.0);
     }
   }
 }
@@ -2364,6 +2489,11 @@ void Core::ExecuteResponse(const Response& resp) {
 
   const double op_t0 = NowSeconds();
   const int64_t fr_t0 = Timeline::SteadyAbsUs();
+  // Profiler op tag: samples during this op fold under the (first) tensor's
+  // name; the data plane's phase scopes refine WALL into wire/wait/reduce/
+  // codec slices underneath it.
+  ProfOpScope prof_op(profiler_.InternOp(
+      resp.names.empty() ? std::string("<unnamed>") : resp.names[0]));
   Status st = Status::OK();
   switch (resp.op_type) {
     case OpType::ALLREDUCE: {
@@ -2578,6 +2708,15 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
   // slow the cycle" signal the reference surfaces only via timeline
   // archaeology.
   m_fusion_batch_bytes_->Observe(static_cast<double>(total_bytes));
+  // Memory telemetry: live fusion-buffer occupancy + high-water mark
+  // (docs/profiling.md "Memory telemetry").
+  if (m_fusion_buffer_gauge_ != nullptr) {
+    m_fusion_buffer_gauge_->Set(static_cast<double>(total_bytes));
+    if (total_bytes > fusion_highwater_bytes_) {
+      fusion_highwater_bytes_ = total_bytes;
+      m_fusion_highwater_gauge_->Set(static_cast<double>(total_bytes));
+    }
+  }
   {
     int64_t threshold;
     {
@@ -3180,6 +3319,58 @@ int hvdtpu_set_perfstats(void* core, int enabled, double slowdown_pct,
   if (min_samples > 0) cfg->perf_min_samples = min_samples;
   cfg->perf_profile_path = profile_path != nullptr ? profile_path : "";
   return 0;
+}
+
+// Always-available sampling profiler (profiler.h; docs/profiling.md).
+// hvdtpu_set_profiler: pre-Start() config — enabled toggles the subsystem
+// (default on; off compiles every entry point down to one branch), hz the
+// SIGPROF rate (<= 0 keeps the default 97; clamped to 1000), capacity the
+// sample-ring size (<= 0 keeps the default 16384), clock 0 = per-thread
+// CPU time (flamegraph contract), 1 = wall (blocked time sampled too),
+// folded_path where Shutdown writes prof.<rank>.folded (NULL/empty = skip;
+// non-empty also starts a whole-job window at Start — hvdrun --profile).
+int hvdtpu_set_profiler(void* core, int enabled, int hz,
+                        long long capacity, int clock_mode,
+                        const char* folded_path) {
+  if (clock_mode < 0 || clock_mode > 1) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->prof = enabled != 0;
+  cfg->prof_hz = hz;
+  cfg->prof_capacity = capacity;
+  cfg->prof_clock = clock_mode;
+  cfg->prof_path = folded_path != nullptr ? folded_path : "";
+  return 0;
+}
+
+// Runtime sampling-window control (the /profz endpoint and hvd.profile()
+// ride these). Start clears the ring and arms every registered thread's
+// timer; both are idempotent no-ops when the profiler is disabled.
+// Callable from any thread.
+int hvdtpu_profiler_start(void* core) {
+  static_cast<Core*>(core)->ProfilerStart();
+  return 0;
+}
+
+int hvdtpu_profiler_stop(void* core) {
+  static_cast<Core*>(core)->ProfilerStop();
+  return 0;
+}
+
+int hvdtpu_profiler_running(void* core) {
+  return static_cast<Core*>(core)->ProfilerRunning() ? 1 : 0;
+}
+
+// Folded-stacks JSON snapshot (horovod_tpu/profiler.py decodes it — the
+// /profz payload and hvd.profile()'s return). Same probe-then-copy
+// contract as hvdtpu_metrics_dump. Callable from any thread, live.
+long long hvdtpu_profiler_snapshot(void* core, char* buf, long long buflen) {
+  std::string img = static_cast<Core*>(core)->ProfilerSnapshot();
+  if (buf != nullptr && buflen > 0) {
+    long long n = std::min<long long>(buflen, img.size());
+    std::memcpy(buf, img.data(), static_cast<size_t>(n));
+    if (n < buflen) buf[n] = '\0';
+  }
+  return static_cast<long long>(img.size());
 }
 
 // Keyed-baseline snapshot as JSON (horovod_tpu/perfstats.py decodes it —

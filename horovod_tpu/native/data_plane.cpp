@@ -12,6 +12,7 @@
 #include <cstring>
 #include <thread>
 
+#include "profiler.h"
 #include "shm_transport.h"
 #include "socket_util.h"
 #include "timeline.h"
@@ -590,6 +591,17 @@ int DataPlane::shm_lane_count() const {
   return shm;
 }
 
+void DataPlane::ShmOccupancy(
+    std::vector<std::pair<int, int64_t>>* out) const {
+  out->clear();
+  for (size_t peer = 0; peer < transports_.size(); ++peer) {
+    const auto& t = transports_[peer];
+    if (t != nullptr && std::strcmp(t->kind(), "shm") == 0) {
+      out->emplace_back(static_cast<int>(peer), t->OccupancyBytes());
+    }
+  }
+}
+
 bool DataPlane::zerocopy_active() const {
   for (TcpTransport* t : tcp_lanes_) {
     if (t->zerocopy_enabled()) return true;
@@ -860,6 +872,10 @@ Status DataPlane::SendTo(int peer, const void* buf, int64_t bytes,
   if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
     return BlackholeWait(peer);
   }
+  // Sampling-profiler phase tag (profiler.h): samples landing inside this
+  // hop fold under WIRE — the same region the op_wire_us_ accumulator
+  // measures (wait slices re-tag themselves WAIT inside the transports).
+  ProfPhaseScope prof_phase(PerfPhase::WIRE);
   const int64_t t0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
   const int64_t w0 = rec_hops_ ? io_ctl_.WaitUs() : 0;
   if (bytes > 0 &&
@@ -880,6 +896,7 @@ Status DataPlane::RecvFrom(int peer, void* buf, int64_t bytes,
   if (blackholed_peer_ >= 0 && peer == blackholed_peer_) {
     return BlackholeWait(peer);
   }
+  ProfPhaseScope prof_phase(PerfPhase::WIRE);
   const int64_t t0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
   const int64_t w0 = rec_hops_ ? io_ctl_.WaitUs() : 0;
   if (bytes > 0 &&
@@ -903,6 +920,10 @@ Status DataPlane::Exchange(int send_peer, const void* send_buf,
                                 recv_peer == blackholed_peer_)) {
     return BlackholeWait(blackholed_peer_);
   }
+  // WIRE for the whole exchange; the segment callbacks (reduction) re-tag
+  // their slices REDUCE and the transports' wait slices re-tag WAIT, so a
+  // profiler sample always names the innermost active phase.
+  ProfPhaseScope prof_phase(PerfPhase::WIRE);
   const int64_t t0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
   const int64_t w0 = rec_hops_ ? io_ctl_.WaitUs() : 0;
   const int64_t hop_bytes = send_bytes + recv_bytes;
@@ -1095,17 +1116,23 @@ Status DataPlane::CompressedRingReduceScatter(
     const int64_t sw = WireBytes(c, sc);
     const int64_t rw = WireBytes(c, rc);
     const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
-    WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
-                 op_residual_ != nullptr ? op_residual_ + starts[send_c]
-                                         : nullptr,
-                 nullptr);
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
+                   op_residual_ != nullptr ? op_residual_ + starts[send_c]
+                                           : nullptr,
+                   nullptr);
+    }
     TraceHop("QUANTIZE", -1, -1, sc * 4, qt0, io_ctl_.WaitUs());
     AddOpBytes(sc * 4, sw);
     Status st = Exchange(right, send_wire.data(), sw, left, recv_wire.data(),
                          rw);
     if (!st.ok()) return st;
     const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
-    WireDecompressAdd(c, recv_wire.data(), rc, buf + starts[recv_c]);
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompressAdd(c, recv_wire.data(), rc, buf + starts[recv_c]);
+    }
     TraceHop("DEQUANTIZE", -1, -1, rc * 4, dt0, io_ctl_.WaitUs());
   }
   return Status::OK();
@@ -1133,10 +1160,13 @@ Status DataPlane::CompressedRingAllgather(float* buf,
   // and the final vectors agree bitwise.
   const int own_c = (gi + 1) % gs;
   const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
-  WireCompress(c, buf + starts[own_c], chunk_count(own_c), cur.data(),
-               op_residual_ != nullptr ? op_residual_ + starts[own_c]
-                                       : nullptr,
-               buf + starts[own_c]);
+  {
+    ProfPhaseScope prof_codec(PerfPhase::CODEC);
+    WireCompress(c, buf + starts[own_c], chunk_count(own_c), cur.data(),
+                 op_residual_ != nullptr ? op_residual_ + starts[own_c]
+                                         : nullptr,
+                 buf + starts[own_c]);
+  }
   TraceHop("QUANTIZE", -1, -1, chunk_count(own_c) * 4, qt0,
            io_ctl_.WaitUs());
   for (int s = 0; s < gs - 1; ++s) {
@@ -1148,8 +1178,11 @@ Status DataPlane::CompressedRingAllgather(float* buf,
     Status st = Exchange(right, cur.data(), sw, left, next.data(), rw);
     if (!st.ok()) return st;
     const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
-    WireDecompress(c, next.data(), chunk_count(recv_c),
-                   buf + starts[recv_c]);
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompress(c, next.data(), chunk_count(recv_c),
+                     buf + starts[recv_c]);
+    }
     TraceHop("DEQUANTIZE", -1, -1, chunk_count(recv_c) * 4, dt0,
              io_ctl_.WaitUs());
     cur.swap(next);
@@ -1190,14 +1223,20 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
       // Self-decode into `data`: both sides of the pair end up with
       // deQ(mine) + deQ(theirs) — bitwise identical by commutativity.
       const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
-      WireCompress(c, data, count, send_wire.data(), op_residual_, data);
+      {
+        ProfPhaseScope prof_codec(PerfPhase::CODEC);
+        WireCompress(c, data, count, send_wire.data(), op_residual_, data);
+      }
       TraceHop("QUANTIZE", -1, -1, raw_bytes, qt0, io_ctl_.WaitUs());
       AddOpBytes(raw_bytes, wb);
       Status st = Exchange(peer, send_wire.data(), wb, peer,
                            recv_wire.data(), wb);
       if (!st.ok()) return st;
       const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
-      WireDecompressAdd(c, recv_wire.data(), count, data);
+      {
+        ProfPhaseScope prof_codec(PerfPhase::CODEC);
+        WireDecompressAdd(c, recv_wire.data(), count, data);
+      }
       TraceHop("DEQUANTIZE", -1, -1, raw_bytes, dt0, io_ctl_.WaitUs());
     }
   }
@@ -1270,6 +1309,7 @@ Status DataPlane::RingReduceScatterPhase(uint8_t* buf,
           right, chunk_ptr(send_c), send_bytes, left, recv_tmp.get(),
           recv_bytes, seg,
           [&](const uint8_t* data, size_t off, size_t len) {
+            ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
             const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
             ReduceBuffer(dst + off, data, static_cast<int64_t>(len / elem),
                          dtype, op);
@@ -1371,6 +1411,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
   } else if (gi < r) {
     Status st = RecvFrom(group[gi + p], other.data(), bytes, "rd fold recv");
     if (!st.ok()) return st;
+    ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
     const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
     ReduceBuffer(data, other.data(), count, dtype, op);
     TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
@@ -1382,6 +1423,7 @@ Status DataPlane::RecursiveDoublingGroup(void* data, int64_t count,
       AddOpBytes(bytes, bytes);
       Status st = Exchange(peer, data, bytes, peer, other.data(), bytes);
       if (!st.ok()) return st;
+      ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
       const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       ReduceBuffer(data, other.data(), count, dtype, op);
       TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
@@ -1421,6 +1463,7 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
       Status st =
           RecvFrom(group[gi + d], other.data(), bytes, "tree reduce recv");
       if (!st.ok()) return st;
+      ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
       const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       ReduceBuffer(data, other.data(), count, dtype, op);
       TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());

@@ -190,6 +190,10 @@ class DataPlane {
     return hier_mode_ == HierMode::ON ||
            (hier_mode_ == HierMode::AUTO && hier_auto_);
   }
+  // Per-peer shm-ring occupancy (peer rank, buffered bytes) for the
+  // memory-occupancy telemetry gauges (docs/profiling.md). Background
+  // thread only, like the other lane walks.
+  void ShmOccupancy(std::vector<std::pair<int, int64_t>>* out) const;
   // Lane summary for the timeline / introspection: "tcp", "tcp-zc", "shm",
   // "shm+tcp", "shm+tcp-zc" ("local" before Connect / at size 1). Rebuilt
   // per call because the zero-copy tag is LIVE: an AUTO lane that detects

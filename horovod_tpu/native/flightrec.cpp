@@ -306,6 +306,12 @@ void InstallFlightSignalHandlers() {
   std::memset(&sa, 0, sizeof(sa));
   sa.sa_handler = FlightSignalHandler;
   sigemptyset(&sa.sa_mask);
+  // SIGPROF is masked for the dump's duration: the sampling profiler
+  // (profiler.h) may be firing at HVDTPU_PROF_HZ on this very thread, and
+  // a sampler interrupting the fatal dump's write loop would stretch the
+  // one chance at a post-mortem (pinned by the unit-test re-entrancy
+  // storm; docs/profiling.md "Signal coexistence").
+  sigaddset(&sa.sa_mask, SIGPROF);
   // No SA_RESETHAND: the handler restores the saved disposition itself so
   // it can chain an application handler instead of always going to default.
   for (size_t i = 0;

@@ -294,6 +294,24 @@ PerfStats::Anomaly PerfStats::RecordOp(int slot, const OpSample& s) {
   return a;
 }
 
+bool PerfStats::ShouldWarn(int slot, int64_t now_us, int64_t min_gap_us) {
+  if (slot < 0 || slot >= nslots_.load(std::memory_order_acquire)) {
+    return false;
+  }
+  PerfSlot* sl = &slots_[slot];
+  int64_t last = sl->last_warn_us.load(std::memory_order_relaxed);
+  // 0 = never warned: the first anomaly of a key always logs. The CAS
+  // claims the window — a concurrent loser sees the fresh stamp and stays
+  // quiet.
+  while (last == 0 || now_us - last >= min_gap_us) {
+    if (sl->last_warn_us.compare_exchange_weak(last, now_us,
+                                               std::memory_order_relaxed)) {
+      return true;
+    }
+  }
+  return false;
+}
+
 std::string PerfStats::SnapshotJson() const {
   std::string out = "{\"version\": 1, \"enabled\": ";
   out += enabled_ ? "true" : "false";

@@ -109,6 +109,12 @@ struct PerfSlot {
   std::atomic<int64_t> anomalies{0};
   std::atomic<int64_t> last_wall_us{0};
   std::atomic<int64_t> samples[kPerfSampleRing] = {};
+  // Sentry WARN throttle stamp, PER KEY (steady-clock us; 0 = never
+  // warned). A global 1/s throttle let one chatty slow key starve the
+  // first warning for a second, different key — the operator's "rank N
+  // just went codec-bound" signal. CAS-claimed so concurrent writers
+  // (the TSan fixture) warn at most once per window per key.
+  std::atomic<int64_t> last_warn_us{0};
 
   std::string key;  // immutable once the slot is published
 };
@@ -152,6 +158,14 @@ class PerfStats {
   // (its baseline mixes unrelated keys). Thread-safe (per-slot spinlock);
   // no allocation.
   Anomaly RecordOp(int slot, const OpSample& s);
+
+  // Per-key WARN throttle for the sentry's log line: true at most once per
+  // min_gap_us PER SLOT (each key gets its own window — a chatty slow key
+  // cannot starve a different key's first warning). The counter and flight
+  // ring record every anomaly regardless; only the LOG rides this. CAS on
+  // the slot's stamp, so it is thread-safe and claims exactly one winner.
+  bool ShouldWarn(int slot, int64_t now_us,
+                  int64_t min_gap_us = 1000000);
 
   // Keyed-baseline snapshot as JSON (the /perfz payload and the body of
   // perf_profile.<rank>.json). Readers touch atomics + immutable keys only

@@ -21,6 +21,8 @@
 #include <new>
 #include <thread>
 
+#include "profiler.h"
+
 namespace hvdtpu {
 
 namespace {
@@ -270,6 +272,21 @@ bool ShmTransport::ApplyNumaPolicy(ShmNumaMode mode) {
   return rc == 0;
 }
 
+int64_t ShmTransport::OccupancyBytes() const {
+  if (seg_ == nullptr) return 0;
+  int64_t total = 0;
+  for (int i = 0; i < 2; ++i) {
+    const ShmRing& r = seg_->rings[i];
+    const uint64_t head = r.head.load(std::memory_order_relaxed);
+    const uint64_t tail = r.tail.load(std::memory_order_relaxed);
+    // Free-running cursors: head >= tail modulo concurrent advance; a
+    // transiently inverted read (tail racing past a stale head) clamps to 0
+    // rather than wrapping to a huge unsigned spread.
+    if (head > tail) total += static_cast<int64_t>(head - tail);
+  }
+  return total;
+}
+
 void ShmTransport::BumpAndWake(std::atomic<uint32_t>* seq) {
   seq->fetch_add(1, std::memory_order_seq_cst);
   FutexWake(seq);
@@ -485,6 +502,9 @@ bool ShmTransport::DeadlineExpired(double last_progress) {
 }
 
 void ShmTransport::WaitOutboundSpace() {
+  // Sampling-profiler phase tag: spin or futex-park, this whole function is
+  // blocked-on-peer time (the WAIT bucket the perf attribution measures).
+  ProfPhaseScope prof_wait(PerfPhase::WAIT);
   ShmRing& r = seg_->rings[out_ring_];
   uint64_t head = r.head.load(std::memory_order_relaxed);
   for (int i = 0, spins = SpinIters(); i < spins; ++i) {
@@ -510,6 +530,7 @@ void ShmTransport::WaitOutboundSpace() {
 }
 
 void ShmTransport::WaitInboundData() {
+  ProfPhaseScope prof_wait(PerfPhase::WAIT);
   ShmRing& r = seg_->rings[1 - out_ring_];
   // Wait for the head to move past its CURRENT position (not merely past
   // the tail): the in-place view consumer can be blocked on the back half

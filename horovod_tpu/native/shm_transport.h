@@ -142,6 +142,10 @@ class ShmTransport : public Transport {
   void Unlink();
 
   size_t ring_bytes() const { return ring_bytes_; }
+  // Bytes sitting in the segment's two rings right now (producer head minus
+  // consumer tail, both directions) — the per-lane occupancy gauge. Any
+  // thread (the cursors are cross-process atomics already).
+  int64_t OccupancyBytes() const override;
   // Futex wake syscalls this side has issued (doorbell-batching tests).
   int64_t futex_wakes() const { return futex_wakes_; }
   // True once THIS lane's liveness probe saw the peer die (EOF) or its

@@ -15,6 +15,8 @@
 #include <mutex>
 #include <thread>
 
+#include "profiler.h"
+
 namespace hvdtpu {
 
 int TcpListen(int port, int backlog, int* out_port) {
@@ -111,7 +113,14 @@ int CtlWait(int fd, short events, IoControl* ctl, double last_progress) {
   }
   pollfd pfd{fd, events, 0};
   const double wait_t0 = MonoSeconds();
-  int rc = poll(&pfd, 1, IoSliceMs(ctl));
+  int rc;
+  {
+    // Sampling-profiler phase tag (profiler.h): a sample landing inside
+    // this poll is blocked-on-peer time, the same split AddWaitUs feeds
+    // the perf-attribution WAIT bucket.
+    ProfPhaseScope prof_wait(PerfPhase::WAIT);
+    rc = poll(&pfd, 1, IoSliceMs(ctl));
+  }
   // Peer-wait accounting for the tracing layer: every microsecond inside
   // this poll is time the transfer stalled on the peer, not the wire.
   ctl->AddWaitUs(static_cast<int64_t>((MonoSeconds() - wait_t0) * 1e6));

@@ -166,6 +166,12 @@ class Transport {
   // sliced reads observe the shared IoControl; the shm lane overrides to
   // flip its cross-process abort flag and wake futex waiters.
   virtual void Abort() {}
+
+  // Bytes currently buffered inside the lane's own storage (the shm rings'
+  // head-tail spread; 0 for lanes that buffer in the kernel) — the memory-
+  // occupancy telemetry's per-lane gauge (docs/profiling.md). Any thread;
+  // weakly consistent like the metrics it feeds.
+  virtual int64_t OccupancyBytes() const { return 0; }
 };
 
 // The PR-1 socket path behind the interface. Does NOT own the fd (the

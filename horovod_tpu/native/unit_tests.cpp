@@ -40,6 +40,7 @@
 #include "message.h"
 #include "metrics.h"
 #include "perfstats.h"
+#include "profiler.h"
 #include "shm_transport.h"
 #include "socket_util.h"
 #include "timeline.h"
@@ -2413,6 +2414,232 @@ void TestDataPlanePerfPhaseAccumulation() {
   b.Shutdown();
 }
 
+void TestPerfStatsPerKeyWarnThrottle() {
+  // ISSUE 14 satellite: the sentry's WARN throttle is per KEY, not a global
+  // 1/s — a chatty slow key must not starve a second, different slow key's
+  // first warning (two-key regression pin).
+  PerfStats ps;
+  ps.Configure(true, 50.0, 5);
+  const int a = ps.KeySlot("chatty|ring|shm|0|none|ALLREDUCE");
+  const int b = ps.KeySlot("quiet|ring|shm|0|none|ALLREDUCE");
+  const int64_t t0 = 1000000;
+  CHECK_TRUE(ps.ShouldWarn(a, t0));        // first anomaly of A logs
+  CHECK_TRUE(!ps.ShouldWarn(a, t0 + 10));  // A throttled inside its window
+  // The regression: B fires 10 us after A — under the old global throttle
+  // this was silently swallowed for a second.
+  CHECK_TRUE(ps.ShouldWarn(b, t0 + 10));
+  CHECK_TRUE(!ps.ShouldWarn(b, t0 + 20));
+  // Windows expire independently.
+  CHECK_TRUE(ps.ShouldWarn(a, t0 + 1000000));
+  CHECK_TRUE(!ps.ShouldWarn(b, t0 + 500000));
+  CHECK_TRUE(ps.ShouldWarn(b, t0 + 10 + 1000000));
+  // Out-of-range slots never warn (the disabled-stats slot-0 path is a
+  // real slot and may warn; invalid ids must not touch memory).
+  CHECK_TRUE(!ps.ShouldWarn(-1, t0));
+  CHECK_TRUE(!ps.ShouldWarn(9999, t0));
+  // Concurrent anomalies on ONE key inside one window: exactly one winner.
+  const int c = ps.KeySlot("concurrent|ring|shm|0|none|ALLREDUCE");
+  std::atomic<int> winners{0};
+  std::vector<std::thread> threads;
+  for (int i = 0; i < 4; ++i) {
+    threads.emplace_back([&] {
+      if (ps.ShouldWarn(c, 42000000)) winners.fetch_add(1);
+    });
+  }
+  for (auto& t : threads) t.join();
+  CHECK_TRUE(winners.load() == 1);
+}
+
+void TestProfilerPhaseScopePublishesAndRestores() {
+  ProfThreadState* t = ProfThread();
+  CHECK_TRUE(t->phase.load() == -1);
+  CHECK_TRUE(t->op_id.load() == 0);
+  {
+    ProfOpScope op(7);
+    CHECK_TRUE(t->op_id.load() == 7);
+    CHECK_TRUE(t->phase.load() == static_cast<int32_t>(PerfPhase::WALL));
+    {
+      ProfPhaseScope wire(PerfPhase::WIRE);
+      CHECK_TRUE(t->phase.load() == static_cast<int32_t>(PerfPhase::WIRE));
+      {
+        ProfPhaseScope wait(PerfPhase::WAIT);
+        CHECK_TRUE(t->phase.load() ==
+                   static_cast<int32_t>(PerfPhase::WAIT));
+      }
+      // Nested scope restored the outer phase, not the base.
+      CHECK_TRUE(t->phase.load() == static_cast<int32_t>(PerfPhase::WIRE));
+    }
+    CHECK_TRUE(t->phase.load() == static_cast<int32_t>(PerfPhase::WALL));
+  }
+  CHECK_TRUE(t->phase.load() == -1);
+  CHECK_TRUE(t->op_id.load() == 0);
+}
+
+void TestProfilerDisabledIsNoop() {
+  SamplingProfiler p;
+  p.Configure(false, 97, 1024, ProfClock::CPU, 0);
+  p.RegisterThread();  // must not create a timer
+  p.Start();
+  CHECK_TRUE(!p.running());
+  CHECK_TRUE(p.registered_threads() == 0);
+  p.Stop();
+  CHECK_TRUE(p.FoldedJson().find("\"enabled\": false") != std::string::npos);
+  CHECK_TRUE(p.FoldedText().empty());
+  CHECK_TRUE(p.InternOp("anything") == 0);
+}
+
+void TestProfilerSamplesTaggedByPhaseAndOp() {
+  // A worker thread burns CPU inside ProfOpScope + REDUCE while a 250 Hz
+  // CPU-clock window runs: samples must land, tagged with the published
+  // phase and op, and fold into both the JSON and flamegraph outputs.
+  SamplingProfiler p;
+  p.Configure(true, 250, 4096, ProfClock::CPU, 3);
+  const int op = p.InternOp("grad/layer0");
+  CHECK_TRUE(op >= 1);
+  CHECK_TRUE(p.InternOp("grad/layer0") == op);  // stable
+  std::atomic<bool> stop{false};
+  std::atomic<bool> ready{false};
+  std::thread worker([&] {
+    p.RegisterThread();
+    ready.store(true);
+    ProfOpScope op_scope(op);
+    ProfPhaseScope reduce(PerfPhase::REDUCE);
+    volatile double sink = 0;
+    while (!stop.load(std::memory_order_relaxed)) {
+      for (int i = 0; i < 1000; ++i) sink += i * 0.5;
+    }
+    p.UnregisterThread();
+  });
+  while (!ready.load()) std::this_thread::yield();
+  p.Start();
+  CHECK_TRUE(p.running());
+  // CPU-clock timers need the worker to BURN ~n/hz seconds of CPU; a
+  // loaded CI box may schedule it slowly, so wait on samples, not time.
+  for (int i = 0; i < 400 && p.sample_count() < 5; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  p.Stop();
+  CHECK_TRUE(!p.running());
+  stop.store(true);
+  worker.join();
+  CHECK_TRUE(p.sample_count() >= 5);
+  const std::string json = p.FoldedJson();
+  CHECK_TRUE(json.find("\"enabled\": true") != std::string::npos);
+  CHECK_TRUE(json.find("\"rank\": 3") != std::string::npos);
+  CHECK_TRUE(json.find("\"clock\": \"cpu\"") != std::string::npos);
+  CHECK_TRUE(json.find("\"reduce\"") != std::string::npos);
+  CHECK_TRUE(json.find("grad/layer0") != std::string::npos);
+  const std::string folded = p.FoldedText();
+  CHECK_TRUE(folded.find("reduce;grad/layer0") != std::string::npos);
+  // Every folded line is "stack count" with a positive count.
+  size_t pos = 0;
+  int lines = 0;
+  while (pos < folded.size()) {
+    const size_t eol = folded.find('\n', pos);
+    CHECK_TRUE(eol != std::string::npos);
+    const std::string line = folded.substr(pos, eol - pos);
+    const size_t sp = line.rfind(' ');
+    CHECK_TRUE(sp != std::string::npos && sp + 1 < line.size());
+    CHECK_TRUE(std::atoll(line.c_str() + sp + 1) > 0);
+    pos = eol + 1;
+    ++lines;
+  }
+  CHECK_TRUE(lines > 0);
+  // A new window clears the previous ring.
+  p.Start();
+  p.Stop();
+  CHECK_TRUE(p.sample_count() == 0);
+}
+
+void TestProfilerWallClockSamplesBlockedThread() {
+  // Wall-clock mode: a thread PARKED in a WAIT scope still accumulates
+  // samples (the mode the chaos-delay acceptance test rides — blocked
+  // time is exactly what it must see).
+  SamplingProfiler p;
+  p.Configure(true, 250, 4096, ProfClock::WALL, 0);
+  std::atomic<bool> stop{false};
+  std::atomic<bool> ready{false};
+  std::thread worker([&] {
+    p.RegisterThread();
+    ready.store(true);
+    ProfPhaseScope wait(PerfPhase::WAIT);
+    while (!stop.load(std::memory_order_relaxed)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    p.UnregisterThread();
+  });
+  while (!ready.load()) std::this_thread::yield();
+  p.Start();
+  for (int i = 0; i < 400 && p.sample_count() < 5; ++i) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+  p.Stop();
+  stop.store(true);
+  worker.join();
+  CHECK_TRUE(p.sample_count() >= 5);
+  CHECK_TRUE(p.FoldedJson().find("\"wait\"") != std::string::npos);
+}
+
+void TestProfilerSigprofStormDuringFlightDump() {
+  // ISSUE 14 satellite (signal coexistence): a SIGPROF storm hammering the
+  // thread that is writing a flight-recorder fatal dump must corrupt
+  // nothing — the dump stays decodable and the profiler keeps sampling.
+  SamplingProfiler p;
+  p.Configure(true, 500, 4096, ProfClock::WALL, 0);
+  p.RegisterThread();
+  p.Start();
+
+  FlightRecorder rec;
+  char dir[] = "/tmp/hvdtpu_prof_storm_XXXXXX";
+  CHECK_TRUE(mkdtemp(dir) != nullptr);
+  rec.Configure(512, dir, 1, 2);
+  const int name = rec.InternName("storm/op");
+  for (int i = 0; i < 600; ++i) {
+    rec.Record(FlightEvent::SENDRECV, name, 1024, 0, 0, i * 10, i * 10 + 5,
+               2, 2);
+  }
+  // Storm: a sibling thread fires SIGPROF at this thread far faster than
+  // the timer would, while the async-signal-safe dump runs.
+  std::atomic<bool> stop{false};
+  pthread_t victim = pthread_self();
+  std::thread stormer([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      pthread_kill(victim, SIGPROF);
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+    }
+  });
+  for (int i = 0; i < 20; ++i) {
+    rec.SignalDump(SIGTERM);  // SIGTERM re-arms the latch: every pass writes
+  }
+  const std::string img = rec.Snapshot(DumpReason::ON_DEMAND, -1);
+  stop.store(true);
+  stormer.join();
+  // Dump still valid: magic + the records survive.
+  CHECK_TRUE(img.size() > kFlightHeaderBytes);
+  CHECK_TRUE(std::memcmp(img.data(), kFlightMagic, sizeof(kFlightMagic)) ==
+             0);
+  const std::string path =
+      std::string(dir) + "/flightrec.1.bin";
+  FILE* f = std::fopen(path.c_str(), "rb");
+  CHECK_TRUE(f != nullptr);
+  if (f != nullptr) {
+    char magic[8] = {0};
+    CHECK_TRUE(std::fread(magic, 1, 8, f) == 8);
+    CHECK_TRUE(std::memcmp(magic, kFlightMagic, 8) == 0);
+    std::fclose(f);
+  }
+  p.Stop();
+  p.UnregisterThread();
+  unlink(path.c_str());
+  rmdir(dir);
+  // The fatal-signal handlers mask SIGPROF while they run (the other half
+  // of coexistence): pin the installed disposition's mask.
+  InstallFlightSignalHandlers();
+  struct sigaction current;
+  CHECK_TRUE(sigaction(SIGSEGV, nullptr, &current) == 0);
+  CHECK_TRUE(sigismember(&current.sa_mask, SIGPROF) == 1);
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -2482,6 +2709,12 @@ int main() {
   TestPerfStatsConcurrentWritersAndReader();
   TestPerfStatsSnapshotJsonShape();
   TestDataPlanePerfPhaseAccumulation();
+  TestPerfStatsPerKeyWarnThrottle();
+  TestProfilerPhaseScopePublishesAndRestores();
+  TestProfilerDisabledIsNoop();
+  TestProfilerSamplesTaggedByPhaseAndOp();
+  TestProfilerWallClockSamplesBlockedThread();
+  TestProfilerSigprofStormDuringFlightDump();
   if (failures == 0) {
     std::printf("native unit tests: ALL OK\n");
     return 0;

@@ -143,6 +143,32 @@ def sample_value(parsed: dict, name: str, suffix: str = "",
     return None
 
 
+# The per-worker observability surface as ONE path registry (ISSUE 14
+# satellite): every endpoint rides the same HMAC gate and the same
+# keep-alive error handling, and adding a surface is one row here plus one
+# source callable — not a copy of the handler boilerplate. Rows:
+# path -> (content type, server attribute holding the source callable).
+# Every source callable takes the raw query string (most ignore it; /profz
+# reads ?start/?stop) — the MetricsServer ctor adapts query-less sources,
+# so the handler needs no per-path cases. A registered path whose source is
+# None (subsystem absent) answers 404, exactly like an unknown path — the
+# parameterized auth suite in tests/test_security.py walks this table.
+ENDPOINT_PATHS = {
+    "/metrics": ("text/plain; version=0.0.4; charset=utf-8",
+                 "metrics_dump_fn"),
+    "/healthz": ("application/json", "metrics_health_fn"),
+    # Flight-recorder live view (docs/fault-tolerance.md): the in-flight
+    # op + last-N phase events, decoded from an in-memory ring snapshot.
+    "/debugz": ("application/json", "metrics_debugz_fn"),
+    # Live perf attribution (docs/observability.md): the streaming per-key
+    # baselines + anomaly counts as JSON.
+    "/perfz": ("application/json", "metrics_perfz_fn"),
+    # Sampling profiler (docs/profiling.md): folded-stacks JSON;
+    # ?start / ?stop open and close the sampling window.
+    "/profz": ("application/json", "metrics_profz_fn"),
+}
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence
         pass
@@ -153,6 +179,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             return True
         import hmac as _hmac
         proof = self.headers.get(_AUTH_HEADER, "")
+        # The proof binds the FULL request target (query string included),
+        # so an authed /profz scrape cannot be replayed as /profz?stop.
         expect = _sign(secret, self.command, self.path, b"")
         if _hmac.compare_digest(proof, expect):
             return True
@@ -163,98 +191,62 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         if not self._authorized():
             return
-        if self.path == "/metrics":
-            try:
-                body = self.server.metrics_dump_fn().encode()  # type: ignore
-            except Exception as exc:  # keep the endpoint alive
-                self.send_response(500)
-                self.end_headers()
-                self.wfile.write(str(exc).encode())
-                return
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        elif self.path == "/perfz":
-            # Live perf attribution (docs/observability.md): the streaming
-            # per-key baselines + anomaly counts as JSON, straight from the
-            # native snapshot. Secret-gated like /metrics.
-            fn = getattr(self.server, "metrics_perfz_fn", None)
-            if fn is None:
-                self.send_response(404)
-                self.end_headers()
-                return
-            try:
-                body = fn().encode()
-            except Exception as exc:  # keep the endpoint alive
-                self.send_response(500)
-                self.end_headers()
-                self.wfile.write(str(exc).encode())
-                return
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        elif self.path == "/debugz":
-            # Flight-recorder live view (docs/fault-tolerance.md): the
-            # in-flight op + last-N phase events, decoded from an in-memory
-            # ring snapshot. Secret-gated like /metrics.
-            fn = getattr(self.server, "metrics_debugz_fn", None)
-            if fn is None:
-                self.send_response(404)
-                self.end_headers()
-                return
-            try:
-                body = fn().encode()
-            except Exception as exc:  # keep the endpoint alive
-                self.send_response(500)
-                self.end_headers()
-                self.wfile.write(str(exc).encode())
-                return
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        elif self.path == "/healthz":
-            info = getattr(self.server, "metrics_health", None) or {}
-            body = json.dumps(dict(info, status="ok")).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        else:
+        path, _, query = self.path.partition("?")
+        row = ENDPOINT_PATHS.get(path)
+        fn = getattr(self.server, row[1], None) if row else None
+        if fn is None:  # unknown path, or a registered one with no source
             self.send_response(404)
             self.end_headers()
+            return
+        try:
+            body = fn(query).encode()
+        except Exception as exc:  # keep the endpoint alive
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(str(exc).encode())
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", row[0])
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
 
 class MetricsServer:
-    """Threaded HTTP server for one worker's ``/metrics`` + ``/healthz``.
+    """Threaded HTTP server for one worker's observability endpoints
+    (``ENDPOINT_PATHS``: /metrics, /healthz, /debugz, /perfz, /profz).
 
     ``dump_fn()`` returns the exposition text (the native registry dump);
     ``health`` is a static dict merged into the ``/healthz`` JSON (rank,
-    size, ...). With ``secret`` set, requests must carry the same HMAC
-    proof header the KV store uses — unauthenticated scrapes get 403.
+    size, ...). ``profz_fn(query)`` receives the raw query string so
+    ``?start``/``?stop`` drive the sampling window. With ``secret`` set,
+    requests must carry the same HMAC proof header the KV store uses —
+    unauthenticated scrapes get 403 on every path.
     """
 
     def __init__(self, dump_fn: Callable[[], str], port: int = 0,
                  secret: Optional[str] = None,
                  health: Optional[dict] = None,
                  debugz_fn: Optional[Callable[[], str]] = None,
-                 perfz_fn: Optional[Callable[[], str]] = None):
+                 perfz_fn: Optional[Callable[[], str]] = None,
+                 profz_fn: Optional[Callable[[str], str]] = None):
         self._server = ThreadingHTTPServer(("0.0.0.0", port),
                                            _MetricsHandler)
-        self._server.metrics_dump_fn = dump_fn  # type: ignore[attr-defined]
-        self._server.metrics_secret = secret  # type: ignore[attr-defined]
-        self._server.metrics_health = health  # type: ignore[attr-defined]
-        # /debugz JSON source (flight-recorder live view); None = 404.
-        self._server.metrics_debugz_fn = debugz_fn  # type: ignore[attr-defined]
-        # /perfz JSON source (perf-attribution baselines); None = 404.
-        self._server.metrics_perfz_fn = perfz_fn  # type: ignore[attr-defined]
+
+        def ignore_query(fn):
+            # Adapt a query-less source to the registry's uniform
+            # fn(query) -> str contract (None stays None -> 404).
+            return None if fn is None else (lambda query, _f=fn: _f())
+
+        srv = self._server
+        srv.metrics_secret = secret  # type: ignore[attr-defined]
+        srv.metrics_dump_fn = ignore_query(dump_fn)  # type: ignore[attr-defined]
+        srv.metrics_health_fn = (  # type: ignore[attr-defined]
+            lambda query: json.dumps(dict(health or {}, status="ok")))
+        # Subsystem sources; None = that path 404s (ENDPOINT_PATHS).
+        srv.metrics_debugz_fn = ignore_query(debugz_fn)  # type: ignore[attr-defined]
+        srv.metrics_perfz_fn = ignore_query(perfz_fn)  # type: ignore[attr-defined]
+        srv.metrics_profz_fn = profz_fn  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -149,6 +149,23 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                         "DIR/perf_profile.<rank>.json at shutdown; the "
                         "driver merges them into DIR/perf_profile.json — "
                         "compare two runs with scripts/perf_diff.py")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="in-process sampling profiler (HVDTPU_PROF_DIR; "
+                        "docs/profiling.md): run a whole-job sampling "
+                        "window on every rank; each writes "
+                        "DIR/prof.<rank>.folded at shutdown and the driver "
+                        "merges them into DIR/profile_merged.folded + "
+                        "DIR/profile.speedscope.json and prints the "
+                        "per-phase attribution table "
+                        "(scripts/prof_report.py re-runs the analysis)")
+    p.add_argument("--prof-hz", type=int, default=None,
+                   help="profiler sampling rate per thread in Hz "
+                        "(HVDTPU_PROF_HZ; default 97)")
+    p.add_argument("--prof-clock", default=None, choices=["cpu", "wall"],
+                   help="profiler clock (HVDTPU_PROF_CLOCK): 'cpu' samples "
+                        "only burning threads (flamegraph contract), "
+                        "'wall' samples blocked time too (matches the "
+                        "perf-attribution wall buckets)")
     p.add_argument("--perf-slowdown-pct", type=float, default=None,
                    help="slowdown-sentry threshold in percent over each "
                         "op's rolling baseline (HVDTPU_PERF_SLOWDOWN_PCT; "
@@ -412,6 +429,23 @@ def _apply_tuning_env(env: dict, args) -> dict:
         _prepare_artifact_dir(args.perf_profile, "perf_profile.*.json",
                               "perf_profile.json")
         env[ev.HVDTPU_PERF_PROFILE_DIR] = args.perf_profile
+    if args.profile:
+        # Whole-job sampling window (docs/profiling.md): same per-run
+        # hygiene — stale prof.<rank>.folded files would silently merge a
+        # previous run into this one's flamegraph (and a stale speedscope
+        # doc would pass for this run's profile if the merge never runs).
+        args.profile = os.path.abspath(args.profile)
+        _prepare_artifact_dir(args.profile, "prof.*.folded",
+                              "profile_merged.folded",
+                              "profile.speedscope.json")
+        env[ev.HVDTPU_PROF_DIR] = args.profile
+    if args.prof_hz is not None:
+        if not 1 <= args.prof_hz <= ev.MAX_PROF_HZ:
+            raise SystemExit(
+                f"hvdrun: --prof-hz must be 1..{ev.MAX_PROF_HZ}")
+        env[ev.HVDTPU_PROF_HZ] = str(args.prof_hz)
+    if args.prof_clock is not None:
+        env[ev.HVDTPU_PROF_CLOCK] = args.prof_clock
     if getattr(args, "_chaos_spec", None):
         env[ev.HVDTPU_CHAOS] = args._chaos_spec
         if getattr(args, "_chaos_marker", None):
@@ -444,14 +478,14 @@ def _apply_tuning_env(env: dict, args) -> dict:
 
 
 def _prepare_artifact_dir(path: str, stale_glob: str,
-                          merged_name: str) -> None:
+                          *merged_names: str) -> None:
     """Create a per-run artifact directory (trace / post-mortem dumps) and
     clear this launcher's own naming pattern from a previous run — stale
     per-rank files would silently merge two unrelated runs."""
     import glob
     os.makedirs(path, exist_ok=True)
     stale = glob.glob(os.path.join(path, stale_glob))
-    stale.append(os.path.join(path, merged_name))
+    stale.extend(os.path.join(path, name) for name in merged_names)
     for old in stale:
         try:
             os.unlink(old)
@@ -565,6 +599,8 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
         _postmortem_report(args.postmortem)
     if args.perf_profile:
         _merge_perf_profiles(args.perf_profile)
+    if args.profile:
+        _merge_prof_dir(args.profile)
     return rc
 
 
@@ -717,6 +753,8 @@ def run_launcher(args: argparse.Namespace) -> int:
         _merge_trace_dir(args.trace)
     if args.perf_profile:
         _merge_perf_profiles(args.perf_profile)
+    if args.profile:
+        _merge_prof_dir(args.profile)
     if args.postmortem and rc != 0:
         # The launcher knows which ranks ran on THIS host — their dumps are
         # the only ones expected locally; remote ranks' missing dumps read
@@ -785,6 +823,39 @@ def _merge_perf_profiles(profile_dir: str) -> None:
               "scripts/perf_diff.py OLD NEW)", file=sys.stderr)
     except Exception as exc:  # observability must never fail the job
         print(f"hvdrun: perf-profile: merge failed: {exc}", file=sys.stderr)
+
+
+def _merge_prof_dir(prof_dir: str) -> None:
+    """End-of-job profile collection (hvdrun --profile; docs/profiling.md):
+    merge the per-rank ``prof.<rank>.folded`` files into one rank-prefixed
+    ``profile_merged.folded`` + a speedscope document, and print the
+    per-phase attribution table. Best-effort like the trace merge — remote
+    workers' profiles live on their own hosts — and never fails the job."""
+    try:
+        import json
+
+        from ..profiler import (format_report, load_folded_dir, merge_ranks,
+                                to_speedscope)
+        per_rank = load_folded_dir(prof_dir)
+        if not per_rank:
+            print(f"hvdrun: profile: no prof.<rank>.folded in {prof_dir} "
+                  "(remote workers keep theirs on their own hosts; copy "
+                  "them here and run scripts/prof_report.py)",
+                  file=sys.stderr)
+            return
+        merged_path = os.path.join(prof_dir, "profile_merged.folded")
+        with open(merged_path, "w") as f:
+            f.write("\n".join(merge_ranks(per_rank)) + "\n")
+        speed_path = os.path.join(prof_dir, "profile.speedscope.json")
+        with open(speed_path, "w") as f:
+            json.dump(to_speedscope(per_rank), f)
+        print(format_report(per_rank), file=sys.stderr)
+        print(f"hvdrun: profile: merged {len(per_rank)} rank profile(s) -> "
+              f"{merged_path} (flamegraph.pl-ready) and {speed_path} "
+              "(https://www.speedscope.app; scripts/prof_report.py re-runs "
+              "the analysis)", file=sys.stderr)
+    except Exception as exc:  # observability must never fail the job
+        print(f"hvdrun: profile: merge failed: {exc}", file=sys.stderr)
 
 
 def _postmortem_report(dump_dir: str, local_ranks=None) -> None:

@@ -443,12 +443,30 @@ def init(comm: Optional[Sequence[int]] = None,
                     return snap.decode() if snap else \
                         '{"version": 1, "enabled": false, "keys": []}'
 
+                def _profz(query, core=st.core):
+                    # Sampling profiler next to /metrics (docs/profiling.md):
+                    # ?start / ?stop drive the window, a plain GET returns
+                    # the folded-stacks JSON.
+                    if not hasattr(core, "profiler_snapshot"):
+                        return ('{"version": 1, "enabled": false, '
+                                '"stacks": []}')
+                    if query == "start":
+                        core.profiler_start()
+                        return '{"profiler": "started"}'
+                    if query == "stop":
+                        core.profiler_stop()
+                        return '{"profiler": "stopped"}'
+                    snap = core.profiler_snapshot()
+                    return snap.decode() if snap else \
+                        '{"version": 1, "enabled": false, "stacks": []}'
+
                 try:
                     st.metrics_server = MetricsServer(
                         dump_fn=st.core.metrics_dump, port=port,
                         secret=ev.get_str(ev.HVDTPU_SECRET) or None,
                         health={"rank": st.rank, "size": st.size},
-                        debugz_fn=_debugz, perfz_fn=_perfz)
+                        debugz_fn=_debugz, perfz_fn=_perfz,
+                        profz_fn=_profz)
                 except OSError as exc:
                     # The core already joined the world — tear it down
                     # before failing or it would linger as a zombie rank
@@ -722,3 +740,70 @@ def stop_trace() -> None:
         st.core.stop_trace()
     else:
         stop_timeline()
+
+
+def prof_start() -> None:
+    """Open a sampling-profiler window (docs/profiling.md): the native
+    core's SIGPROF timers start firing at ``HVDTPU_PROF_HZ`` and every
+    sample is tagged with the current collective phase and op. No-op
+    outside process mode or with ``HVDTPU_PROF=0``."""
+    st = _require_init()
+    if st.core is not None and hasattr(st.core, "profiler_start"):
+        st.core.profiler_start()
+
+
+def prof_stop() -> None:
+    """Close the sampling window; the ring keeps the window's samples for
+    :func:`prof_snapshot`."""
+    st = _require_init()
+    if st.core is not None and hasattr(st.core, "profiler_stop"):
+        st.core.profiler_stop()
+
+
+def prof_snapshot(parsed: bool = True):
+    """Folded-stacks snapshot of the current/last sampling window — the
+    same JSON the worker's ``/profz`` endpoint serves: aggregated
+    ``{phase, op, frames} -> count``, symbolized at snapshot time.
+    ``parsed=False`` returns flamegraph.pl-compatible folded lines instead
+    (:func:`horovod_tpu.profiler.to_folded_text`). ``{"profiler":
+    "disabled"}`` outside process mode or without the native core."""
+    from .profiler import parse_snapshot, to_folded_text
+    st = _require_init()
+    if st.core is None or not hasattr(st.core, "profiler_snapshot"):
+        return {"profiler": "disabled"}
+    snap = st.core.profiler_snapshot()
+    if not snap:
+        return {"profiler": "disabled"}
+    doc = parse_snapshot(snap)
+    return doc if parsed else to_folded_text(doc)
+
+
+class profile:
+    """Context manager running a sampling window over its body::
+
+        with hvd.profile() as prof:
+            train_some_steps()
+        print(hvd.profiler.format_report(prof.result))
+
+    On exit the window is stopped and ``prof.result`` holds the parsed
+    folded-stacks document (``{"profiler": "disabled"}`` when the native
+    core is absent). ``path`` writes flamegraph.pl-compatible folded lines
+    there too — feed them to ``scripts/prof_report.py`` or flamegraph.pl
+    directly."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self.result: Optional[dict] = None
+
+    def __enter__(self) -> "profile":
+        prof_start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        prof_stop()
+        self.result = prof_snapshot()
+        if self._path and isinstance(self.result, dict) and \
+                "stacks" in self.result:
+            from .profiler import to_folded_text
+            with open(self._path, "w") as f:
+                f.write(to_folded_text(self.result))

@@ -169,6 +169,29 @@ HVDTPU_PERF_PROFILE_DIR = "HVDTPU_PERF_PROFILE_DIR"
 DEFAULT_PERF_SLOWDOWN_PCT = 50.0
 DEFAULT_PERF_MIN_SAMPLES = 20
 
+# In-process sampling profiler (native/profiler.{h,cpp} +
+# horovod_tpu/profiler.py; docs/profiling.md). PROF: "1" (default) keeps
+# the subsystem armed — per-thread SIGPROF timers exist but fire only
+# while a sampling window runs (/profz, hvd.profile(), hvdrun --profile);
+# "0" removes even that. PROF_HZ: sampling rate per thread (default 97 —
+# prime, so the sampler cannot phase-lock with millisecond-periodic
+# loops). PROF_CLOCK: "cpu" samples only while the thread burns cycles
+# (the flamegraph contract); "wall" samples blocked time too, matching
+# the perf-attribution wall buckets. PROF_DIR: directory where each rank
+# writes prof.<rank>.folded at shutdown AND the switch that runs the
+# window for the whole job (`hvdrun --profile DIR` sets it and merges at
+# job end via scripts/prof_report.py).
+HVDTPU_PROF = "HVDTPU_PROF"
+HVDTPU_PROF_HZ = "HVDTPU_PROF_HZ"
+HVDTPU_PROF_CLOCK = "HVDTPU_PROF_CLOCK"
+HVDTPU_PROF_DIR = "HVDTPU_PROF_DIR"
+
+DEFAULT_PROF_HZ = 97
+MAX_PROF_HZ = 1000
+# hvdtpu::ProfClock (native/profiler.h; scripts/check_invariants.py
+# ENUM-MIRROR).
+PROF_CLOCK_MODES = {"cpu": 0, "wall": 1}
+
 # Autotune (reference: HOROVOD_AUTOTUNE, HOROVOD_AUTOTUNE_LOG,
 # horovod/common/operations.cc:474-532)
 HVDTPU_AUTOTUNE = "HVDTPU_AUTOTUNE"

@@ -45,7 +45,7 @@ NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
 # unpaired sweeps, ±10% drift windows apart, on this box).
 AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
             "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace",
-            "flightrec", "perfstats")
+            "flightrec", "perfstats", "prof")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -134,6 +134,15 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_longlong, ctypes.c_char_p]
     except AttributeError:
         pass  # pre-perfstats build
+    try:
+        lib.hvdtpu_set_profiler.restype = ctypes.c_int
+        lib.hvdtpu_set_profiler.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_char_p]
+        lib.hvdtpu_profiler_start.restype = ctypes.c_int
+        lib.hvdtpu_profiler_start.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass  # pre-profiler build
     return lib
 
 
@@ -238,6 +247,20 @@ def run_worker(args) -> int:
             print("SKIP perfstats config: library has no perf attribution",
                   file=sys.stderr)
             return 0
+    if args.prof != "default":
+        # Same tri-state contract as --flightrec/--perfstats: "default"
+        # never calls the API (keeps --ab lib=old:new runnable against
+        # pre-profiler .so builds); on = a whole-run sampling window at
+        # the production default rate (97 Hz CPU clock, no folded file);
+        # off = subsystem fully disabled. `--ab prof=off:on` is the
+        # profiler's observability-budget gate (docs/benchmarks.md).
+        if hasattr(lib, "hvdtpu_set_profiler"):
+            lib.hvdtpu_set_profiler(core, 1 if args.prof == "on" else 0,
+                                    0, 0, 0, b"")
+        else:
+            print("SKIP prof config: library has no sampling profiler",
+                  file=sys.stderr)
+            return 0
     if hasattr(lib, "hvdtpu_set_transport_ext"):
         lib.hvdtpu_set_transport_ext(core, ZC_MODES[args.tcp_zerocopy],
                                      NUMA_MODES[args.shm_numa],
@@ -253,6 +276,9 @@ def run_worker(args) -> int:
     if lib.hvdtpu_start(core, err, len(err)) != 0:
         print(f"start failed: {err.value.decode()}", file=sys.stderr)
         return 1
+    if args.prof == "on":
+        # Window opened after Start so the background loop's timer exists.
+        lib.hvdtpu_profiler_start(core)
 
     def allreduce(name: bytes, buf, count: int, out) -> None:
         shape = (ctypes.c_longlong * 1)(count)
@@ -346,7 +372,8 @@ def run_config(args, world: int, algo: str, sizes: list,
            "doorbell-batch": args.doorbell_batch,
            "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
            "lib": args.lib, "trace": args.trace,
-           "flightrec": args.flightrec, "perfstats": args.perfstats}
+           "flightrec": args.flightrec, "perfstats": args.perfstats,
+           "prof": args.prof}
     if overrides:
         cfg.update(overrides)
     port = free_port()
@@ -369,6 +396,7 @@ def run_config(args, world: int, algo: str, sizes: list,
                "--trace-sample", str(args.trace_sample),
                "--flightrec", str(cfg["flightrec"]),
                "--perfstats", str(cfg["perfstats"]),
+               "--prof", str(cfg["prof"]),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -402,7 +430,8 @@ def run_config(args, world: int, algo: str, sizes: list,
                     "doorbell_batch": cfg["doorbell-batch"],
                     "trace": cfg["trace"],
                     "flightrec": cfg["flightrec"],
-                    "perfstats": cfg["perfstats"]})
+                    "perfstats": cfg["perfstats"],
+                    "prof": cfg["prof"]})
     return rows, failed
 
 
@@ -590,6 +619,15 @@ def main(argv=None) -> int:
                         "this build, absent on older .so builds); --ab "
                         "perfstats=off:on is the attribution "
                         "observability-budget gate")
+    p.add_argument("--prof", default="default",
+                   choices=["default", "on", "off"],
+                   help="in-process sampling profiler (HVDTPU_PROF; "
+                        "docs/profiling.md): 'on' runs a whole-run "
+                        "sampling window at the default 97 Hz CPU rate, "
+                        "'off' disables the subsystem, 'default' leaves "
+                        "the library default (armed, window closed — keeps "
+                        "--ab lib=old:new runnable); --ab prof=off:on is "
+                        "the profiler observability-budget gate")
     p.add_argument("--ab", default=None, metavar="FLAG=A:B",
                    help="paired interleaved A/B over one knob, e.g. "
                         "'doorbell-batch=1:0' or 'tcp-zerocopy=off:on': "

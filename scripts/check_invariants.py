@@ -547,6 +547,11 @@ def check_enum_mirrors(root: Path, findings, ran):
     # misattributes a slowdown instead of crashing.
     dict_pair("PerfPhase", f"{NATIVE_DIR}/perfstats.h", "PerfPhase",
               "horovod_tpu/perfstats.py", "PERF_PHASES")
+    # Sampling-profiler clock modes (ISSUE 14): the code rides
+    # hvdtpu_set_profiler and decides whether blocked time is sampled — a
+    # drifted value silently flips cpu/wall semantics.
+    dict_pair("ProfClock", f"{NATIVE_DIR}/profiler.h", "ProfClock",
+              ENVVARS_PY, "PROF_CLOCK_MODES")
     # postmortem.py keeps its own OpType literal (no runtime import) to
     # label the fatal op; a drifted code misnames the collective in the
     # verdict, so it is pinned like the others.

@@ -103,6 +103,28 @@ hvdtop_smoke() {
   return 0
 }
 run_check "hvdtop-smoke" hvdtop_smoke
+# Sampling-profiler smoke (docs/profiling.md): a real 2-rank --profile job
+# must leave per-rank folded profiles that prof_report.py merges into a
+# NON-EMPTY per-phase table (exit 2 otherwise) — the flamegraph pipeline
+# cannot silently regress into empty profiles. Wall clock: samples accrue
+# deterministically even on a loaded 1-vCPU box.
+prof_smoke() {
+  local dir
+  dir=$(mktemp -d /tmp/hvdtpu_prof_smoke.XXXXXX) || return 1
+  env JAX_PLATFORMS=cpu TEST_PERF_ITERS=60 "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 --profile "${dir}" \
+    --prof-clock wall python3 tests/data/perf_worker.py || return 1
+  out=$(python3 scripts/prof_report.py "${dir}" --require-samples) \
+    || return 1
+  echo "${out}" | grep -q "Per-phase sample attribution" || return 1
+  echo "${out}" | grep -qE "^ +0 " || return 1
+  echo "${out}" | grep -qE "^ +1 " || return 1
+  [ -f "${dir}/profile_merged.folded" ] || return 1
+  [ -f "${dir}/profile.speedscope.json" ] || return 1
+  rm -rf "${dir}"
+  return 0
+}
+run_check "prof-smoke" prof_smoke
 # Cross-run regression-sentry smoke (docs/observability.md): a job writes
 # merged perf profiles; perf_diff must pass a profile against itself
 # (exit 0) and CONFIRM a doctored 3x slowdown (exit 1) — so the perf

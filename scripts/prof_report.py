@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Merge per-rank sampling profiles + emit the per-phase attribution table.
+
+CLI over :mod:`horovod_tpu.profiler` (docs/profiling.md):
+
+    # merge DIR's prof.<rank>.folded files into one rank-prefixed folded
+    # file (flamegraph.pl-ready) + a speedscope doc, and print the
+    # per-phase table
+    python scripts/prof_report.py /tmp/prof
+
+    # flamegraph it (FlameGraph checkout)
+    flamegraph.pl /tmp/prof/profile_merged.folded > prof.svg
+
+    # or load /tmp/prof/profile.speedscope.json in
+    # https://www.speedscope.app
+
+Exit status: 0 on success; 2 with --require-samples when no rank
+contributed a single sample (the CI prof-smoke gate).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.profiler import (format_report, load_folded_dir,  # noqa: E402
+                                  merge_ranks, phase_table, to_speedscope)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("prof_dir", help="directory of per-rank prof.<rank>.folded "
+                                    "files (hvdrun --profile DIR)")
+    p.add_argument("-o", "--merged", default=None,
+                   help="write the merged rank-prefixed folded stacks here "
+                        "(default: <dir>/profile_merged.folded)")
+    p.add_argument("--speedscope", default=None,
+                   help="write the speedscope document here (default: "
+                        "<dir>/profile.speedscope.json)")
+    p.add_argument("--no-merged", action="store_true",
+                   help="analysis only; skip writing merged outputs")
+    p.add_argument("--report", default=None,
+                   help="write the text report here (default: stdout)")
+    p.add_argument("--json", default=None,
+                   help="write the machine-readable per-rank per-phase "
+                        "table here")
+    p.add_argument("--top", type=int, default=3,
+                   help="hot leaf frames shown per phase (default 3)")
+    p.add_argument("--require-samples", action="store_true",
+                   help="exit 2 unless at least one rank recorded samples "
+                        "(CI smoke gate)")
+    args = p.parse_args(argv)
+
+    per_rank = load_folded_dir(args.prof_dir)
+    if not args.no_merged and per_rank:
+        merged_path = args.merged or os.path.join(args.prof_dir,
+                                                  "profile_merged.folded")
+        with open(merged_path, "w") as f:
+            f.write("\n".join(merge_ranks(per_rank)) + "\n")
+        speed_path = args.speedscope or os.path.join(
+            args.prof_dir, "profile.speedscope.json")
+        with open(speed_path, "w") as f:
+            json.dump(to_speedscope(per_rank), f)
+        print(f"merged profile: {merged_path} (flamegraph.pl-ready), "
+              f"{speed_path} (https://www.speedscope.app)", file=sys.stderr)
+
+    text = format_report(per_rank, top_n=args.top)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    if args.json:
+        table = {str(rank): phases
+                 for rank, phases in phase_table(per_rank).items()}
+        with open(args.json, "w") as f:
+            json.dump({"version": 1, "ranks": table}, f, indent=2)
+
+    total = sum(sum(row.values()) for row in phase_table(per_rank).values())
+    if args.require_samples and total == 0:
+        print("prof_report: no samples in any rank profile (is HVDTPU_PROF "
+              "0, or did the job finish before the first tick?)",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

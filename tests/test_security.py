@@ -70,49 +70,129 @@ class TestKVAuth:
             server.stop()
 
 
-class TestMetricsAuth:
-    """The per-worker /metrics + /healthz endpoint is secret-gated with the
-    same HMAC proof header as the KV store (ISSUE 4 satellite): with a
-    cluster secret set, unauthenticated scrapes must get 403."""
+def _all_endpoint_paths():
+    from horovod_tpu.observability import ENDPOINT_PATHS
+    return sorted(ENDPOINT_PATHS)
 
-    def _server(self, secret):
+
+# Expected payload markers per path when every source is wired (the
+# "authed + source present" leg asserts real content, not just a 200).
+_ENDPOINT_MARKERS = {
+    "/metrics": "hvdtpu_up 1",
+    "/healthz": '"status": "ok"',
+    "/debugz": '"debugz"',
+    "/perfz": '"perfz"',
+    "/profz": '"stacks"',
+}
+
+
+class TestEndpointAuth:
+    """The per-worker observability surface is ONE path registry
+    (observability.ENDPOINT_PATHS) behind one HMAC gate (ISSUE 14
+    satellite): this suite walks every registered path through
+    {authed, unauthed, wrong-secret, missing-source} — a new endpoint
+    added to the registry is covered automatically, and one that skips
+    the registry never ships unauthenticated by accident."""
+
+    def _server(self, secret, with_sources=True):
         from horovod_tpu.observability import MetricsServer
+        kwargs = {}
+        if with_sources:
+            kwargs = dict(
+                debugz_fn=lambda: '{"debugz": 1}',
+                perfz_fn=lambda: '{"perfz": 1}',
+                profz_fn=lambda query: '{"stacks": [], "q": "%s"}' % query,
+            )
         server = MetricsServer(dump_fn=lambda: "hvdtpu_up 1\n", port=0,
-                               secret=secret, health={"rank": 0})
+                               secret=secret, health={"rank": 0}, **kwargs)
         server.start()
         return server
 
-    def test_unauthenticated_scrape_rejected(self):
-        from horovod_tpu.observability import scrape
-        server = self._server("s3cret")
-        try:
-            for path in ("/metrics", "/healthz"):
-                with pytest.raises(urllib.error.HTTPError) as e:
-                    scrape("127.0.0.1", server.port, path)
-                assert e.value.code == 403, path
-        finally:
-            server.stop()
-
-    def test_wrong_secret_rejected(self):
+    @pytest.mark.parametrize("path", _all_endpoint_paths())
+    def test_unauthenticated_rejected(self, path):
         from horovod_tpu.observability import scrape
         server = self._server("s3cret")
         try:
             with pytest.raises(urllib.error.HTTPError) as e:
-                scrape("127.0.0.1", server.port, secret="wrong")
-            assert e.value.code == 403
+                scrape("127.0.0.1", server.port, path)
+            assert e.value.code == 403, path
         finally:
             server.stop()
 
-    def test_authenticated_scrape_ok(self):
+    @pytest.mark.parametrize("path", _all_endpoint_paths())
+    def test_wrong_secret_rejected(self, path):
         from horovod_tpu.observability import scrape
         server = self._server("s3cret")
         try:
-            assert "hvdtpu_up 1" in scrape("127.0.0.1", server.port,
-                                           secret="s3cret")
-            import json
-            health = json.loads(scrape("127.0.0.1", server.port, "/healthz",
-                                       secret="s3cret"))
-            assert health["status"] == "ok"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                scrape("127.0.0.1", server.port, path, secret="wrong")
+            assert e.value.code == 403, path
+        finally:
+            server.stop()
+
+    @pytest.mark.parametrize("path", _all_endpoint_paths())
+    def test_authenticated_with_source_ok(self, path):
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret")
+        try:
+            body = scrape("127.0.0.1", server.port, path, secret="s3cret")
+            assert _ENDPOINT_MARKERS[path] in body, (path, body)
+        finally:
+            server.stop()
+
+    @pytest.mark.parametrize("path", _all_endpoint_paths())
+    def test_authenticated_missing_source_404(self, path):
+        """A registered path whose subsystem is absent (source callable is
+        None) answers 404 — same as an unknown path, never a crash.
+        /metrics and /healthz always have sources; they stay 200."""
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret", with_sources=False)
+        try:
+            if path in ("/metrics", "/healthz"):
+                assert scrape("127.0.0.1", server.port, path,
+                              secret="s3cret")
+            else:
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    scrape("127.0.0.1", server.port, path, secret="s3cret")
+                assert e.value.code == 404, path
+        finally:
+            server.stop()
+
+    def test_unknown_path_404_authed_403_unauthed(self):
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret")
+        try:
+            # The auth gate runs FIRST: an unauthenticated probe cannot
+            # even distinguish registered from unregistered paths.
+            with pytest.raises(urllib.error.HTTPError) as e:
+                scrape("127.0.0.1", server.port, "/nope")
+            assert e.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as e:
+                scrape("127.0.0.1", server.port, "/nope", secret="s3cret")
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+    def test_profz_window_actions_signed_with_query(self):
+        """/profz?start must be authed under the FULL request target: the
+        proof for a plain /profz scrape cannot be replayed to drive the
+        window, and a properly signed action round-trips."""
+        from horovod_tpu.observability import scrape
+        server = self._server("s3cret")
+        try:
+            body = scrape("127.0.0.1", server.port, "/profz?start",
+                          secret="s3cret")
+            assert '"q": "start"' in body
+            import urllib.request
+            from horovod_tpu.runner.http_kv import _AUTH_HEADER, _sign
+            # Proof signed for the bare path, replayed against ?stop.
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/profz?stop",
+                headers={_AUTH_HEADER: _sign("s3cret", "GET", "/profz",
+                                             b"")})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert e.value.code == 403
         finally:
             server.stop()
 
