@@ -19,8 +19,8 @@ from .runtime import (init, shutdown, is_initialized, rank, size, local_rank,
                       dp_axis, mode, start_timeline, stop_timeline,
                       start_trace, stop_trace,
                       metrics, metrics_dump, debugz, flightrec_dump,
-                      perf_report, profile, prof_start, prof_stop,
-                      prof_snapshot)
+                      perf_report, grad_report, profile, prof_start,
+                      prof_stop, prof_snapshot)
 
 # Collectives (reference: horovod/torch/mpi_ops.py).
 from .ops.collectives import (

@@ -44,7 +44,7 @@ _ALLREDUCE_ALGOS = {name: code
 # both languages to the same values: a silent tag drift would corrupt the
 # control plane, not crash it.
 _CTRL_MSGS = {"hello": 1, "peers": 2, "ready": 3, "responses": 4, "join": 5,
-              "need_full": 6, "params": 7, "clock": 8}
+              "need_full": 6, "params": 7, "clock": 8, "gradcheck": 9}
 _RESPONSE_TYPES = {"ok": 0, "error": 1, "join_done": 2, "shutdown": 3}
 
 
@@ -166,6 +166,13 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_perfstats.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
         ctypes.c_char_p]
+    lib.hvdtpu_set_gradstats.restype = ctypes.c_int
+    lib.hvdtpu_set_gradstats.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+        ctypes.c_char_p]
+    lib.hvdtpu_gradstats_snapshot.restype = ctypes.c_longlong
+    lib.hvdtpu_gradstats_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
     lib.hvdtpu_perfstats_snapshot.restype = ctypes.c_longlong
     lib.hvdtpu_perfstats_snapshot.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
@@ -333,6 +340,34 @@ class NativeCore:
                                         f"perf_profile.{rank}.json")
         self._lib.hvdtpu_set_perfstats(self._core, int(perf_on), perf_pct,
                                        perf_min, profile_path.encode())
+        # Numerical-health observability (docs/numerics.md): gradient
+        # moments + quantization quality + the cross-rank divergence
+        # probe, plus the NaN/Inf sentinel policy. Profile path absolute
+        # for the same chdir() reason as the dirs above.
+        from .gradstats import NAN_POLICIES
+        grad_on = ev.get_bool(ev.HVDTPU_GRADSTATS, default=True)
+        nancheck = (ev.get_str(ev.HVDTPU_NANCHECK, "warn") or
+                    "warn").strip().lower()
+        if nancheck not in NAN_POLICIES:
+            raise ValueError(
+                f"{ev.HVDTPU_NANCHECK} must be one of "
+                f"{sorted(NAN_POLICIES)}, got {nancheck!r}")
+        gradcheck = ev.get_int(ev.HVDTPU_GRADCHECK_SAMPLE,
+                               ev.DEFAULT_GRADCHECK_SAMPLE)
+        if gradcheck < 0:
+            raise ValueError(
+                f"{ev.HVDTPU_GRADCHECK_SAMPLE} must be >= 0 (every Nth "
+                f"op; 0 disables the divergence probe), got {gradcheck}")
+        grad_profile = ""
+        grad_dir = ev.get_str(ev.HVDTPU_GRAD_PROFILE_DIR, "") or ""
+        if grad_dir and grad_on:
+            grad_dir = os.path.abspath(grad_dir)
+            os.makedirs(grad_dir, exist_ok=True)
+            grad_profile = os.path.join(grad_dir,
+                                        f"grad_profile.{rank}.json")
+        self._lib.hvdtpu_set_gradstats(
+            self._core, int(grad_on), NAN_POLICIES[nancheck], gradcheck,
+            grad_profile.encode())
         # In-process sampling profiler (docs/profiling.md): armed by
         # default, sampling only while a window runs. HVDTPU_PROF_DIR (set
         # by `hvdrun --profile`) runs the window for the whole job and
@@ -676,6 +711,15 @@ class NativeCore:
         The same payload the ``/perfz`` endpoint serves. ``b""`` when the
         core is shut down."""
         return self._probe_then_copy(self._lib.hvdtpu_perfstats_snapshot)
+
+    def gradstats_snapshot(self) -> bytes:
+        """Keyed numerical-health snapshot as JSON bytes (decode with
+        :mod:`horovod_tpu.gradstats` / ``json.loads``): per-tensor gradient
+        norms/absmax/NaN counts, per-key quantization MSE/SNR +
+        error-feedback residual norms, and the divergence-probe totals.
+        The same payload the ``/gradz`` endpoint serves. ``b""`` when the
+        core is shut down."""
+        return self._probe_then_copy(self._lib.hvdtpu_gradstats_snapshot)
 
     def profiler_start(self) -> None:
         """Open a sampling window (docs/profiling.md): clears the sample

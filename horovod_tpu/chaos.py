@@ -14,6 +14,11 @@ sees resolved integers — and is deliberately tiny::
              drop[=<peer>]   blackhole one lane: silent partition, no
                              EOF (default: the triggering hop's peer,
                              or the ring neighbor on an op trigger)
+             corrupt         flip one byte of the triggering op's
+                             post-allreduce output: seeded silent data
+                             corruption the divergence probe
+                             (docs/numerics.md) must catch. op trigger
+                             only — the flip lands after the reduce.
     trigger  op=<N>          the N-th allreduce this rank STARTS (1-based)
              hop=<N>         the N-th pairwise exchange this rank runs
                              (1-based, counted across every phase —
@@ -55,11 +60,12 @@ from .utils import envvars as ev
 
 # Mirrors hvdtpu::ChaosSpec::Action (native/data_plane.h); byte-for-byte
 # parity is enforced by scripts/check_invariants.py (ENUM-MIRROR).
-CHAOS_ACTIONS = {"none": 0, "kill": 1, "hang": 2, "delay": 3, "drop": 4}
+CHAOS_ACTIONS = {"none": 0, "kill": 1, "hang": 2, "delay": 3, "drop": 4,
+                 "corrupt": 5}
 
 _SPEC_RE = re.compile(
     r"^(?:rank(?P<rank>\d+):)?"
-    r"(?P<action>kill|hang|delay|drop)"
+    r"(?P<action>kill|hang|delay|drop|corrupt)"
     r"(?:=(?P<arg>\d+))?"
     r"@(?P<trigger>op|hop)=(?P<index>\d+)$")
 
@@ -95,9 +101,14 @@ def parse_chaos(spec: str, rank: int) -> Optional[ChaosSpec]:
         raise ValueError(
             f"{ev.HVDTPU_CHAOS}: delay needs a duration, e.g. "
             f"'delay=200@hop=5' (milliseconds)")
-    if action in ("kill", "hang") and arg is not None:
+    if action in ("kill", "hang", "corrupt") and arg is not None:
         raise ValueError(
             f"{ev.HVDTPU_CHAOS}: {action} takes no '=<arg>' (got {spec!r})")
+    if action == "corrupt" and m.group("trigger") != "op":
+        raise ValueError(
+            f"{ev.HVDTPU_CHAOS}: corrupt flips a byte of a specific op's "
+            f"post-allreduce OUTPUT, so it is op-gated only — use "
+            f"'corrupt@op=N' (got {spec!r})")
     index = int(m.group("index"))
     if index <= 0:
         raise ValueError(

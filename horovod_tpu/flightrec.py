@@ -31,11 +31,13 @@ from typing import Dict, List, Optional
 FLIGHT_EVENTS = {"none": 0, "op_begin": 1, "op_end": 2, "send": 3,
                  "recv": 4, "sendrecv": 5, "reduce": 6, "quantize": 7,
                  "dequantize": 8, "fusion_wait": 9, "fail_detect": 10,
-                 "stall": 11, "abort": 12, "mark": 13, "anomaly": 14}
+                 "stall": 11, "abort": 12, "mark": 13, "anomaly": 14,
+                 "nonfinite": 15, "divergence": 16}
 EVENT_NAMES = {v: k for k, v in FLIGHT_EVENTS.items()}
 
 # Byte-for-byte mirror of hvdtpu::DumpReason (native/flightrec.h).
-DUMP_REASONS = {"on_demand": 0, "abort": 1, "stall": 2, "signal": 3}
+DUMP_REASONS = {"on_demand": 0, "abort": 1, "stall": 2, "signal": 3,
+                "nonfinite": 4}
 REASON_NAMES = {v: k for k, v in DUMP_REASONS.items()}
 
 # Lane codes (FlightLaneCode in native/flightrec.h).

@@ -193,6 +193,34 @@ struct Status {
   bool ok() const { return code == StatusCode::OK; }
 };
 
+// Allocator whose construct() default-initializes (a no-op for trivial
+// types) instead of value-initializing: resize() on a ByteBuf is "malloc
+// only", no zero-fill pass. Working buffers about to be fully overwritten
+// — the unfused allreduce output, the fusion buffer — must not pay a
+// 16-64 MB memset per op; note that bulk copies into a ByteBuf should go
+// through memcpy (or a fused kernel like CopyMomentsF32), not range
+// insert: libstdc++ only lowers uninitialized range copies to the
+// (non-temporal, large-copy-optimized) memmove for std::allocator.
+template <typename T>
+struct DefaultInitAllocator : std::allocator<T> {
+  template <typename U>
+  struct rebind {
+    using other = DefaultInitAllocator<U>;
+  };
+  template <typename U>
+  void construct(U* p) {
+    ::new (static_cast<void*>(p)) U;
+  }
+  template <typename U, typename... Args>
+  void construct(U* p, Args&&... args) {
+    ::new (static_cast<void*>(p)) U(std::forward<Args>(args)...);
+  }
+};
+
+// Collective working/output buffer (TensorEntry::output and the data
+// plane's gather outputs): byte vector with uninitialized growth.
+using ByteBuf = std::vector<uint8_t, DefaultInitAllocator<uint8_t>>;
+
 // A pending collective on this rank (reference: TensorTableEntry, common.h:183).
 struct TensorEntry {
   std::string name;
@@ -206,7 +234,9 @@ struct TensorEntry {
   std::vector<int32_t> splits;      // alltoall (may be empty = even)
   const void* input = nullptr;      // caller-owned until completion
   // Output buffer: owned by the core, copied out by the caller after wait.
-  std::vector<uint8_t> output;
+  // ByteBuf (uninitialized growth): every fill path overwrites the full
+  // range it sizes, so the old value-init zero pass was pure waste.
+  ByteBuf output;
   int32_t handle = -1;
   // Absolute steady-clock us at Enqueue (Timeline::SteadyAbsUs): the start
   // of the tensor's FUSION-WAIT trace span — how long it sat queued/fusing

@@ -14,7 +14,35 @@ namespace hvdtpu {
 
 namespace {
 
+// Quality accumulation helper (docs/numerics.md): err2 += (x - deq)^2,
+// sig2 += x^2, finite terms only — one NaN element (or an fp16 overflow's
+// inf diff) must not erase the whole op's SNR; the non-finite elements
+// themselves are the NaN sentinel's business, not the quality metric's.
+inline void AccumQuality(GradQuality* q, float x, float deq) {
+  const float d = x - deq;
+  if (d - d == 0.0f && x - x == 0.0f) {  // both finite
+    q->err2 += static_cast<double>(d) * static_cast<double>(d);
+    q->sig2 += static_cast<double>(x) * static_cast<double>(x);
+  }
+  ++q->count;
+}
+
 #if defined(__x86_64__)
+// Drain an 8-lane float product vector into a double accumulator.
+__attribute__((target("avx2")))
+inline void AccumPd(__m256d* acc, __m256 v) {
+  *acc = _mm256_add_pd(*acc, _mm256_cvtps_pd(_mm256_castps256_ps128(v)));
+  *acc = _mm256_add_pd(*acc, _mm256_cvtps_pd(_mm256_extractf128_ps(v, 1)));
+}
+
+__attribute__((target("avx2")))
+inline double HorizontalSumPd(__m256d v) {
+  __m128d lo = _mm256_castpd256_pd128(v);
+  __m128d hi = _mm256_extractf128_pd(v, 1);
+  lo = _mm_add_pd(lo, hi);
+  return _mm_cvtsd_f64(lo) + _mm_cvtsd_f64(_mm_unpackhi_pd(lo, lo));
+}
+
 bool HaveF16C() {
   // gcc 10's __builtin_cpu_supports has no "f16c"; read CPUID leaf 1 ECX
   // bit 29 directly (same probe as data_plane.cpp).
@@ -32,7 +60,12 @@ bool HaveF16C() {
 __attribute__((target("avx2,f16c")))
 void Fp16CompressF16C(const float* __restrict__ src, int64_t count,
                       uint16_t* __restrict__ dst, float* __restrict__ residual,
-                      float* __restrict__ self_decode) {
+                      float* __restrict__ self_decode,
+                      GradQuality* __restrict__ quality) {
+  const bool want_back =
+      residual != nullptr || self_decode != nullptr || quality != nullptr;
+  __m256d qerr = _mm256_setzero_pd();
+  __m256d qsig = _mm256_setzero_pd();
   int64_t i = 0;
   for (; i + 8 <= count; i += 8) {
     __m256 x = _mm256_loadu_ps(src + i);
@@ -41,31 +74,46 @@ void Fp16CompressF16C(const float* __restrict__ src, int64_t count,
     }
     __m128i h = _mm256_cvtps_ph(x, _MM_FROUND_TO_NEAREST_INT);
     _mm_storeu_si128(reinterpret_cast<__m128i*>(dst + i), h);
-    if (residual != nullptr || self_decode != nullptr) {
+    if (want_back) {
       __m256 back = _mm256_cvtph_ps(h);
+      // Finite mask over x - back: zero where half-range overflow
+      // saturated to inf or a NaN input poisons the diff.
+      __m256 r = _mm256_sub_ps(x, back);
+      __m256 finite = _mm256_cmp_ps(_mm256_sub_ps(r, r),
+                                    _mm256_setzero_ps(), _CMP_EQ_OQ);
+      __m256 rf = _mm256_and_ps(r, finite);
       if (residual != nullptr) {
-        // Zero the residual where x - back is not finite (half-range
-        // overflow saturated to inf, or a NaN input): carrying ±inf would
-        // poison the element's error feedback permanently.
-        __m256 r = _mm256_sub_ps(x, back);
-        __m256 finite = _mm256_cmp_ps(_mm256_sub_ps(r, r),
-                                      _mm256_setzero_ps(), _CMP_EQ_OQ);
-        _mm256_storeu_ps(residual + i, _mm256_and_ps(r, finite));
+        // Carrying ±inf would poison the element's error feedback
+        // permanently — store the filtered diff.
+        _mm256_storeu_ps(residual + i, rf);
       }
       if (self_decode != nullptr) _mm256_storeu_ps(self_decode + i, back);
+      if (quality != nullptr) {
+        // r finite implies x finite (back is never NaN for finite x), so
+        // one mask filters both quality terms.
+        __m256 xf = _mm256_and_ps(x, finite);
+        AccumPd(&qerr, _mm256_mul_ps(rf, rf));
+        AccumPd(&qsig, _mm256_mul_ps(xf, xf));
+      }
     }
+  }
+  if (quality != nullptr) {
+    quality->err2 += HorizontalSumPd(qerr);
+    quality->sig2 += HorizontalSumPd(qsig);
+    quality->count += i;
   }
   for (; i < count; ++i) {
     float x = src[i] + (residual != nullptr ? residual[i] : 0.0f);
     uint16_t h = FloatToHalfPublic(x);
     dst[i] = h;
-    if (residual != nullptr || self_decode != nullptr) {
+    if (want_back) {
       float back = HalfToFloatPublic(h);
       if (residual != nullptr) {
         float r = x - back;
         residual[i] = std::isfinite(r) ? r : 0.0f;
       }
       if (self_decode != nullptr) self_decode[i] = back;
+      if (quality != nullptr) AccumQuality(quality, x, back);
     }
   }
 }
@@ -88,18 +136,20 @@ void Fp16DecompressF16C(const uint16_t* __restrict__ src, int64_t count,
 #endif  // __x86_64__
 
 void Fp16Compress(const float* src, int64_t count, uint8_t* dst,
-                  float* residual, float* self_decode) {
+                  float* residual, float* self_decode, GradQuality* quality) {
   uint16_t* h = reinterpret_cast<uint16_t*>(dst);
 #if defined(__x86_64__)
   if (HaveF16C()) {
-    Fp16CompressF16C(src, count, h, residual, self_decode);
+    Fp16CompressF16C(src, count, h, residual, self_decode, quality);
     return;
   }
 #endif
+  const bool want_back =
+      residual != nullptr || self_decode != nullptr || quality != nullptr;
   for (int64_t i = 0; i < count; ++i) {
     float x = src[i] + (residual != nullptr ? residual[i] : 0.0f);
     h[i] = FloatToHalfPublic(x);
-    if (residual != nullptr || self_decode != nullptr) {
+    if (want_back) {
       float back = HalfToFloatPublic(h[i]);
       if (residual != nullptr) {
         // Half-range overflow saturates to inf; a ±inf residual would
@@ -108,6 +158,7 @@ void Fp16Compress(const float* src, int64_t count, uint8_t* dst,
         residual[i] = std::isfinite(r) ? r : 0.0f;
       }
       if (self_decode != nullptr) self_decode[i] = back;
+      if (quality != nullptr) AccumQuality(quality, x, back);
     }
   }
 }
@@ -169,7 +220,11 @@ inline float HorizontalMax(__m256 v) {
 
 __attribute__((target("avx2")))
 void MaxMinCompress8Avx2(const float* src, int64_t count, uint8_t* dst,
-                         float* residual, float* self_decode) {
+                         float* residual, float* self_decode,
+                         GradQuality* quality) {
+  __m256d qerr = _mm256_setzero_pd();
+  __m256d qsig = _mm256_setzero_pd();
+  int64_t qvec = 0;  // lanes the vector loop accumulated (tails self-count)
   const int64_t nb = NumBuckets(count);
   float* header = reinterpret_cast<float*>(dst);
   uint8_t* codes = dst + nb * 8;
@@ -234,13 +289,26 @@ void MaxMinCompress8Avx2(const float* src, int64_t count, uint8_t* dst,
                                      _mm256_extracti128_si256(i32, 1));
       _mm_storel_epi64(reinterpret_cast<__m128i*>(codes + lo + i),
                        _mm_packus_epi16(u16, u16));
-      if (residual != nullptr || self_decode != nullptr) {
+      if (residual != nullptr || self_decode != nullptr ||
+          quality != nullptr) {
         __m256 deq = _mm256_add_ps(vmn, _mm256_mul_ps(q, vunit));
+        __m256 diff = _mm256_sub_ps(x, deq);
         if (residual != nullptr) {
-          _mm256_storeu_ps(residual + lo + i, _mm256_sub_ps(x, deq));
+          _mm256_storeu_ps(residual + lo + i, diff);
         }
         if (self_decode != nullptr) {
           _mm256_storeu_ps(self_decode + lo + i, deq);
+        }
+        if (quality != nullptr) {
+          // Finite lanes only (a NaN input makes the bucket's min/unit —
+          // and so diff — NaN; the sentinel owns non-finite values).
+          __m256 finite = _mm256_cmp_ps(_mm256_sub_ps(diff, diff),
+                                        _mm256_setzero_ps(), _CMP_EQ_OQ);
+          __m256 df = _mm256_and_ps(diff, finite);
+          __m256 xf = _mm256_and_ps(x, finite);
+          AccumPd(&qerr, _mm256_mul_ps(df, df));
+          AccumPd(&qsig, _mm256_mul_ps(xf, xf));
+          qvec += 8;
         }
       }
     }
@@ -250,12 +318,19 @@ void MaxMinCompress8Avx2(const float* src, int64_t count, uint8_t* dst,
       if (q < 0.0f) q = 0.0f;
       if (q > 255.0f) q = 255.0f;
       codes[lo + i] = static_cast<uint8_t>(q);
-      if (residual != nullptr || self_decode != nullptr) {
+      if (residual != nullptr || self_decode != nullptr ||
+          quality != nullptr) {
         const float deq = mn + q * unit;
         if (residual != nullptr) residual[lo + i] = xbuf[i] - deq;
         if (self_decode != nullptr) self_decode[lo + i] = deq;
+        if (quality != nullptr) AccumQuality(quality, xbuf[i], deq);
       }
     }
+  }
+  if (quality != nullptr) {
+    quality->err2 += HorizontalSumPd(qerr);
+    quality->sig2 += HorizontalSumPd(qsig);
+    quality->count += qvec;  // scalar tails self-counted via AccumQuality
   }
 }
 
@@ -291,7 +366,8 @@ void MaxMinDecompress8Avx2(const uint8_t* src, int64_t count, float* dst) {
 
 template <int kBits>
 void MaxMinCompress(const float* src, int64_t count, uint8_t* dst,
-                    float* residual, float* self_decode) {
+                    float* residual, float* self_decode,
+                    GradQuality* quality) {
   constexpr float kLevels = static_cast<float>((1 << kBits) - 1);
   const int64_t nb = NumBuckets(count);
   float* header = reinterpret_cast<float*>(dst);
@@ -333,10 +409,12 @@ void MaxMinCompress(const float* src, int64_t count, uint8_t* dst,
           cell = static_cast<uint8_t>(cell | (code << 4));
         }
       }
-      if (residual != nullptr || self_decode != nullptr) {
+      if (residual != nullptr || self_decode != nullptr ||
+          quality != nullptr) {
         const float deq = mn + q * unit;
         if (residual != nullptr) residual[lo + i] = xbuf[i] - deq;
         if (self_decode != nullptr) self_decode[lo + i] = deq;
+        if (quality != nullptr) AccumQuality(quality, xbuf[i], deq);
       }
     }
   }
@@ -395,23 +473,24 @@ int64_t WireBytes(WireCompression c, int64_t count) {
 }
 
 void WireCompress(WireCompression c, const float* src, int64_t count,
-                  uint8_t* dst, float* residual, float* self_decode) {
+                  uint8_t* dst, float* residual, float* self_decode,
+                  GradQuality* quality) {
   if (count <= 0) return;
   switch (c) {
     case WireCompression::FP16:
-      Fp16Compress(src, count, dst, residual, self_decode);
+      Fp16Compress(src, count, dst, residual, self_decode, quality);
       return;
     case WireCompression::INT8:
 #if defined(__x86_64__)
       if (HaveAvx2()) {
-        MaxMinCompress8Avx2(src, count, dst, residual, self_decode);
+        MaxMinCompress8Avx2(src, count, dst, residual, self_decode, quality);
         return;
       }
 #endif
-      MaxMinCompress<8>(src, count, dst, residual, self_decode);
+      MaxMinCompress<8>(src, count, dst, residual, self_decode, quality);
       return;
     case WireCompression::INT4:
-      MaxMinCompress<4>(src, count, dst, residual, self_decode);
+      MaxMinCompress<4>(src, count, dst, residual, self_decode, quality);
       return;
     case WireCompression::NONE:
     case WireCompression::AUTO:
@@ -477,12 +556,24 @@ void WireDecompressAdd(WireCompression c, const uint8_t* src, int64_t count,
   }
 }
 
-float* ResidualStore::Get(const std::string& key, int64_t count) {
+float* ResidualStore::Get(const std::string& key, int64_t count,
+                          bool* reset) {
+  if (reset != nullptr) *reset = false;
   if (buf_.size() >= kMaxEntries && buf_.find(key) == buf_.end()) {
+    // Cap reached by a NEW signature: every live key's feedback is
+    // dropped — that is a reset of real state, not a first use.
+    if (reset != nullptr && !buf_.empty()) *reset = true;
     buf_.clear();
   }
-  std::vector<float>& buf = buf_[key];
+  auto it = buf_.find(key);
+  const bool existed = it != buf_.end();
+  std::vector<float>& buf = existed ? it->second : buf_[key];
   if (buf.size() != static_cast<size_t>(count)) {
+    // Element count changed on a LIVE key (refused fusion / reshape):
+    // accumulated error feedback restarts from zero — the caller counts
+    // and WARNs (hvdtpu_residual_resets_total) so a mid-run reshape is
+    // visible instead of silently degrading quality.
+    if (reset != nullptr && existed && !buf.empty()) *reset = true;
     buf.assign(static_cast<size_t>(count), 0.0f);
   }
   return buf.data();

@@ -31,6 +31,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "gradstats.h"  // GradQuality (quantization-quality accumulation)
+
 namespace hvdtpu {
 
 // Matches the Python surface (envvars.WIRE_COMPRESSION_MODES) and the
@@ -67,9 +69,15 @@ int64_t WireBytes(WireCompression c, int64_t count);
 // peers will decode — cross-rank bitwise consistency for the compressed
 // collectives.
 //
+// quality (optional): accumulates sum (x - dequantized)^2 and sum x^2 over
+// every quantized element (docs/numerics.md) — the kernels already compute
+// the dequantized value for error feedback, so the accumulation costs two
+// FMAs per lane, not an extra pass.
+//
 // c must be a concrete mode (not NONE/AUTO).
 void WireCompress(WireCompression c, const float* src, int64_t count,
-                  uint8_t* dst, float* residual, float* self_decode);
+                  uint8_t* dst, float* residual, float* self_decode,
+                  GradQuality* quality = nullptr);
 
 // dst[i] = decoded[i].
 void WireDecompress(WireCompression c, const uint8_t* src, int64_t count,
@@ -95,12 +103,19 @@ class ResidualStore {
   // varies the threshold), so distinct keys can proliferate — past
   // kMaxEntries the store resets rather than leak a full-size fp32 buffer
   // per stale signature (EF restarts from zero; it is best-effort state).
-  float* Get(const std::string& key, int64_t count);
+  // *reset (optional) is set true when EXISTING feedback state was dropped
+  // — a live key resized (refused fusion / reshape) or the whole store
+  // cleared at the cap — so the caller can count and WARN
+  // (hvdtpu_residual_resets_total; docs/numerics.md): silently restarting
+  // error feedback mid-run is a quality event, not bookkeeping.
+  float* Get(const std::string& key, int64_t count, bool* reset = nullptr);
   size_t size() const { return buf_.size(); }
   // Total bytes held across every residual buffer — the memory-occupancy
-  // telemetry's hvdtpu_residual_store_bytes gauge. O(entries), entries are
-  // capped at kMaxEntries; background thread only, like Get.
-  int64_t bytes() const {
+  // telemetry's hvdtpu_residual_store_bytes gauge (refreshed at 1 Hz by
+  // the background loop; docs/metrics.md documents the staleness window).
+  // O(entries), entries are capped at kMaxEntries; background thread only,
+  // like Get.
+  int64_t TotalBytes() const {
     int64_t total = 0;
     for (const auto& kv : buf_) {
       total += static_cast<int64_t>(kv.second.size() * sizeof(float));

@@ -39,6 +39,7 @@
 #include "common.h"
 #include "compressed.h"
 #include "data_plane.h"
+#include "gradstats.h"
 #include "message.h"
 #include "metrics.h"
 #include "perfstats.h"
@@ -91,6 +92,9 @@ enum class CtrlMsg : int32_t {
   NEED_FULL = 6,  // coordinator -> worker: cache miss, resend full requests
   PARAMS = 7,     // coordinator -> worker: autotuned cycle time / fusion
   CLOCK = 8,      // clock-sync ping-pong: worker {t1} <-> coord {t1, t2}
+  GRADCHECK = 9,  // worker -> coordinator: post-allreduce output
+                  // fingerprint {seq, crc32c, tensor} for the cross-rank
+                  // divergence probe (docs/numerics.md)
 };
 
 void LogWarn(int rank, const char* fmt, ...) {
@@ -339,6 +343,19 @@ struct CoreConfig {
   int32_t wire_compression = 0;
   int64_t compression_min_bytes = 1024;
   std::string compression_skip_regex;
+  // Numerical-health observability (gradstats.h; docs/numerics.md). On by
+  // default: the moments fold into passes the core already pays for.
+  // nancheck: 0 off, 1 warn (default), 2 abort — what the first NaN/Inf
+  // gradient does. gradcheck_sample: fingerprint every Nth allreduce's
+  // post-reduce output and compare across ranks through the control plane
+  // (0 disables the divergence probe; must be uniform across ranks, which
+  // the launcher's env broadcast guarantees). grad_profile_path: where
+  // Shutdown persists grad_profile.<rank>.json (HVDTPU_GRAD_PROFILE_DIR;
+  // empty = skip) for scripts/grad_diff.py.
+  bool gradstats = true;
+  int32_t nancheck = 1;
+  int64_t gradcheck_sample = 64;
+  std::string grad_profile_path;
 };
 
 class Core {
@@ -418,6 +435,9 @@ class Core {
   // Keyed-baseline snapshot as JSON — lock-free reads, callable from any
   // thread at any point in the core lifecycle.
   std::string PerfSnapshot() { return perfstats_.SnapshotJson(); }
+  // Numerical-health surface (C API hvdtpu_gradstats_snapshot; /gradz /
+  // hvd.grad_report()). Same lock-free-read contract as PerfSnapshot.
+  std::string GradSnapshot() { return gradstats_.SnapshotJson(); }
   // Sampling-profiler surface (C API hvdtpu_profiler_*; /profz /
   // hvd.profile()). All callable from any thread at any point in the core
   // lifecycle — a disabled profiler starts/stops as no-ops and snapshots
@@ -496,6 +516,46 @@ class Core {
   std::vector<std::string> perf_anomaly_log_;
   bool perf_profile_written_ = false;
   void WritePerfProfile();
+  // Numerical-health telemetry (gradstats.h; docs/numerics.md): per-tensor
+  // gradient moments + per-key quantization quality + the cross-rank
+  // divergence probe. grad_quality_ is the per-op accumulator the data
+  // plane's WireCompress calls fill (background thread only).
+  GradStats gradstats_;
+  GradQuality grad_quality_;
+  bool grad_profile_written_ = false;
+  void WriteGradProfile();
+  // Divergence probe state: every executed (non-Adasum) allreduce bumps
+  // gradcheck_seq_ identically on every rank; sampled ops fingerprint
+  // their post-reduce output and the coordinator majority-votes per seq.
+  // Both are background-thread-owned, like message_table_.
+  int64_t gradcheck_seq_ = 0;
+  struct GradcheckSlot {
+    std::string name;
+    int64_t bytes = 0;
+    std::vector<uint32_t> crcs;
+    std::vector<uint8_t> have;
+    int count = 0;
+  };
+  std::map<int64_t, GradcheckSlot> gradcheck_pending_;  // coordinator only
+  // Fingerprint this op's post-allreduce output when the sampler says so
+  // (background thread; called before postscale, so every rank hashes
+  // bitwise-identical bytes). adasum ops are skipped — their pairwise
+  // adaptive combine is outside the PR-3 bitwise-identity contract.
+  void MaybeGradcheck(const std::string& name, const void* data,
+                      int64_t bytes);
+  // Coordinator side: record one rank's fingerprint for seq; when every
+  // rank reported, majority-vote and convict the minority (DIVERGENCE
+  // flight event + hvdtpu_divergence_total{suspect=...}).
+  void RecordFingerprint(int64_t seq, int rank, uint32_t crc,
+                         const std::string& name, int64_t bytes);
+  // Non-finite sentinel (HVDTPU_NANCHECK): count + flight event + WARN for
+  // a tensor whose copy-in moments saw NaN/Inf; returns true when the
+  // policy is ABORT and the op must fail-fast before any data moves.
+  // `slot` keys the per-tensor 1/s WARN/flight throttle — a NaN-flooded
+  // model must not evict the flight ring's op/hop forensics (counters
+  // stay exact regardless).
+  bool NoteNonfinite(const std::string& tensor, const GradMoments& m,
+                     int slot);
   // Always-available sampling profiler (profiler.h; docs/profiling.md):
   // the background loop registers itself for SIGPROF sampling, the data
   // plane publishes the phase thread-local the samples are tagged with,
@@ -660,6 +720,13 @@ class Core {
   Counter* m_failures_detected_ = nullptr;
   Histogram* m_recovery_seconds_ = nullptr;
   Counter* m_flightrec_dumps_ = nullptr;
+  // Numerical-health counters (docs/numerics.md): non-finite gradient
+  // elements seen, divergence probes run, and error-feedback residual
+  // resets (hvdtpu_divergence_total is label-resolved per suspect rank at
+  // emission).
+  Counter* m_nonfinite_grads_ = nullptr;
+  Counter* m_gradcheck_probes_ = nullptr;
+  Counter* m_residual_resets_ = nullptr;
   // Clock-sync quality vs rank 0 (PR-8 alignment), refreshed at every
   // adoption so the aggregator/console can flag degraded ranks.
   Gauge* m_clock_offset_gauge_ = nullptr;
@@ -873,6 +940,156 @@ void Core::WritePerfProfile() {
   fclose(f);
 }
 
+void Core::WriteGradProfile() {
+  if (cfg_.grad_profile_path.empty() || !gradstats_.enabled() ||
+      grad_profile_written_) {
+    return;
+  }
+  grad_profile_written_ = true;
+  std::string body = "{\"version\": 1, \"rank\": " +
+                     std::to_string(cfg_.rank) +
+                     ", \"size\": " + std::to_string(cfg_.size) +
+                     ", \"gradstats\": " + gradstats_.SnapshotJson() + "}\n";
+  FILE* f = fopen(cfg_.grad_profile_path.c_str(), "w");
+  if (f == nullptr) {
+    LogWarn(cfg_.rank, "grad profile: cannot write %s",
+            cfg_.grad_profile_path.c_str());
+    return;
+  }
+  fwrite(body.data(), 1, body.size(), f);
+  fclose(f);
+}
+
+bool Core::NoteNonfinite(const std::string& tensor, const GradMoments& m,
+                         int slot) {
+  if (m.nonfinite == 0) return false;
+  const NanPolicy policy = gradstats_.nan_policy();
+  if (policy == NanPolicy::OFF) return false;
+  m_nonfinite_grads_->Add(m.nonfinite);
+  gradstats_.NoteNonfinite(m.nonfinite);
+  // A diverged model floods NaN tensors hundreds of ops per second:
+  // throttle the LOG and the flight record per tensor (first event always
+  // passes) so the ring keeps the op/hop records a post-mortem needs.
+  // Aborts always surface — they are about to freeze the ring anyway.
+  const int64_t now = Timeline::SteadyAbsUs();
+  if (policy == NanPolicy::ABORT ||
+      gradstats_.ShouldWarnNonfinite(slot, now)) {
+    flightrec_.Record(FlightEvent::NONFINITE, flightrec_.InternName(tensor),
+                      m.nonfinite, -1, -1, now, now,
+                      static_cast<int64_t>(policy), 0);
+    LogWarn(cfg_.rank,
+            "non-finite gradient in tensor '%s': %lld of %lld elements are "
+            "NaN/Inf (HVDTPU_NANCHECK=%s)",
+            tensor.c_str(), static_cast<long long>(m.nonfinite),
+            static_cast<long long>(m.count), NanPolicyName(policy));
+  }
+  if (policy != NanPolicy::ABORT) return false;
+  // Fail-fast forensics BEFORE any lane breaks: this rank's own dump must
+  // carry the NONFINITE record (and the NONFINITE reason code) so the
+  // post-mortem verdict can name the tensor, not just the rank.
+  if (flightrec_.DumpToFile(DumpReason::NONFINITE, cfg_.rank, "",
+                            /*fatal_once=*/true) &&
+      m_flightrec_dumps_ != nullptr) {
+    m_flightrec_dumps_->Inc();
+  }
+  return true;
+}
+
+void Core::MaybeGradcheck(const std::string& name, const void* data,
+                          int64_t bytes) {
+  if (!gradstats_.enabled() || cfg_.size <= 1 || bytes <= 0) return;
+  const int64_t every = gradstats_.gradcheck_sample();
+  if (every <= 0) return;
+  // The sequence counter advances on EVERY probed-eligible op so all ranks
+  // roll the same sampling decision (the knob is env-broadcast uniform).
+  const int64_t seq = ++gradcheck_seq_;
+  if (seq % every != 0) return;
+  const uint32_t crc = Crc32c(data, static_cast<size_t>(bytes));
+  gradstats_.NoteProbe();
+  m_gradcheck_probes_->Inc();
+  if (cfg_.rank == 0) {
+    RecordFingerprint(seq, 0, crc, name, bytes);
+    return;
+  }
+  if (control_fd_ < 0) return;
+  // Piggybacked control-plane frame: rides the already-open coordinator
+  // connection, one small frame per sampled op (cost model in
+  // docs/numerics.md).
+  Writer w;
+  w.I32(static_cast<int32_t>(CtrlMsg::GRADCHECK));
+  w.I64(seq);
+  w.I64(static_cast<int64_t>(crc));
+  w.Str(name);
+  SendFrame(control_fd_, w.buffer());
+}
+
+void Core::RecordFingerprint(int64_t seq, int rank, uint32_t crc,
+                             const std::string& name, int64_t bytes) {
+  GradcheckSlot& slot = gradcheck_pending_[seq];
+  if (slot.crcs.empty()) {
+    slot.crcs.assign(cfg_.size, 0);
+    slot.have.assign(cfg_.size, 0);
+  }
+  if (rank < 0 || rank >= cfg_.size || slot.have[rank] != 0) return;
+  slot.crcs[rank] = crc;
+  slot.have[rank] = 1;
+  ++slot.count;
+  if (!name.empty()) slot.name = name;
+  if (bytes > 0) slot.bytes = bytes;
+  if (slot.count < cfg_.size) {
+    // Bound the pending table: a rank that shut down (or lost its frame)
+    // must not pin entries forever — drop the oldest incomplete probes.
+    while (gradcheck_pending_.size() > 256) {
+      gradcheck_pending_.erase(gradcheck_pending_.begin());
+    }
+    return;
+  }
+  // Every rank reported: majority vote. The majority fingerprint is the
+  // most frequent value (ties broken toward the lowest holding rank, so a
+  // 1v1 world convicts rank 1, matching the verdict convention that rank 0
+  // holds the reference copy of negotiated state).
+  std::unordered_map<uint32_t, int> freq;
+  for (int r = 0; r < cfg_.size; ++r) ++freq[slot.crcs[r]];
+  uint32_t majority = slot.crcs[0];
+  int best = 0;
+  for (int r = 0; r < cfg_.size; ++r) {
+    const int f = freq[slot.crcs[r]];
+    if (f > best) {
+      best = f;
+      majority = slot.crcs[r];
+    }
+  }
+  if (best < cfg_.size) {
+    for (int r = 0; r < cfg_.size; ++r) {
+      if (slot.crcs[r] == majority) continue;
+      // Silent data corruption (or non-determinism): the invariant every
+      // collective here guarantees — bitwise-identical outputs on every
+      // rank (PR-3 made even the compressed paths honor it) — broke.
+      gradstats_.NoteDivergence();
+      metrics_
+          .GetCounter(
+              "hvdtpu_divergence_total",
+              "Cross-rank divergence-probe mismatches: sampled "
+              "post-allreduce outputs whose crc32c differed from the "
+              "world's majority (silent data corruption or "
+              "non-determinism), by minority rank",
+              MetricLabels{{"suspect", std::to_string(r)}})
+          ->Inc();
+      const int64_t now = Timeline::SteadyAbsUs();
+      flightrec_.Record(FlightEvent::DIVERGENCE,
+                        flightrec_.InternName(slot.name), slot.bytes, r, -1,
+                        now, now, static_cast<int64_t>(slot.crcs[r]), 0);
+      LogWarn(0,
+              "DIVERGENCE: tensor '%s' (probe #%lld) — rank %d's "
+              "post-allreduce fingerprint %08x differs from the majority "
+              "%08x; silent data corruption or non-determinism",
+              slot.name.c_str(), static_cast<long long>(seq), r,
+              slot.crcs[r], majority);
+    }
+  }
+  gradcheck_pending_.erase(seq);
+}
+
 void Core::UpdateParamGauges(double cycle_ms, int64_t fusion, bool cache_on,
                              int64_t crossover) {
   m_cycle_time_gauge_->Set(cycle_ms);
@@ -1024,6 +1241,27 @@ Status Core::Start() {
   perfstats_.Configure(cfg_.perfstats, cfg_.perf_slowdown_pct,
                        cfg_.perf_min_samples);
   data_plane_.set_perf_enabled(perfstats_.enabled());
+  // Numerical-health telemetry (docs/numerics.md): gradient moments fold
+  // into the fusion copy-in, quantization quality into the compressed
+  // hops, and the divergence probe fingerprints every Nth op's output.
+  gradstats_.Configure(cfg_.gradstats,
+                       static_cast<NanPolicy>(cfg_.nancheck),
+                       cfg_.gradcheck_sample);
+  m_nonfinite_grads_ = metrics_.GetCounter(
+      "hvdtpu_nonfinite_grads_total",
+      "NaN/Inf gradient elements seen at fusion copy-in "
+      "(HVDTPU_NANCHECK; docs/numerics.md)");
+  m_gradcheck_probes_ = metrics_.GetCounter(
+      "hvdtpu_gradcheck_probes_total",
+      "Cross-rank divergence probes this rank ran: sampled post-allreduce "
+      "outputs fingerprinted and reported to rank 0 "
+      "(HVDTPU_GRADCHECK_SAMPLE)");
+  m_residual_resets_ = metrics_.GetCounter(
+      "hvdtpu_residual_resets_total",
+      "Error-feedback residual buffers dropped mid-run (element count "
+      "changed on a live key — refused fusion or reshape — or the store "
+      "hit its entry cap); compression quality restarts from zero "
+      "feedback");
   // Always-available sampling profiler (docs/profiling.md): the background
   // loop registers itself once it starts; a window runs only on demand
   // (/profz, hvd.profile()) — except under hvdrun --profile, whose
@@ -1416,6 +1654,9 @@ void Core::Shutdown() {
   // rank's per-key baselines + anomaly log. After the join, the
   // background thread's perf state is quiescent.
   WritePerfProfile();
+  // Numerical-health profile (docs/numerics.md): per-key norms/SNR for
+  // scripts/grad_diff.py, same quiescence argument.
+  WriteGradProfile();
   // Whole-job profile (hvdrun --profile): stop the window and persist
   // prof.<rank>.folded for scripts/prof_report.py. The background thread
   // has unregistered its timer by now; the ring is quiescent.
@@ -1630,7 +1871,7 @@ void Core::UpdateMemoryGauges(bool force) {
   last_mem_update_at_ = now;
   if (m_residual_bytes_gauge_ != nullptr) {
     m_residual_bytes_gauge_->Set(
-        static_cast<double>(residual_store_.bytes()));
+        static_cast<double>(residual_store_.TotalBytes()));
   }
   // Per-lane shm-ring occupancy. The gauge handle resolution is a mutex-map
   // lookup per lane — fine at this cadence; lanes are fixed after Connect.
@@ -1989,6 +2230,17 @@ void Core::CoordinatorIngest() {
         w.I64(t1);
         w.I64(Timeline::SteadyAbsUs());
         SendFrame(fd, w.buffer());
+      } else if (type == CtrlMsg::GRADCHECK) {
+        // Divergence probe report (docs/numerics.md): one sampled op's
+        // post-allreduce fingerprint from this worker.
+        int64_t seq = r.I64();
+        int64_t crc = r.I64();
+        std::string name = r.Str();
+        if (!r.ok()) {
+          LogBadFrame(cfg_.rank, "coordinator GRADCHECK", frame);
+          continue;
+        }
+        RecordFingerprint(seq, rank, static_cast<uint32_t>(crc), name, 0);
       }
     }
   }
@@ -2516,7 +2768,7 @@ void Core::ExecuteResponse(const Response& resp) {
       for (int r = 0; r < cfg_.size; ++r) {
         block_bytes[r] = resp.first_dims[r] * row_bytes;
       }
-      std::vector<uint8_t> out;
+      ByteBuf out;
       st = data_plane_.Allgatherv(e->input, my_first * row_bytes, block_bytes,
                                   &out);
       if (st.ok()) e->output = std::move(out);
@@ -2549,7 +2801,7 @@ void Core::ExecuteResponse(const Response& resp) {
             resp.all_splits[static_cast<size_t>(r) * cfg_.size + cfg_.rank] *
             row_bytes;
       }
-      std::vector<uint8_t> out;
+      ByteBuf out;
       st = data_plane_.Alltoallv(e->input, send_bytes, recv_bytes, &out);
       if (st.ok()) e->output = std::move(out);
       break;
@@ -2562,7 +2814,7 @@ void Core::ExecuteResponse(const Response& resp) {
         input_copy.assign(static_cast<size_t>(e->byte_size()), 0);
         src = input_copy.data();
       }
-      std::vector<uint8_t> out;
+      ByteBuf out;
       st = data_plane_.ReduceScatter(src, e->num_elements(), e->dtype,
                                      e->reduce_op, &out);
       if (st.ok()) e->output = std::move(out);
@@ -2770,9 +3022,70 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
       key += ';';
       key += resp.names[i];
     }
-    residual = residual_store_.Get(key, total_elems);
+    bool residual_reset = false;
+    residual = residual_store_.Get(key, total_elems, &residual_reset);
+    if (residual_reset) {
+      // A live key's error feedback was dropped (element count changed —
+      // refused fusion or reshape — or the store hit its cap). Quality
+      // telemetry, not bookkeeping: the accumulated correction restarts
+      // from zero, so make it visible (docs/numerics.md).
+      m_residual_resets_->Inc();
+      gradstats_.NoteResidualReset();
+      LogWarn(cfg_.rank,
+              "error-feedback residual reset for '%s' (element count "
+              "changed mid-run or store overflow); compression restarts "
+              "with zero feedback",
+              key.c_str());
+    }
   }
-  data_plane_.BeginCompressedOp(comp, residual);
+  // Gradient-health instrumentation for this op (docs/numerics.md):
+  // moments fold into the fp32 copy-in below; the compressed hops fill
+  // grad_quality_ through the data plane.
+  const bool grad_on =
+      gradstats_.enabled() && resp.dtype == DataType::FLOAT32;
+  data_plane_.BeginCompressedOp(
+      comp, residual,
+      grad_on && comp != WireCompression::NONE ? &grad_quality_ : nullptr);
+  // The per-key signature the health stats are keyed by: the primary
+  // tensor for unfused ops (per-layer granularity), primary + batch width
+  // for fused batches (same convention as the perf baselines). Built only
+  // when gradstats will consume it — off must stay one branch per op.
+  const std::string grad_key =
+      !grad_on || entries.empty()
+          ? std::string()
+          : (entries.size() == 1
+                 ? entries[0]->name
+                 : entries[0]->name + "(+" +
+                       std::to_string(entries.size() - 1) + ")");
+  // Fail-fast path for HVDTPU_NANCHECK=abort: complete every entry with
+  // one coherent error BEFORE any data moves, then break the world — a
+  // rank that keeps collectives running on NaN gradients just burns the
+  // fleet to diverge the loss.
+  auto nan_abort = [&](const std::string& tensor) {
+    data_plane_.EndCompressedOp();
+    Status st = Status::Error(
+        StatusCode::INVALID_ARGUMENT,
+        "non-finite gradient in tensor '" + tensor +
+            "' (HVDTPU_NANCHECK=abort)");
+    flightrec_.Record(
+        FlightEvent::OP_END,
+        entries.empty() ? -1 : flightrec_.InternName(entries[0]->name),
+        total_bytes, -1, -1, exec_start_us, Timeline::SteadyAbsUs(), 1, 0);
+    for (auto* e : entries) {
+      timeline_.ActivityEnd(e->name);
+      timeline_.OpDone(e->name, st.reason);
+      if (e->handle >= 0) CompleteEntry(e, st);
+    }
+    // Break every lane so peers blocked in this collective cascade-fail
+    // within one detect slice instead of hanging; then fail over like a
+    // data-plane failure (the coordinator broadcasts SHUTDOWN).
+    data_plane_.Abort();
+    if (cfg_.rank == 0) {
+      world_broken_ = true;
+    } else {
+      worker_failover_pending_ = true;
+    }
+  };
 
   if (entries.size() == 1) {
     // Unfused: the entry's output buffer IS the working buffer — one big
@@ -2780,11 +3093,29 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     TensorEntry* e = entries[0];
     const size_t nbytes = static_cast<size_t>(total_elems) * elem;
     if (e->input != nullptr) {
-      // Range-insert, not assign(n, 0) + memcpy: skips a full zero-fill
-      // pass over a buffer that is immediately overwritten.
       const uint8_t* in = static_cast<const uint8_t*>(e->input);
-      e->output.clear();
-      e->output.insert(e->output.end(), in, in + nbytes);
+      // ByteBuf resize is malloc-only (no zero-fill pass — every byte is
+      // about to be overwritten); explicit memcpy keeps glibc's
+      // large-copy non-temporal path, which a range insert through the
+      // custom allocator would lose.
+      e->output.resize(nbytes);
+      if (grad_on) {
+        // Single-pass fused copy + moments scan (docs/numerics.md): the
+        // scan rides the copy's load stream, within the A/B-measured
+        // noise of plain memcpy.
+        GradMoments m;
+        CopyMomentsF32(reinterpret_cast<float*>(e->output.data()),
+                       reinterpret_cast<const float*>(in), total_elems,
+                       &m);
+        const int slot = gradstats_.KeySlot(e->name);
+        gradstats_.RecordMoments(slot, m);
+        if (NoteNonfinite(e->name, m, slot)) {
+          nan_abort(e->name);
+          return;
+        }
+      } else {
+        memcpy(e->output.data(), in, nbytes);
+      }
       ScaleBuffer(e->output.data(), total_elems, resp.dtype, e->prescale);
     } else {
       e->output.assign(nbytes, 0);
@@ -2798,6 +3129,19 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
                                  resp.reduce_op);
     }
     data_plane_.EndCompressedOp();
+    if (st.ok() && grad_on) {
+      if (comp != WireCompression::NONE) {
+        gradstats_.RecordQuality(gradstats_.KeySlot(grad_key), comp,
+                                 grad_quality_);
+      }
+      // Fingerprint BEFORE postscale: AVERAGE's 1/size postscale is
+      // per-entry, the pre-postscale reduction is the bitwise-identical
+      // artifact every rank holds.
+      if (resp.reduce_op != ReduceOp::ADASUM) {
+        MaybeGradcheck(e->name, e->output.data(),
+                       static_cast<int64_t>(nbytes));
+      }
+    }
     ObserveOp("ALLREDUCE", NowSeconds() - op_t0, total_bytes,
               data_plane_.last_algo_label(), data_plane_.transport_label(),
               data_plane_.hier_active(), WireCompressionName(comp),
@@ -2818,16 +3162,38 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     return;
   }
 
-  std::vector<uint8_t> fusion(static_cast<size_t>(total_elems) * elem, 0);
+  // ByteBuf: malloc-only sizing — every segment is either copied over
+  // below or explicitly zeroed (zombie stand-ins), so the old whole-buffer
+  // zero-fill pass was pure waste.
+  ByteBuf fusion;
+  fusion.resize(static_cast<size_t>(total_elems) * elem);
 
   int64_t off = 0;
   for (size_t i = 0; i < entries.size(); ++i) {
     TensorEntry* e = entries[i];
     int64_t n = NumElements(resp.shapes[i]);
     if (e->input != nullptr) {
-      memcpy(fusion.data() + off * elem, e->input,
-             static_cast<size_t>(n) * elem);
+      if (grad_on) {
+        // Moments fold into the copy-in the fusion buffer already pays
+        // for — per TENSOR, so each layer keeps its own norm baseline
+        // even inside a fused batch (docs/numerics.md).
+        GradMoments m;
+        CopyMomentsF32(reinterpret_cast<float*>(fusion.data() + off * elem),
+                       reinterpret_cast<const float*>(e->input), n, &m);
+        const int slot = gradstats_.KeySlot(e->name);
+        gradstats_.RecordMoments(slot, m);
+        if (NoteNonfinite(e->name, m, slot)) {
+          nan_abort(e->name);
+          return;
+        }
+      } else {
+        memcpy(fusion.data() + off * elem, e->input,
+               static_cast<size_t>(n) * elem);
+      }
       ScaleBuffer(fusion.data() + off * elem, n, resp.dtype, e->prescale);
+    } else {
+      // Joined rank's zero stand-in: only these segments need zeroing.
+      memset(fusion.data() + off * elem, 0, static_cast<size_t>(n) * elem);
     }
     off += n;
   }
@@ -2840,6 +3206,15 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
                                resp.reduce_op);
   }
   data_plane_.EndCompressedOp();
+  if (st.ok() && grad_on) {
+    if (comp != WireCompression::NONE) {
+      gradstats_.RecordQuality(gradstats_.KeySlot(grad_key), comp,
+                               grad_quality_);
+    }
+    if (resp.reduce_op != ReduceOp::ADASUM) {
+      MaybeGradcheck(grad_key, fusion.data(), total_bytes);
+    }
+  }
   const int64_t op_raw = data_plane_.op_raw_bytes();
   const int64_t op_wire = data_plane_.op_wire_bytes();
   // Fused batches key their perf baseline on the primary tensor plus the
@@ -2867,8 +3242,12 @@ void Core::ExecuteFusedAllreduce(const Response& resp,
     int64_t n = NumElements(resp.shapes[i]);
     if (st.ok()) {
       ScaleBuffer(fusion.data() + off * elem, n, resp.dtype, e->postscale);
-      e->output.assign(fusion.begin() + off * static_cast<int64_t>(elem),
-                       fusion.begin() + (off + n) * static_cast<int64_t>(elem));
+      // resize + memcpy, NOT range-assign: through the ByteBuf's custom
+      // allocator a range copy loses libstdc++'s memmove lowering (see
+      // common.h) — this is the copy-OUT of up to a full fusion batch.
+      e->output.resize(static_cast<size_t>(n) * elem);
+      memcpy(e->output.data(), fusion.data() + off * elem,
+             static_cast<size_t>(n) * elem);
     }
     off += n;
     // Timeline events BEFORE CompleteEntry: completion hands ownership to
@@ -3209,12 +3588,14 @@ int hvdtpu_set_failure_detection(void* core, long long detect_ms,
 
 // Arm one fault injection (HVDTPU_CHAOS -> horovod_tpu/chaos.py; the spec
 // grammar lives in Python, the native side sees resolved integers). action:
-// 0 none, 1 kill, 2 hang, 3 delay, 4 drop. Fires once, at the op_index-th
-// allreduce this rank starts or the hop_index-th pairwise exchange it runs
-// (1-based; 0 = not gated on that counter). Pre-Start() only.
+// 0 none, 1 kill, 2 hang, 3 delay, 4 drop, 5 corrupt (flip one byte of the
+// triggering op's post-allreduce output — the seeded SDC the divergence
+// probe must catch). Fires once, at the op_index-th allreduce this rank
+// starts or the hop_index-th pairwise exchange it runs (1-based; 0 = not
+// gated on that counter). Pre-Start() only.
 int hvdtpu_set_chaos(void* core, int action, long long op_index,
                      long long hop_index, long long delay_ms, int peer) {
-  if (action < 0 || action > 4) return -1;
+  if (action < 0 || action > 5) return -1;
   if (action != 0 && op_index <= 0 && hop_index <= 0) return -1;
   hvdtpu::ChaosSpec spec;
   spec.action = static_cast<hvdtpu::ChaosSpec::Action>(action);
@@ -3365,6 +3746,40 @@ int hvdtpu_profiler_running(void* core) {
 // contract as hvdtpu_metrics_dump. Callable from any thread, live.
 long long hvdtpu_profiler_snapshot(void* core, char* buf, long long buflen) {
   std::string img = static_cast<Core*>(core)->ProfilerSnapshot();
+  if (buf != nullptr && buflen > 0) {
+    long long n = std::min<long long>(buflen, img.size());
+    std::memcpy(buf, img.data(), static_cast<size_t>(n));
+    if (n < buflen) buf[n] = '\0';
+  }
+  return static_cast<long long>(img.size());
+}
+
+// Numerical-health observability (gradstats.h; docs/numerics.md).
+// hvdtpu_set_gradstats: pre-Start() config — enabled toggles the whole
+// subsystem (default on; off compiles every entry point down to one
+// branch), nancheck is the NanPolicy code (0 off, 1 warn, 2 abort; < 0
+// keeps the default warn), gradcheck_sample the divergence probe's
+// every-Nth-op rate (0 disables the probe; < 0 keeps the default 64;
+// must be uniform across ranks), profile_path where Shutdown writes
+// grad_profile.<rank>.json for scripts/grad_diff.py (NULL/empty = skip).
+int hvdtpu_set_gradstats(void* core, int enabled, int nancheck,
+                         long long gradcheck_sample,
+                         const char* profile_path) {
+  if (nancheck > 2) return -1;
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->gradstats = enabled != 0;
+  if (nancheck >= 0) cfg->nancheck = nancheck;
+  if (gradcheck_sample >= 0) cfg->gradcheck_sample = gradcheck_sample;
+  cfg->grad_profile_path = profile_path != nullptr ? profile_path : "";
+  return 0;
+}
+
+// Keyed numerical-health snapshot as JSON (horovod_tpu/gradstats.py
+// decodes it — hvd.grad_report() and the /gradz endpoint's data source).
+// Same probe-then-copy contract as hvdtpu_metrics_dump. Callable any
+// thread.
+long long hvdtpu_gradstats_snapshot(void* core, char* buf, long long buflen) {
+  std::string img = static_cast<Core*>(core)->GradSnapshot();
   if (buf != nullptr && buflen > 0) {
     long long n = std::min<long long>(buflen, img.size());
     std::memcpy(buf, img.data(), static_cast<size_t>(n));

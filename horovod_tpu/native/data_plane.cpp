@@ -822,6 +822,16 @@ void DataPlane::FireChaos(int peer_hint) {
       std::this_thread::sleep_for(
           std::chrono::milliseconds(chaos_.delay_ms));
       return;
+    case ChaosSpec::Action::CORRUPT:
+      // Deferred: the byte flips AFTER this op's reduction completes (see
+      // Allreduce) so the corruption lands in the post-allreduce output
+      // the divergence probe fingerprints — a pre-reduce flip would just
+      // change the (still bitwise-consistent) sum on every rank.
+      fprintf(stderr,
+              "[hvdtpu %d] CHAOS: corrupting this op's output (op %lld)\n",
+              rank_, static_cast<long long>(chaos_ops_));
+      corrupt_pending_ = true;
+      return;
     case ChaosSpec::Action::DROP: {
       // An op trigger has no hop peer yet (peer_hint == -1): blackhole the
       // ring neighbor so `drop@op=N` injects a real partition instead of
@@ -1044,6 +1054,13 @@ Status DataPlane::Allreduce(void* data, int64_t count, DataType dtype,
   raw_bytes_total_->Add(op_raw_bytes_);
   wire_bytes_total_->Add(op_wire_bytes_);
   PublishZeroCopyCounters();
+  if (corrupt_pending_ && st.ok()) {
+    // Seeded silent data corruption (HVDTPU_CHAOS corrupt@op=N): one byte
+    // of THIS rank's reduced output flips, exactly the bitwise divergence
+    // the gradcheck fingerprint probe exists to catch (docs/numerics.md).
+    corrupt_pending_ = false;
+    static_cast<uint8_t*>(data)[0] ^= 0x01;
+  }
   return st;
 }
 
@@ -1121,7 +1138,7 @@ Status DataPlane::CompressedRingReduceScatter(
       WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
                    op_residual_ != nullptr ? op_residual_ + starts[send_c]
                                            : nullptr,
-                   nullptr);
+                   nullptr, op_quality_);
     }
     TraceHop("QUANTIZE", -1, -1, sc * 4, qt0, io_ctl_.WaitUs());
     AddOpBytes(sc * 4, sw);
@@ -1165,7 +1182,7 @@ Status DataPlane::CompressedRingAllgather(float* buf,
     WireCompress(c, buf + starts[own_c], chunk_count(own_c), cur.data(),
                  op_residual_ != nullptr ? op_residual_ + starts[own_c]
                                          : nullptr,
-                 buf + starts[own_c]);
+                 buf + starts[own_c], op_quality_);
   }
   TraceHop("QUANTIZE", -1, -1, chunk_count(own_c) * 4, qt0,
            io_ctl_.WaitUs());
@@ -1207,7 +1224,8 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
   // Fold: extra members ship their contribution quantized (uplink), the
   // partner dequantizes + accumulates.
   if (gi >= p) {
-    WireCompress(c, data, count, send_wire.data(), op_residual_, nullptr);
+    WireCompress(c, data, count, send_wire.data(), op_residual_, nullptr,
+                 op_quality_);
     AddOpBytes(raw_bytes, wb);
     Status st = SendTo(group[gi - p], send_wire.data(), wb, "rd fold send");
     if (!st.ok()) return st;
@@ -1225,7 +1243,8 @@ Status DataPlane::CompressedRecursiveDoubling(float* data, int64_t count,
       const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
       {
         ProfPhaseScope prof_codec(PerfPhase::CODEC);
-        WireCompress(c, data, count, send_wire.data(), op_residual_, data);
+        WireCompress(c, data, count, send_wire.data(), op_residual_, data,
+                     op_quality_);
       }
       TraceHop("QUANTIZE", -1, -1, raw_bytes, qt0, io_ctl_.WaitUs());
       AddOpBytes(raw_bytes, wb);
@@ -1570,7 +1589,7 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
 
 Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
                              const std::vector<int64_t>& block_bytes,
-                             std::vector<uint8_t>* out) {
+                             ByteBuf* out) {
   BeginOpTrace();
   std::vector<int64_t> offsets(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) offsets[r + 1] = offsets[r] + block_bytes[r];
@@ -1612,7 +1631,7 @@ Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
 Status DataPlane::Alltoallv(const void* in,
                             const std::vector<int64_t>& send_bytes,
                             const std::vector<int64_t>& recv_bytes,
-                            std::vector<uint8_t>* out) {
+                            ByteBuf* out) {
   BeginOpTrace();
   std::vector<int64_t> send_off(size_ + 1, 0), recv_off(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) {
@@ -1743,7 +1762,7 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
 }
 
 Status DataPlane::ReduceScatter(const void* in, int64_t count, DataType dtype,
-                                ReduceOp op, std::vector<uint8_t>* out) {
+                                ReduceOp op, ByteBuf* out) {
   // Simple implementation on top of ring allreduce: reduce a copy, keep my
   // chunk. (A dedicated reduce-scatter would halve traffic; the coordinator
   // only dispatches small eager tensors here — the compiled path owns the hot

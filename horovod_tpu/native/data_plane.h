@@ -78,10 +78,13 @@ enum class HierMode : int32_t {
 struct ChaosSpec {
   enum class Action : int32_t {
     NONE = 0,
-    KILL = 1,   // raise(SIGKILL): abrupt rank death mid-schedule
-    HANG = 2,   // wedge the collective thread forever (live but silent)
-    DELAY = 3,  // one-shot sleep of delay_ms (must NOT trip detection)
-    DROP = 4,   // blackhole one peer lane (partition: silent, no EOF)
+    KILL = 1,     // raise(SIGKILL): abrupt rank death mid-schedule
+    HANG = 2,     // wedge the collective thread forever (live but silent)
+    DELAY = 3,    // one-shot sleep of delay_ms (must NOT trip detection)
+    DROP = 4,     // blackhole one peer lane (partition: silent, no EOF)
+    CORRUPT = 5,  // flip one byte of the op's post-allreduce output —
+                  // seeded silent data corruption the divergence probe
+                  // (docs/numerics.md) must catch. op trigger only.
   };
   Action action = Action::NONE;
   int64_t op_index = 0;   // 0 = not op-gated
@@ -215,13 +218,21 @@ class DataPlane {
   // the ring and recursive-doubling paths (tree and the hierarchical
   // intra-host/gather stages stay raw; hier compresses the leader phase —
   // the slow cross-host link, the reference fork's premise).
-  void BeginCompressedOp(WireCompression c, float* residual) {
+  // quality (nullable): per-op quantization-quality accumulator
+  // (gradstats.h) threaded into every WireCompress call this op makes —
+  // the core reads MSE/SNR/residual-norm out of it at op completion
+  // (docs/numerics.md).
+  void BeginCompressedOp(WireCompression c, float* residual,
+                         GradQuality* quality = nullptr) {
     op_comp_ = c == WireCompression::AUTO ? WireCompression::NONE : c;
     op_residual_ = residual;
+    op_quality_ = quality;
+    if (quality != nullptr) quality->Reset();
   }
   void EndCompressedOp() {
     op_comp_ = WireCompression::NONE;
     op_residual_ = nullptr;
+    op_quality_ = nullptr;
   }
 
   // Payload accounting for the timeline's per-op raw_bytes/wire_bytes args
@@ -284,7 +295,7 @@ class DataPlane {
   // rank order. block_bytes[r] gives each rank's contribution size.
   Status Allgatherv(const void* in, int64_t in_bytes,
                     const std::vector<int64_t>& block_bytes,
-                    std::vector<uint8_t>* out);
+                    ByteBuf* out);
 
   Status Broadcast(void* data, int64_t bytes, int root);
 
@@ -292,12 +303,12 @@ class DataPlane {
   // in rank order); recv_bytes[r] received from rank r into out (rank order).
   Status Alltoallv(const void* in, const std::vector<int64_t>& send_bytes,
                    const std::vector<int64_t>& recv_bytes,
-                   std::vector<uint8_t>* out);
+                   ByteBuf* out);
 
   // Reduce then keep this rank's contiguous chunk (count must divide evenly;
   // validated by the coordinator before dispatch).
   Status ReduceScatter(const void* in, int64_t count, DataType dtype,
-                       ReduceOp op, std::vector<uint8_t>* out);
+                       ReduceOp op, ByteBuf* out);
 
   // In-place Adasum reduction (float32/float64): hypercube pairwise exchange
   // with the adaptive combine a*(1 - dot/2|a|^2) + b*(1 - dot/2|b|^2)
@@ -462,6 +473,11 @@ class DataPlane {
   int64_t chaos_ops_ = 0;
   int64_t chaos_hops_ = 0;
   int blackholed_peer_ = -1;
+  // CORRUPT fired at this op's entry: flip one output byte AFTER the
+  // reduction completes (the corruption must be in the post-allreduce
+  // buffer the divergence probe fingerprints, not in an input a correct
+  // reduction would overwrite).
+  bool corrupt_pending_ = false;
 
   // Distributed-tracing state (background thread only, like the chaos
   // counters): the core's timeline as span sink, the every-Nth-op sampler,
@@ -494,6 +510,7 @@ class DataPlane {
   // cross-thread).
   WireCompression op_comp_ = WireCompression::NONE;
   float* op_residual_ = nullptr;
+  GradQuality* op_quality_ = nullptr;
   int64_t op_raw_bytes_ = 0;
   int64_t op_wire_bytes_ = 0;
   const char* last_algo_label_ = "none";

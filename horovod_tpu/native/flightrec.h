@@ -54,6 +54,10 @@ enum class FlightEvent : int32_t {
   MARK = 13,         // user marker (reserved for the Python API)
   ANOMALY = 14,      // perf sentry: op past its baseline (arg = PerfPhase
                      // code, send_peer = slow hop peer for wire-slow)
+  NONFINITE = 15,    // NaN/Inf gradient at fusion copy-in (name = tensor,
+                     // bytes = non-finite element count, arg = NanPolicy)
+  DIVERGENCE = 16,   // cross-rank fingerprint mismatch (send_peer = the
+                     // minority rank, arg = its crc32c, bytes = payload)
 };
 
 // Why a dump was written. Mirrored in horovod_tpu/flightrec.py DUMP_REASONS.
@@ -62,6 +66,7 @@ enum class DumpReason : int32_t {
   ABORT = 1,      // abort cascade (detail = suspected failed peer, -1 none)
   STALL = 2,      // stall-shutdown escalation
   SIGNAL = 3,     // fatal signal (detail = signo)
+  NONFINITE = 4,  // HVDTPU_NANCHECK=abort fail-fast (detail = this rank)
 };
 
 // One decoded record (the ring stores these packed into kRecordWords

@@ -2640,6 +2640,292 @@ void TestProfilerSigprofStormDuringFlightDump() {
   CHECK_TRUE(sigismember(&current.sa_mask, SIGPROF) == 1);
 }
 
+// --- numerical-health telemetry (gradstats.h; docs/numerics.md) -------------
+
+void TestCrc32cKnownAnswers() {
+  // RFC 3720 B.4 test vectors (Castagnoli polynomial).
+  const uint8_t zeros[32] = {0};
+  CHECK_TRUE(Crc32c(zeros, 32) == 0x8a9136aau);
+  uint8_t ones[32];
+  memset(ones, 0xff, sizeof(ones));
+  CHECK_TRUE(Crc32c(ones, 32) == 0x62a8ab43u);
+  uint8_t inc[32];
+  for (int i = 0; i < 32; ++i) inc[i] = static_cast<uint8_t>(i);
+  CHECK_TRUE(Crc32c(inc, 32) == 0x46dd794eu);
+  // "123456789" is the classic check value for CRC-32C: 0xe3069283.
+  CHECK_TRUE(Crc32c("123456789", 9) == 0xe3069283u);
+  // One flipped byte MUST change the fingerprint (the divergence probe's
+  // whole premise, and exactly what chaos corrupt@op injects).
+  uint8_t flipped[32] = {0};
+  flipped[0] ^= 0x01;
+  CHECK_TRUE(Crc32c(flipped, 32) != Crc32c(zeros, 32));
+}
+
+void TestMomentsCountNanInfAndNorm() {
+  std::vector<float> v(1027, 0.0f);  // odd size: exercises the scalar tail
+  for (size_t i = 0; i < v.size(); ++i) v[i] = (i % 2 == 0) ? 3.0f : -4.0f;
+  GradMoments m;
+  MomentsF32(v.data(), static_cast<int64_t>(v.size()), &m);
+  CHECK_TRUE(m.count == static_cast<int64_t>(v.size()));
+  CHECK_TRUE(m.nonfinite == 0);
+  CHECK_TRUE(std::fabs(m.absmax - 4.0) < 1e-9);
+  const double want = 514 * 9.0 + 513 * 16.0;
+  CHECK_TRUE(std::fabs(m.sumsq - want) < 1e-6 * want);
+  // NaN/Inf lanes are COUNTED, not folded into the norm: one bad element
+  // must not erase the other thousand's magnitude.
+  v[7] = std::numeric_limits<float>::quiet_NaN();
+  v[900] = std::numeric_limits<float>::infinity();
+  v[1024] = -std::numeric_limits<float>::infinity();  // in the scalar tail
+  GradMoments m2;
+  MomentsF32(v.data(), static_cast<int64_t>(v.size()), &m2);
+  CHECK_TRUE(m2.nonfinite == 3);
+  CHECK_TRUE(std::isfinite(m2.sumsq));
+  CHECK_TRUE(std::fabs(m2.absmax - 4.0) < 1e-9);
+  CHECK_TRUE(m2.sumsq < m.sumsq && m2.sumsq > 0.9 * m.sumsq);
+}
+
+void TestCopyMomentsMatchesMemcpyAndScan() {
+  std::vector<float> src(4099);
+  for (size_t i = 0; i < src.size(); ++i) {
+    src[i] = std::sin(static_cast<double>(i)) * 7.5f;
+  }
+  src[17] = std::numeric_limits<float>::quiet_NaN();
+  std::vector<float> dst(src.size(), -1.0f);
+  GradMoments mc;
+  CopyMomentsF32(dst.data(), src.data(),
+                 static_cast<int64_t>(src.size()), &mc);
+  CHECK_TRUE(memcmp(dst.data(), src.data(), src.size() * 4) == 0);
+  GradMoments ms;
+  MomentsF32(src.data(), static_cast<int64_t>(src.size()), &ms);
+  CHECK_TRUE(mc.count == ms.count);
+  CHECK_TRUE(mc.nonfinite == ms.nonfinite && mc.nonfinite == 1);
+  CHECK_TRUE(std::fabs(mc.sumsq - ms.sumsq) < 1e-6 * (ms.sumsq + 1));
+  CHECK_TRUE(mc.absmax == ms.absmax);
+  // Streaming-store path (buffers past the NT threshold, including an
+  // odd tail and a deliberately misaligned destination): bitwise-equal
+  // copy, identical moments.
+  const int64_t big = (4 << 20) / 4 + 13;
+  std::vector<float> bsrc(static_cast<size_t>(big));
+  for (int64_t i = 0; i < big; ++i) {
+    bsrc[static_cast<size_t>(i)] = std::sin(static_cast<double>(i)) * 3.0f;
+  }
+  bsrc[12345] = std::numeric_limits<float>::infinity();
+  std::vector<float> bdst(static_cast<size_t>(big) + 1, -7.0f);
+  GradMoments mb;
+  CopyMomentsF32(bdst.data() + 1, bsrc.data(), big, &mb);  // unaligned dst
+  CHECK_TRUE(memcmp(bdst.data() + 1, bsrc.data(),
+                    static_cast<size_t>(big) * 4) == 0);
+  GradMoments mbs;
+  MomentsF32(bsrc.data(), big, &mbs);
+  CHECK_TRUE(mb.count == big && mb.nonfinite == 1);
+  CHECK_TRUE(std::fabs(mb.sumsq - mbs.sumsq) < 1e-9 * (mbs.sumsq + 1));
+  CHECK_TRUE(mb.absmax == mbs.absmax);
+  // ByteBuf (default-init allocator): resize must not zero — fill, shrink,
+  // regrow, and the old bytes reappear (proving no value-init pass runs).
+  ByteBuf bb;
+  bb.resize(64);
+  memset(bb.data(), 0xAB, 64);
+  bb.resize(0);
+  bb.resize(64);
+  CHECK_TRUE(bb[0] == 0xAB && bb[63] == 0xAB);
+}
+
+void TestWireCompressQualityAccumulation() {
+  // Quality rides the quantize kernels: err2/sig2 must reflect the actual
+  // round-trip error, so coarser codes score strictly lower SNR.
+  std::vector<float> src(2000);
+  for (size_t i = 0; i < src.size(); ++i) {
+    src[i] = std::cos(static_cast<double>(i) * 0.37) * 2.0f + 0.1f;
+  }
+  auto snr_of = [&](WireCompression c) {
+    std::vector<uint8_t> wire(
+        static_cast<size_t>(WireBytes(c, src.size())));
+    std::vector<float> decoded(src.size());
+    GradQuality q;
+    WireCompress(c, src.data(), static_cast<int64_t>(src.size()),
+                 wire.data(), nullptr, nullptr, &q);
+    CHECK_TRUE(q.count == static_cast<int64_t>(src.size()));
+    CHECK_TRUE(q.sig2 > 0);
+    // Cross-check err2 against an explicit decode pass.
+    WireDecompress(c, wire.data(), static_cast<int64_t>(src.size()),
+                   decoded.data());
+    double err2 = 0;
+    for (size_t i = 0; i < src.size(); ++i) {
+      const double d = src[i] - decoded[i];
+      err2 += d * d;
+    }
+    CHECK_TRUE(std::fabs(q.err2 - err2) < 1e-6 * (err2 + 1e-12));
+    return q.err2 > 0 ? 10.0 * std::log10(q.sig2 / q.err2) : 1e9;
+  };
+  const double snr_fp16 = snr_of(WireCompression::FP16);
+  const double snr_int8 = snr_of(WireCompression::INT8);
+  const double snr_int4 = snr_of(WireCompression::INT4);
+  CHECK_TRUE(snr_fp16 > snr_int8);
+  CHECK_TRUE(snr_int8 > snr_int4);
+  CHECK_TRUE(snr_int4 > 0);
+  // With residual error feedback active, err2 equals the post-op residual
+  // content (residual[i] = x - deq): the residual-norm telemetry contract.
+  std::vector<float> residual(src.size(), 0.0f);
+  std::vector<uint8_t> wire(
+      static_cast<size_t>(WireBytes(WireCompression::INT8, src.size())));
+  GradQuality q;
+  WireCompress(WireCompression::INT8, src.data(),
+               static_cast<int64_t>(src.size()), wire.data(),
+               residual.data(), nullptr, &q);
+  double res2 = 0;
+  for (float r : residual) res2 += static_cast<double>(r) * r;
+  CHECK_TRUE(std::fabs(q.err2 - res2) < 1e-6 * (res2 + 1e-12));
+}
+
+void TestResidualStoreResetReporting() {
+  ResidualStore store;
+  bool reset = true;
+  float* a = store.Get("w", 100, &reset);
+  CHECK_TRUE(a != nullptr);
+  CHECK_TRUE(!reset);  // first use is not a reset
+  a[0] = 1.5f;
+  CHECK_TRUE(store.Get("w", 100, &reset) == a && !reset);
+  CHECK_TRUE(a[0] == 1.5f);  // steady state keeps the feedback
+  // Element count changed on a live key (reshape / refused fusion): the
+  // feedback restarts from zero AND the caller is told.
+  float* b = store.Get("w", 64, &reset);
+  CHECK_TRUE(reset);
+  CHECK_TRUE(b[0] == 0.0f);
+  CHECK_TRUE(store.TotalBytes() == 64 * 4);
+  // Cap overflow clears every live key: also a reset (fresh store so the
+  // clear fires exactly at the probe, not mid-fill).
+  ResidualStore full;
+  for (size_t i = 0; i < ResidualStore::kMaxEntries; ++i) {
+    full.Get("k" + std::to_string(i), 8, nullptr);
+  }
+  full.Get("one-more", 8, &reset);
+  CHECK_TRUE(reset);
+}
+
+void TestGradStatsSlotsAndSnapshot() {
+  GradStats gs;
+  gs.Configure(true, NanPolicy::WARN, 16);
+  CHECK_TRUE(gs.enabled());
+  CHECK_TRUE(gs.nan_policy() == NanPolicy::WARN);
+  CHECK_TRUE(gs.gradcheck_sample() == 16);
+  const int s1 = gs.KeySlot("layer/w");
+  const int s2 = gs.KeySlot("layer/bias");
+  CHECK_TRUE(s1 >= 1 && s2 >= 1 && s1 != s2);
+  CHECK_TRUE(gs.KeySlot("layer/w") == s1);
+  GradMoments m;
+  m.sumsq = 16.0;
+  m.absmax = 3.0;
+  m.count = 10;
+  gs.RecordMoments(s1, m);
+  gs.RecordMoments(s2, m);
+  GradQuality q;
+  q.err2 = 1.0;
+  q.sig2 = 100.0;
+  q.count = 10;
+  gs.RecordQuality(s1, WireCompression::INT4, q);
+  gs.NoteNonfinite(2);
+  gs.NoteProbe();
+  gs.NoteDivergence();
+  gs.NoteResidualReset();
+  const GradSlot* sl = gs.slot(s1);
+  CHECK_TRUE(sl != nullptr);
+  CHECK_TRUE(std::fabs(sl->pub_norm.load() - 4.0) < 1e-9);
+  CHECK_TRUE(std::fabs(sl->pub_snr_db.load() - 20.0) < 1e-9);
+  CHECK_TRUE(std::fabs(sl->pub_res_norm.load() - 1.0) < 1e-9);
+  const std::string json = gs.SnapshotJson();
+  // Shape: totals + both keys; SNR fields ONLY on the compressed key —
+  // the bias slot (never quantized) must stay absent from the SNR report.
+  CHECK_TRUE(json.find("\"nonfinite_total\": 2") != std::string::npos);
+  CHECK_TRUE(json.find("\"probes_total\": 1") != std::string::npos);
+  CHECK_TRUE(json.find("\"divergence_total\": 1") != std::string::npos);
+  CHECK_TRUE(json.find("\"residual_resets_total\": 1") != std::string::npos);
+  CHECK_TRUE(json.find("\"nancheck\": \"warn\"") != std::string::npos);
+  CHECK_TRUE(json.find("layer/w") != std::string::npos);
+  CHECK_TRUE(json.find("layer/bias") != std::string::npos);
+  const size_t bias_at = json.find("layer/bias");
+  const size_t w_at = json.find("\"key\": \"layer/w\"");
+  const size_t snr_at = json.find("\"snr_db\":");
+  CHECK_TRUE(snr_at != std::string::npos);
+  // Exactly one snr_db field (only the quantized key carries one).
+  CHECK_TRUE(json.find("\"snr_db\":", snr_at + 1) == std::string::npos);
+  CHECK_TRUE(json.find("\"compression\": \"int4\"") != std::string::npos);
+  (void)bias_at;
+  (void)w_at;
+  // Key overflow: past the cap everything shares slot 0.
+  for (int i = 0; i < kGradMaxKeys + 8; ++i) {
+    gs.KeySlot("overflow/" + std::to_string(i));
+  }
+  CHECK_TRUE(gs.KeySlot("one-more") == 0);
+}
+
+void TestGradStatsNonfiniteWarnThrottle() {
+  // A NaN-flooded tensor warns (and flight-records) at most once per
+  // window PER KEY; a second key's first event is never starved.
+  GradStats gs;
+  gs.Configure(true, NanPolicy::WARN, 0);
+  const int s1 = gs.KeySlot("flood/w");
+  const int s2 = gs.KeySlot("other/w");
+  CHECK_TRUE(gs.ShouldWarnNonfinite(s1, 1000));      // first always passes
+  CHECK_TRUE(!gs.ShouldWarnNonfinite(s1, 500000));   // inside the window
+  CHECK_TRUE(gs.ShouldWarnNonfinite(s2, 600000));    // other key unstarved
+  CHECK_TRUE(gs.ShouldWarnNonfinite(s1, 1000 + 1000000));  // window over
+  CHECK_TRUE(!gs.ShouldWarnNonfinite(-1, 0));        // bad slot: quiet
+}
+
+void TestGradStatsDisabledIsNoop() {
+  GradStats gs;
+  gs.Configure(false, NanPolicy::ABORT, 4);
+  CHECK_TRUE(!gs.enabled());
+  CHECK_TRUE(gs.KeySlot("x") == 0);
+  GradMoments m;
+  m.count = 1;
+  gs.RecordMoments(0, m);  // must not crash with no slot storage
+  const std::string json = gs.SnapshotJson();
+  CHECK_TRUE(json.find("\"enabled\": false") != std::string::npos);
+}
+
+void TestGradStatsConcurrentWritersAndReader() {
+  // TSan fixture: four writers hammer two slots while a reader snapshots
+  // — same weak-consistency contract as PerfStats (torn sets, never torn
+  // values, never a crash).
+  GradStats gs;
+  gs.Configure(true, NanPolicy::WARN, 8);
+  const int s1 = gs.KeySlot("a");
+  const int s2 = gs.KeySlot("b");
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> writers;
+  for (int t = 0; t < 4; ++t) {
+    writers.emplace_back([&, t] {
+      GradMoments m;
+      m.sumsq = 4.0 + t;
+      m.absmax = 2.0;
+      m.count = 8;
+      GradQuality q;
+      q.err2 = 0.5;
+      q.sig2 = 50.0;
+      q.count = 8;
+      for (int i = 0; i < 2000; ++i) {
+        gs.RecordMoments(t % 2 == 0 ? s1 : s2, m);
+        gs.RecordQuality(t % 2 == 0 ? s1 : s2, WireCompression::INT8, q);
+        gs.NoteNonfinite(1);
+      }
+    });
+  }
+  std::thread reader([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      const std::string json = gs.SnapshotJson();
+      CHECK_TRUE(json.find("\"keys\": [") != std::string::npos);
+    }
+  });
+  for (auto& w : writers) w.join();
+  stop.store(true, std::memory_order_relaxed);
+  reader.join();
+  CHECK_TRUE(gs.nonfinite_total() == 4 * 2000);
+  CHECK_TRUE(gs.slot(s1)->count.load() +
+                 gs.slot(s2)->count.load() ==
+             4 * 2000);
+}
+
 }  // namespace
 }  // namespace hvdtpu
 
@@ -2715,6 +3001,15 @@ int main() {
   TestProfilerSamplesTaggedByPhaseAndOp();
   TestProfilerWallClockSamplesBlockedThread();
   TestProfilerSigprofStormDuringFlightDump();
+  TestCrc32cKnownAnswers();
+  TestMomentsCountNanInfAndNorm();
+  TestCopyMomentsMatchesMemcpyAndScan();
+  TestWireCompressQualityAccumulation();
+  TestResidualStoreResetReporting();
+  TestGradStatsSlotsAndSnapshot();
+  TestGradStatsNonfiniteWarnThrottle();
+  TestGradStatsDisabledIsNoop();
+  TestGradStatsConcurrentWritersAndReader();
   if (failures == 0) {
     std::printf("native unit tests: ALL OK\n");
     return 0;

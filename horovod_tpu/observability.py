@@ -163,6 +163,9 @@ ENDPOINT_PATHS = {
     # Live perf attribution (docs/observability.md): the streaming per-key
     # baselines + anomaly counts as JSON.
     "/perfz": ("application/json", "metrics_perfz_fn"),
+    # Numerical health (docs/numerics.md): per-tensor gradient norms,
+    # per-key quantization SNR, NaN/divergence totals as JSON.
+    "/gradz": ("application/json", "metrics_gradz_fn"),
     # Sampling profiler (docs/profiling.md): folded-stacks JSON;
     # ?start / ?stop open and close the sampling window.
     "/profz": ("application/json", "metrics_profz_fn"),
@@ -214,7 +217,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
 class MetricsServer:
     """Threaded HTTP server for one worker's observability endpoints
-    (``ENDPOINT_PATHS``: /metrics, /healthz, /debugz, /perfz, /profz).
+    (``ENDPOINT_PATHS``: /metrics, /healthz, /debugz, /perfz, /profz,
+    /gradz).
 
     ``dump_fn()`` returns the exposition text (the native registry dump);
     ``health`` is a static dict merged into the ``/healthz`` JSON (rank,
@@ -229,7 +233,8 @@ class MetricsServer:
                  health: Optional[dict] = None,
                  debugz_fn: Optional[Callable[[], str]] = None,
                  perfz_fn: Optional[Callable[[], str]] = None,
-                 profz_fn: Optional[Callable[[str], str]] = None):
+                 profz_fn: Optional[Callable[[str], str]] = None,
+                 gradz_fn: Optional[Callable[[], str]] = None):
         self._server = ThreadingHTTPServer(("0.0.0.0", port),
                                            _MetricsHandler)
 
@@ -247,6 +252,7 @@ class MetricsServer:
         srv.metrics_debugz_fn = ignore_query(debugz_fn)  # type: ignore[attr-defined]
         srv.metrics_perfz_fn = ignore_query(perfz_fn)  # type: ignore[attr-defined]
         srv.metrics_profz_fn = profz_fn  # type: ignore[attr-defined]
+        srv.metrics_gradz_fn = ignore_query(gradz_fn)  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -178,6 +178,25 @@ def build_verdict(dumps: Dict[int, FlightDump],
                 dead.append({"rank": r, "how": _SIGNAMES.get(
                     info["detail"], f"signal {info['detail']}"),
                     "evidence": "own fatal-signal dump"})
+    # HVDTPU_NANCHECK=abort fail-fasts dump with reason "nonfinite": the
+    # rank broke the world BY POLICY, and its own ring carries the
+    # NONFINITE record naming the offending tensor (docs/numerics.md).
+    nonfinite: List[dict] = []
+    for r, d in sorted(dumps.items()):
+        if d.reason != "nonfinite":
+            continue
+        tensor = None
+        for ev in reversed(d.events):
+            if ev.type == "nonfinite":
+                tensor = ev.name
+                break
+        nonfinite.append({"rank": r, "tensor": tensor})
+        if not any(x["rank"] == r for x in dead):
+            where = f" in tensor '{tensor}'" if tensor else ""
+            dead.append({"rank": r,
+                         "how": f"aborted on a non-finite gradient{where} "
+                                "(HVDTPU_NANCHECK=abort)",
+                         "evidence": "own NONFINITE dump"})
     # Ranks with no dump at all: SIGKILLed / lost before any handler ran —
     # unless they ran on a REMOTE host, where a missing dump just means it
     # was never copied here (uncollected, not dead).
@@ -246,6 +265,7 @@ def build_verdict(dumps: Dict[int, FlightDump],
         "world_size": world,
         "ranks_dumped": sorted(present),
         "dead": sorted(dead, key=lambda d: d["rank"]),
+        "nonfinite": nonfinite,
         "terminated": sorted(terminated),
         "uncollected": uncollected,
         "topology_known": local_ranks is not None,
@@ -268,6 +288,13 @@ def format_verdict(verdict: dict) -> str:
     else:
         out.append("  no dead rank identified (clean shutdown or "
                    "on-demand dumps)")
+    for nf in verdict.get("nonfinite", []):
+        tensor = nf.get("tensor")
+        out.append(
+            f"  non-finite gradient: rank {nf['rank']} tripped "
+            f"HVDTPU_NANCHECK=abort"
+            + (f" on tensor '{tensor}'" if tensor else "")
+            + " — numerical divergence, not a systems failure")
     if verdict["stalled_coordinator"]:
         out.append(f"  stall escalation: coordinator rank(s) "
                    f"{verdict['stalled_coordinator']} broke the world after "

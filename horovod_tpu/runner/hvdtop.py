@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..gradstats import parse_snapshot as parse_grad_snapshot
+from ..gradstats import worst_snr
 from ..observability import parse_prometheus_text, sample_value, scrape
 from ..perfstats import find_straggler, parse_snapshot
 
@@ -29,29 +31,37 @@ FramePrev = Tuple[float, Dict[int, float]]
 
 def scrape_rank(host: str, port: int,
                 secret: Optional[str]) -> Tuple[Optional[dict],
+                                                Optional[dict],
                                                 Optional[dict]]:
-    """(parsed /metrics, parsed /perfz) for one worker; (None, None) when
-    unreachable, (parsed, None) when only /perfz is absent (older build)."""
+    """(parsed /metrics, parsed /perfz, parsed /gradz) for one worker;
+    all-None when unreachable, (parsed, None, None) when only the newer
+    endpoints are absent (older build)."""
     try:
         parsed = parse_prometheus_text(
             scrape(host, port, secret=secret, timeout=3.0))
     except Exception:
-        return None, None
+        return None, None, None
     try:
         perf = parse_snapshot(
             scrape(host, port, path="/perfz", secret=secret, timeout=3.0))
     except Exception:
         perf = None
-    return parsed, perf
+    try:
+        grad = parse_grad_snapshot(
+            scrape(host, port, path="/gradz", secret=secret, timeout=3.0))
+    except Exception:
+        grad = None
+    return parsed, perf, grad
 
 
 def scrape_all(endpoints: Dict[int, Tuple[str, int]],
                secret: Optional[str]
-               ) -> Tuple[Dict[int, dict], Dict[int, dict]]:
+               ) -> Tuple[Dict[int, dict], Dict[int, dict], Dict[int, dict]]:
     from concurrent.futures import ThreadPoolExecutor
 
     metrics_by_rank: Dict[int, dict] = {}
     perf_by_rank: Dict[int, dict] = {}
+    grad_by_rank: Dict[int, dict] = {}
 
     def one(item):
         rank, (host, port) = item
@@ -59,22 +69,40 @@ def scrape_all(endpoints: Dict[int, Tuple[str, int]],
 
     with ThreadPoolExecutor(
             max_workers=min(16, max(1, len(endpoints)))) as pool:
-        for rank, (parsed, perf) in pool.map(one, endpoints.items()):
+        for rank, (parsed, perf, grad) in pool.map(one, endpoints.items()):
             if parsed is not None:
                 metrics_by_rank[rank] = parsed
             if perf is not None:
                 perf_by_rank[rank] = perf
-    return metrics_by_rank, perf_by_rank
+            if grad is not None:
+                grad_by_rank[rank] = grad
+    return metrics_by_rank, perf_by_rank, grad_by_rank
 
 
 def render_frame(endpoints: Dict[int, Tuple[str, int]],
                  metrics_by_rank: Dict[int, dict],
                  perf_by_rank: Dict[int, dict],
                  prev: Optional[FramePrev],
-                 now: float) -> Tuple[str, FramePrev]:
+                 now: float,
+                 grad_by_rank: Optional[Dict[int, dict]] = None
+                 ) -> Tuple[str, FramePrev]:
     """One console frame (pure — the CI smoke and unit tests drive it with
     canned scrapes). Returns (text, new_prev)."""
     ops_now: Dict[int, float] = {}
+    grad_by_rank = grad_by_rank or {}
+    # Divergence convictions live on the coordinator's registry as
+    # hvdtpu_divergence_total{suspect="R"}: collect every named suspect so
+    # the MINORITY rank's row carries the DIV flag, not rank 0's.
+    div_suspects: Dict[int, float] = {}
+    for parsed in metrics_by_rank.values():
+        for (suf, lbls, v) in parsed.get(
+                "hvdtpu_divergence_total", {}).get("samples", []):
+            if suf == "" and v > 0 and "suspect" in lbls:
+                try:
+                    r = int(lbls["suspect"])
+                except ValueError:
+                    continue
+                div_suspects[r] = div_suspects.get(r, 0) + v
     header = (f"  {'rank':>4} {'host':<18} {'ops/s':>7} {'wire':>6} "
               f"{'anom':>5} {'clk±us':>7} {'stall':>5}  status")
     lines = [f"hvdtop — {len(metrics_by_rank)}/{len(endpoints)} ranks up "
@@ -83,8 +111,13 @@ def render_frame(endpoints: Dict[int, Tuple[str, int]],
         host = endpoints[rank][0]
         parsed = metrics_by_rank.get(rank)
         if parsed is None:
+            # A divergence conviction lives on the COORDINATOR's scrape, so
+            # it can flag a rank whose own endpoint is down (a corrupted
+            # rank may well be dying) — keep the DIV marker visible.
+            status = "UNREACHABLE DIV" if div_suspects.get(rank, 0) > 0 \
+                else "UNREACHABLE"
             lines.append(f"  {rank:>4} {host:<18} {'-':>7} {'-':>6} "
-                         f"{'-':>5} {'-':>7} {'-':>5}  UNREACHABLE")
+                         f"{'-':>5} {'-':>7} {'-':>5}  {status}")
             continue
         ops = sum(v for (suf, _l, v)
                   in parsed.get("hvdtpu_ops_total", {}).get("samples", [])
@@ -112,6 +145,13 @@ def render_frame(endpoints: Dict[int, Tuple[str, int]],
             flags.append("STALL")
         if clock_err is not None and clock_err > 10000:
             flags.append("CLKDRIFT")  # alignment degraded past 10 ms
+        # Numerical health (docs/numerics.md): NAN = this rank saw
+        # non-finite gradient elements; DIV = the divergence probe
+        # convicted this rank's post-allreduce output as the minority.
+        if (sample_value(parsed, "hvdtpu_nonfinite_grads_total") or 0) > 0:
+            flags.append("NAN")
+        if div_suspects.get(rank, 0) > 0:
+            flags.append("DIV")
         lines.append(
             f"  {rank:>4} {host:<18} {rate:>7} {ratio:>6} "
             f"{int(anomalies):>5} {clk:>7} {'yes' if stalled else 'no':>5}"
@@ -126,6 +166,18 @@ def render_frame(endpoints: Dict[int, Tuple[str, int]],
                straggler["anomalies"] else "") + ")")
     else:
         lines.append("  straggler: n/a (no /perfz data yet)")
+    # Worst compressed-layer SNR across the fleet (docs/numerics.md
+    # "SNR-guided compression selection"): the layer quantization hurts
+    # most right now, and on which rank.
+    worst = None
+    for rank, grad in sorted(grad_by_rank.items()):
+        w = worst_snr(grad)
+        if w is not None and (worst is None or w["snr_db"] < worst[1]["snr_db"]):
+            worst = (rank, w)
+    if worst is not None:
+        lines.append(
+            f"  worst SNR: {worst[1]['key']} at {worst[1]['snr_db']:.1f} dB "
+            f"({worst[1]['compression']}, rank {worst[0]})")
     return "\n".join(lines), (now, ops_now)
 
 
@@ -156,11 +208,12 @@ class TopConsole:
     def frame(self) -> Tuple[str, int, bool]:
         """Scrape + render one frame; returns (text, ranks answering,
         straggler attributed)."""
-        metrics_by_rank, perf_by_rank = scrape_all(self._endpoints,
-                                                   self._secret)
+        metrics_by_rank, perf_by_rank, grad_by_rank = scrape_all(
+            self._endpoints, self._secret)
         text, self._prev = render_frame(self._endpoints, metrics_by_rank,
                                         perf_by_rank, self._prev,
-                                        time.monotonic())
+                                        time.monotonic(),
+                                        grad_by_rank=grad_by_rank)
         return text, len(metrics_by_rank), \
             find_straggler(perf_by_rank) is not None
 

@@ -173,6 +173,27 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
     p.add_argument("--no-perfstats", action="store_true",
                    help="disable the always-on perf-attribution baselines "
                         "entirely (HVDTPU_PERFSTATS=0)")
+    p.add_argument("--grad-profile", default=None, metavar="DIR",
+                   help="cross-run numerical-quality sentry "
+                        "(HVDTPU_GRAD_PROFILE_DIR; docs/numerics.md): each "
+                        "rank persists its gradient-health baselines as "
+                        "DIR/grad_profile.<rank>.json at shutdown; the "
+                        "driver merges them into DIR/grad_profile.json — "
+                        "compare two runs with scripts/grad_diff.py")
+    p.add_argument("--nancheck", default=None,
+                   choices=["off", "warn", "abort"],
+                   help="non-finite gradient policy (HVDTPU_NANCHECK; "
+                        "docs/numerics.md): 'warn' (default) flags the "
+                        "first NaN/Inf gradient and continues, 'abort' "
+                        "fail-fasts the job naming the tensor")
+    p.add_argument("--gradcheck-sample", type=int, default=None,
+                   help="cross-rank divergence probe: fingerprint every "
+                        "Nth allreduce's output and majority-vote across "
+                        "ranks (HVDTPU_GRADCHECK_SAMPLE; default 64, "
+                        "0 disables)")
+    p.add_argument("--no-gradstats", action="store_true",
+                   help="disable the numerical-health telemetry entirely "
+                        "(HVDTPU_GRADSTATS=0)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="base port for the live-metrics endpoints "
                         "(HVDTPU_METRICS_PORT): worker rank r serves "
@@ -429,6 +450,21 @@ def _apply_tuning_env(env: dict, args) -> dict:
         _prepare_artifact_dir(args.perf_profile, "perf_profile.*.json",
                               "perf_profile.json")
         env[ev.HVDTPU_PERF_PROFILE_DIR] = args.perf_profile
+    # Numerical-health knobs (docs/numerics.md): flags own the env only
+    # when passed, like the perf knobs above.
+    if args.no_gradstats:
+        env[ev.HVDTPU_GRADSTATS] = "0"
+    if args.nancheck is not None:
+        env[ev.HVDTPU_NANCHECK] = args.nancheck
+    if args.gradcheck_sample is not None:
+        if args.gradcheck_sample < 0:
+            raise SystemExit("hvdrun: --gradcheck-sample must be >= 0")
+        env[ev.HVDTPU_GRADCHECK_SAMPLE] = str(args.gradcheck_sample)
+    if args.grad_profile:
+        args.grad_profile = os.path.abspath(args.grad_profile)
+        _prepare_artifact_dir(args.grad_profile, "grad_profile.*.json",
+                              "grad_profile.json")
+        env[ev.HVDTPU_GRAD_PROFILE_DIR] = args.grad_profile
     if args.profile:
         # Whole-job sampling window (docs/profiling.md): same per-run
         # hygiene — stale prof.<rank>.folded files would silently merge a
@@ -599,6 +635,8 @@ def run_elastic_launcher(args: argparse.Namespace) -> int:
         _postmortem_report(args.postmortem)
     if args.perf_profile:
         _merge_perf_profiles(args.perf_profile)
+    if args.grad_profile:
+        _merge_grad_profiles(args.grad_profile)
     if args.profile:
         _merge_prof_dir(args.profile)
     return rc
@@ -753,6 +791,8 @@ def run_launcher(args: argparse.Namespace) -> int:
         _merge_trace_dir(args.trace)
     if args.perf_profile:
         _merge_perf_profiles(args.perf_profile)
+    if args.grad_profile:
+        _merge_grad_profiles(args.grad_profile)
     if args.profile:
         _merge_prof_dir(args.profile)
     if args.postmortem and rc != 0:
@@ -823,6 +863,34 @@ def _merge_perf_profiles(profile_dir: str) -> None:
               "scripts/perf_diff.py OLD NEW)", file=sys.stderr)
     except Exception as exc:  # observability must never fail the job
         print(f"hvdrun: perf-profile: merge failed: {exc}", file=sys.stderr)
+
+
+def _merge_grad_profiles(profile_dir: str) -> None:
+    """End-of-job numerical-health collection (hvdrun --grad-profile):
+    merge the per-rank ``grad_profile.<rank>.json`` files into one
+    ``grad_profile.json`` for scripts/grad_diff.py. Best-effort like the
+    perf merge — remote workers' profiles live on their own hosts — and
+    never fails the job."""
+    try:
+        import json
+
+        from ..gradstats import merge_profile_dir
+        merged, found = merge_profile_dir(profile_dir)
+        if not found:
+            print(f"hvdrun: grad-profile: no grad_profile.<rank>.json in "
+                  f"{profile_dir} (remote workers keep theirs on their own "
+                  "hosts; copy them here and re-merge with "
+                  "horovod_tpu.gradstats.merge_profile_dir)",
+                  file=sys.stderr)
+            return
+        merged_path = os.path.join(profile_dir, "grad_profile.json")
+        with open(merged_path, "w") as f:
+            json.dump(merged, f)
+        print(f"hvdrun: grad-profile: merged {len(found)} rank profile(s) "
+              f"-> {merged_path} (compare runs with "
+              "scripts/grad_diff.py OLD NEW)", file=sys.stderr)
+    except Exception as exc:  # observability must never fail the job
+        print(f"hvdrun: grad-profile: merge failed: {exc}", file=sys.stderr)
 
 
 def _merge_prof_dir(prof_dir: str) -> None:

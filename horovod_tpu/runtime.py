@@ -443,6 +443,15 @@ def init(comm: Optional[Sequence[int]] = None,
                     return snap.decode() if snap else \
                         '{"version": 1, "enabled": false, "keys": []}'
 
+                def _gradz(core=st.core):
+                    # Numerical health next to /metrics: per-tensor
+                    # gradient norms, per-key quantization SNR, and the
+                    # NaN/divergence totals as JSON (docs/numerics.md).
+                    snap = (core.gradstats_snapshot()
+                            if hasattr(core, "gradstats_snapshot") else b"")
+                    return snap.decode() if snap else \
+                        '{"version": 1, "enabled": false, "keys": []}'
+
                 def _profz(query, core=st.core):
                     # Sampling profiler next to /metrics (docs/profiling.md):
                     # ?start / ?stop drive the window, a plain GET returns
@@ -466,7 +475,7 @@ def init(comm: Optional[Sequence[int]] = None,
                         secret=ev.get_str(ev.HVDTPU_SECRET) or None,
                         health={"rank": st.rank, "size": st.size},
                         debugz_fn=_debugz, perfz_fn=_perfz,
-                        profz_fn=_profz)
+                        profz_fn=_profz, gradz_fn=_gradz)
                 except OSError as exc:
                     # The core already joined the world — tear it down
                     # before failing or it would linger as a zombie rank
@@ -664,6 +673,25 @@ def perf_report(parsed: bool = True):
     snap = st.core.perfstats_snapshot()
     if not snap:
         return {"perfstats": "disabled"}
+    doc = parse_snapshot(snap)
+    return doc if parsed else format_report(doc)
+
+
+def grad_report(parsed: bool = True):
+    """Numerical-health snapshot (docs/numerics.md): this rank's per-tensor
+    gradient norms / absmax / NaN-Inf counts, per-key quantization MSE/SNR
+    and error-feedback residual norms, plus the divergence-probe totals —
+    the same JSON the worker's ``/gradz`` endpoint serves. ``parsed=False``
+    returns the human-readable table instead
+    (:func:`horovod_tpu.gradstats.format_report`). ``{"gradstats":
+    "disabled"}`` outside process mode or without the native core."""
+    from .gradstats import format_report, parse_snapshot
+    st = _require_init()
+    if st.core is None or not hasattr(st.core, "gradstats_snapshot"):
+        return {"gradstats": "disabled"}
+    snap = st.core.gradstats_snapshot()
+    if not snap:
+        return {"gradstats": "disabled"}
     doc = parse_snapshot(snap)
     return doc if parsed else format_report(doc)
 

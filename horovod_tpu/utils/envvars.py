@@ -169,6 +169,33 @@ HVDTPU_PERF_PROFILE_DIR = "HVDTPU_PERF_PROFILE_DIR"
 DEFAULT_PERF_SLOWDOWN_PCT = 50.0
 DEFAULT_PERF_MIN_SAMPLES = 20
 
+# Numerical-health observability (native/gradstats.{h,cpp} +
+# horovod_tpu/gradstats.py; docs/numerics.md). GRADSTATS: "1" (default)
+# streams per-tensor gradient moments (L2 norm, absmax, NaN/Inf counts,
+# folded into the fusion copy-in), per-key quantization MSE/SNR +
+# error-feedback residual norms (accumulated inside the compressed-wire
+# kernels), and the cross-rank divergence probe — inside the shared <2%
+# observability budget; "0" disables the whole subsystem. NANCHECK: what
+# the first NaN/Inf gradient does — "off" (count nothing), "warn"
+# (default: NONFINITE flight event + hvdtpu_nonfinite_grads_total + WARN,
+# the op proceeds), "abort" (fail-fast: the op errors naming the tensor,
+# the world breaks, and the forensics dump carries the NONFINITE record).
+# GRADCHECK_SAMPLE: every Nth allreduce, each rank crc32c-fingerprints its
+# post-reduce output and rank 0 majority-votes the world — any minority is
+# silent data corruption or non-determinism (DIVERGENCE flight event +
+# hvdtpu_divergence_total{suspect=...}). Default 64; 0 disables the probe;
+# must be uniform across ranks (the launcher's env broadcast guarantees
+# it). GRAD_PROFILE_DIR: directory where each rank persists
+# grad_profile.<rank>.json at shutdown for the cross-run quality sentry
+# (`hvdrun --grad-profile DIR` sets it and merges at job end;
+# scripts/grad_diff.py compares two profiles).
+HVDTPU_GRADSTATS = "HVDTPU_GRADSTATS"
+HVDTPU_NANCHECK = "HVDTPU_NANCHECK"
+HVDTPU_GRADCHECK_SAMPLE = "HVDTPU_GRADCHECK_SAMPLE"
+HVDTPU_GRAD_PROFILE_DIR = "HVDTPU_GRAD_PROFILE_DIR"
+
+DEFAULT_GRADCHECK_SAMPLE = 64
+
 # In-process sampling profiler (native/profiler.{h,cpp} +
 # horovod_tpu/profiler.py; docs/profiling.md). PROF: "1" (default) keeps
 # the subsystem armed — per-thread SIGPROF timers exist but fire only

@@ -45,7 +45,7 @@ NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
 # unpaired sweeps, ±10% drift windows apart, on this box).
 AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
             "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace",
-            "flightrec", "perfstats", "prof")
+            "flightrec", "perfstats", "prof", "gradstats")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -134,6 +134,13 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_longlong, ctypes.c_char_p]
     except AttributeError:
         pass  # pre-perfstats build
+    try:
+        lib.hvdtpu_set_gradstats.restype = ctypes.c_int
+        lib.hvdtpu_set_gradstats.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_char_p]
+    except AttributeError:
+        pass  # pre-gradstats build
     try:
         lib.hvdtpu_set_profiler.restype = ctypes.c_int
         lib.hvdtpu_set_profiler.argtypes = [
@@ -246,6 +253,20 @@ def run_worker(args) -> int:
         else:
             print("SKIP perfstats config: library has no perf attribution",
                   file=sys.stderr)
+            return 0
+    if args.gradstats != "default":
+        # Same tri-state contract as --flightrec/--perfstats: "default"
+        # never calls the API (keeps --ab lib=old:new runnable against
+        # pre-gradstats .so builds); on = production defaults (nancheck
+        # warn, divergence probe every 64th op, no profile). `--ab
+        # gradstats=off:on` is the numerical-health observability-budget
+        # gate (docs/benchmarks.md).
+        if hasattr(lib, "hvdtpu_set_gradstats"):
+            lib.hvdtpu_set_gradstats(
+                core, 1 if args.gradstats == "on" else 0, 1, 64, b"")
+        else:
+            print("SKIP gradstats config: library has no numerical-health "
+                  "telemetry", file=sys.stderr)
             return 0
     if args.prof != "default":
         # Same tri-state contract as --flightrec/--perfstats: "default"
@@ -373,7 +394,7 @@ def run_config(args, world: int, algo: str, sizes: list,
            "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
            "lib": args.lib, "trace": args.trace,
            "flightrec": args.flightrec, "perfstats": args.perfstats,
-           "prof": args.prof}
+           "prof": args.prof, "gradstats": args.gradstats}
     if overrides:
         cfg.update(overrides)
     port = free_port()
@@ -397,6 +418,7 @@ def run_config(args, world: int, algo: str, sizes: list,
                "--flightrec", str(cfg["flightrec"]),
                "--perfstats", str(cfg["perfstats"]),
                "--prof", str(cfg["prof"]),
+               "--gradstats", str(cfg["gradstats"]),
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.PIPE, text=True))
@@ -628,6 +650,15 @@ def main(argv=None) -> int:
                         "the library default (armed, window closed — keeps "
                         "--ab lib=old:new runnable); --ab prof=off:on is "
                         "the profiler observability-budget gate")
+    p.add_argument("--gradstats", default="default",
+                   choices=["default", "on", "off"],
+                   help="numerical-health telemetry (HVDTPU_GRADSTATS; "
+                        "docs/numerics.md): 'on' = production defaults "
+                        "(nancheck warn, divergence probe every 64th op), "
+                        "'off' disables, 'default' leaves the library "
+                        "default (keeps --ab lib=old:new runnable); --ab "
+                        "gradstats=off:on is the numerical-health "
+                        "observability-budget gate")
     p.add_argument("--ab", default=None, metavar="FLAG=A:B",
                    help="paired interleaved A/B over one knob, e.g. "
                         "'doorbell-batch=1:0' or 'tcp-zerocopy=off:on': "

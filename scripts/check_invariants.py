@@ -557,6 +557,14 @@ def check_enum_mirrors(root: Path, findings, ran):
     # verdict, so it is pinned like the others.
     dict_pair("OpType-postmortem", f"{NATIVE_DIR}/common.h", "OpType",
               "horovod_tpu/postmortem.py", "_OP_TYPES")
+    # Numerical-health telemetry (ISSUE 15): the NanPolicy code rides the
+    # NONFINITE flight record's arg word and hvdtpu_set_gradstats; the
+    # GradEvent kinds label the /gradz event vocabulary — a drifted value
+    # misreports a NaN policy or health event instead of crashing.
+    dict_pair("GradEvent", f"{NATIVE_DIR}/gradstats.h", "GradEvent",
+              "horovod_tpu/gradstats.py", "GRAD_EVENTS")
+    dict_pair("NanPolicy", f"{NATIVE_DIR}/gradstats.h", "NanPolicy",
+              "horovod_tpu/gradstats.py", "NAN_POLICIES")
 
     # ReduceOp: IntEnum mirror, names compared verbatim.
     cpp = parse_cpp_enum(root, f"{NATIVE_DIR}/common.h", "ReduceOp")

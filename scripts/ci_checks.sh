@@ -125,6 +125,38 @@ prof_smoke() {
   return 0
 }
 run_check "prof-smoke" prof_smoke
+# Numerical-health smoke (docs/numerics.md): a real 2-rank int8 job must
+# serve a VALID /gradz payload with per-layer SNR on the compressed keys
+# (scraped live mid-job), and a seeded NaN gradient must abort the job
+# under HVDTPU_NANCHECK=abort with the tensor named in the post-mortem
+# verdict — the model-health surface cannot silently regress into empty
+# snapshots or a NaN policy that never fires.
+gradz_smoke() {
+  local dir out
+  # 2-rank int8 job with the divergence probe on every 2nd op; each rank
+  # self-scrapes its live /gradz endpoint and validates per-layer SNR
+  # through the decoder (TEST_GRAD_SCRAPE_GRADZ).
+  out=$(env JAX_PLATFORMS=cpu TEST_GRAD_ITERS=6 HVDTPU_COMPRESSION=int8 \
+    HVDTPU_COMPRESSION_MIN_BYTES=1024 HVDTPU_GRADCHECK_SAMPLE=2 \
+    TEST_GRAD_SCRAPE_GRADZ=1 "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 --metrics-port 19640 \
+    python3 tests/data/grad_worker.py 2>&1) || { echo "${out}"; return 1; }
+  # NaN-negative fixture: the job MUST die and the verdict MUST name the
+  # tensor.
+  dir=$(mktemp -d /tmp/hvdtpu_gradz_smoke.XXXXXX) || return 1
+  if env JAX_PLATFORMS=cpu TEST_GRAD_ITERS=3 TEST_GRAD_NAN_RANK=1 \
+    TEST_GRAD_EXPECT_ABORT=1 HVDTPU_NANCHECK=abort "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 --postmortem "${dir}" \
+    python3 tests/data/grad_worker.py > "${dir}/run.log" 2>&1; then
+    echo "NaN job unexpectedly succeeded under HVDTPU_NANCHECK=abort"
+    return 1
+  fi
+  grep -q "non-finite gradient" "${dir}/run.log" || return 1
+  grep -q "layer1/w" "${dir}/run.log" || return 1
+  rm -rf "${dir}"
+  return 0
+}
+run_check "gradz-smoke" gradz_smoke
 # Cross-run regression-sentry smoke (docs/observability.md): a job writes
 # merged perf profiles; perf_diff must pass a profile against itself
 # (exit 0) and CONFIRM a doctored 3x slowdown (exit 1) — so the perf

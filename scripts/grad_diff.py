@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Cross-run numerical-quality sentry (docs/numerics.md).
+
+Compares two ``grad_profile`` artifacts — the per-key gradient-health
+baselines each job persists at shutdown (``HVDTPU_GRAD_PROFILE_DIR`` /
+``hvdrun --grad-profile DIR``) — and exits non-zero when quality
+regressed, so a compression-knob change (or a code change touching the
+quantizers) is machine-gated instead of eyeballed:
+
+    python scripts/grad_diff.py OLD NEW [--snr-threshold-db 3]
+
+OLD/NEW each name a merged ``grad_profile.json``, a per-rank
+``grad_profile.<rank>.json``, or a directory of per-rank files (merged on
+the fly). Keys are matched per (rank, tensor-set signature).
+
+A regression is:
+
+* a matched compressed key whose EWMA SNR dropped by more than
+  ``--snr-threshold-db`` (default 3 dB — half a bit of effective
+  precision), or
+* NaN/Inf gradients in NEW where OLD had none, or
+* divergence-probe convictions in NEW where OLD had none.
+
+Gradient norms are reported (a norm drifting 10x is worth eyes) but never
+gate: they legitimately move with training progress.
+
+Exit status: 0 = no regression, 1 = regression, 2 = bad arguments /
+unreadable profiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.gradstats import (load_profile, merge_profile_dir,  # noqa: E402
+                                   profile_ranks)
+
+
+def load_any(path: str) -> dict:
+    """Profile file OR directory of grad_profile.<rank>.json files."""
+    if os.path.isdir(path):
+        merged, found = merge_profile_dir(path)
+        if not found:
+            raise ValueError(f"{path}: no grad_profile.<rank>.json files")
+        return merged
+    return load_profile(path)
+
+
+def key_entries(doc: dict) -> Dict[Tuple[int, str], dict]:
+    """{(rank, key): key-entry} across every rank in a profile document."""
+    out: Dict[Tuple[int, str], dict] = {}
+    for rank, prof in profile_ranks(doc).items():
+        snap = prof.get("gradstats", {})
+        for entry in snap.get("keys", []):
+            out[(rank, entry["key"])] = entry
+    return out
+
+
+def totals(doc: dict) -> Dict[str, float]:
+    agg = {"nonfinite_total": 0.0, "divergence_total": 0.0,
+           "residual_resets_total": 0.0}
+    for prof in profile_ranks(doc).values():
+        snap = prof.get("gradstats", {})
+        for k in agg:
+            agg[k] += float(snap.get(k, 0))
+    return agg
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--snr-threshold-db", type=float, default=3.0,
+                    help="flag a compressed key whose EWMA SNR dropped by "
+                         "more than this many dB (default 3)")
+    ap.add_argument("--min-quant-ops", type=int, default=3,
+                    help="compare a key's SNR only when both runs "
+                         "quantized it at least this many times")
+    args = ap.parse_args(argv)
+    try:
+        old_doc = load_any(args.old)
+        new_doc = load_any(args.new)
+    except (OSError, ValueError) as exc:
+        print(f"grad_diff: {exc}", file=sys.stderr)
+        return 2
+
+    old_keys = key_entries(old_doc)
+    new_keys = key_entries(new_doc)
+    regressions: List[str] = []
+    compared = 0
+    for ident in sorted(set(old_keys) & set(new_keys)):
+        o, nw = old_keys[ident], new_keys[ident]
+        if min(o.get("quant_count", 0),
+               nw.get("quant_count", 0)) < args.min_quant_ops:
+            continue
+        compared += 1
+        rank, key = ident
+        o_snr = float(o.get("ewma_snr_db", 0.0))
+        n_snr = float(nw.get("ewma_snr_db", 0.0))
+        drop = o_snr - n_snr
+        line = (f"  rank {rank} {key}: SNR {o_snr:.1f} -> {n_snr:.1f} dB "
+                f"({o.get('compression', '?')} -> "
+                f"{nw.get('compression', '?')})")
+        if drop > args.snr_threshold_db:
+            regressions.append(line + f"  [REGRESSED {drop:.1f} dB]")
+        else:
+            print(line)
+    old_t, new_t = totals(old_doc), totals(new_doc)
+    for field, label in (("nonfinite_total", "NaN/Inf gradient elements"),
+                         ("divergence_total", "divergence convictions")):
+        if new_t[field] > 0 and old_t[field] == 0:
+            regressions.append(
+                f"  {label}: 0 -> {new_t[field]:.0f}  [NEW in this run]")
+    if new_t["residual_resets_total"] > old_t["residual_resets_total"]:
+        print(f"  note: residual resets {old_t['residual_resets_total']:.0f}"
+              f" -> {new_t['residual_resets_total']:.0f} (fusion churn?)")
+
+    print(f"grad_diff: compared {compared} compressed key(s)")
+    if regressions:
+        print("grad_diff: QUALITY REGRESSION:", file=sys.stderr)
+        for line in regressions:
+            print(line, file=sys.stderr)
+        return 1
+    print("grad_diff: no quality regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,8 +110,8 @@ class TestSnapshotDecode:
         (the linter pins values; this pins the reverse maps)."""
         from horovod_tpu.flightrec import (DUMP_REASONS, EVENT_NAMES,
                                            FLIGHT_EVENTS, REASON_NAMES)
-        assert sorted(FLIGHT_EVENTS.values()) == list(range(15))
-        assert sorted(DUMP_REASONS.values()) == list(range(4))
+        assert sorted(FLIGHT_EVENTS.values()) == list(range(17))
+        assert sorted(DUMP_REASONS.values()) == list(range(5))
         assert EVENT_NAMES[FLIGHT_EVENTS["sendrecv"]] == "sendrecv"
         assert REASON_NAMES[DUMP_REASONS["abort"]] == "abort"
 

@@ -83,6 +83,7 @@ _ENDPOINT_MARKERS = {
     "/debugz": '"debugz"',
     "/perfz": '"perfz"',
     "/profz": '"stacks"',
+    "/gradz": '"gradz"',
 }
 
 
@@ -102,6 +103,7 @@ class TestEndpointAuth:
                 debugz_fn=lambda: '{"debugz": 1}',
                 perfz_fn=lambda: '{"perfz": 1}',
                 profz_fn=lambda query: '{"stacks": [], "q": "%s"}' % query,
+                gradz_fn=lambda: '{"gradz": 1}',
             )
         server = MetricsServer(dump_fn=lambda: "hvdtpu_up 1\n", port=0,
                                secret=secret, health={"rank": 0}, **kwargs)
