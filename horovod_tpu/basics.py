@@ -127,6 +127,10 @@ def _load_lib() -> ctypes.CDLL:
     lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
     lib.hvdtpu_set_allreduce_tuning.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong]
+    if hasattr(lib, "hvdtpu_set_scale_tuning"):  # older libs lack it
+        lib.hvdtpu_set_scale_tuning.restype = ctypes.c_int
+        lib.hvdtpu_set_scale_tuning.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int]
     lib.hvdtpu_set_transport.restype = ctypes.c_int
     lib.hvdtpu_set_transport.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
@@ -427,8 +431,10 @@ class NativeCore:
                 self._core, chaos.action, chaos.op_index, chaos.hop_index,
                 chaos.delay_ms, chaos.peer)
         # Allreduce algorithm menu (reference fork: ring/scatter-allgather/
-        # tree selection). auto = size-adaptive: recursive doubling at or
-        # below the (autotuned) crossover, pipelined ring above it.
+        # parameter-server/tree selection). auto = size-adaptive: recursive
+        # doubling at or below the (autotuned) crossover, then
+        # scatter-allgather or the pipelined ring above it depending on the
+        # group size vs the SA_GROUP floor.
         algo = (ev.get_str(ev.HVDTPU_ALLREDUCE_ALGO, "auto") or
                 "auto").strip().lower()
         if algo not in _ALLREDUCE_ALGOS:
@@ -439,6 +445,13 @@ class NativeCore:
             self._core, _ALLREDUCE_ALGOS[algo],
             ev.get_int(ev.HVDTPU_ALLREDUCE_CROSSOVER, 0),
             ev.get_int(ev.HVDTPU_ALLREDUCE_SEGMENT_BYTES, 0))
+        # Scale-out knobs: AUTO's scatter-allgather group floor and the
+        # control-plane frame batching toggle (native/core.cpp CtrlOutbox).
+        sa_group = ev.get_int(ev.HVDTPU_ALLREDUCE_SA_GROUP, -1)
+        ctrl_batch = int(ev.get_bool(ev.HVDTPU_CTRL_BATCH, default=True))
+        if hasattr(self._lib, "hvdtpu_set_scale_tuning"):
+            self._lib.hvdtpu_set_scale_tuning(self._core, sa_group,
+                                              ctrl_batch)
         # Transport subsystem (native/transport.h): same-host rank pairs ride
         # POSIX shared-memory ring lanes unless HVDTPU_SHM=0; the two-level
         # allreduce (HVDTPU_ALLREDUCE_HIER) defaults to autotuner-owned auto.

@@ -180,6 +180,7 @@ double ToUnit(double v, double lo, double hi) {
 void ParameterManager::Initialize(double cycle_time_ms,
                                   int64_t fusion_threshold, bool cache_enabled,
                                   int64_t algo_crossover, bool tune_crossover,
+                                  bool sa_enabled, bool tune_sa,
                                   bool hier_enabled, bool tune_hier,
                                   int32_t wire_compression,
                                   bool tune_compression,
@@ -187,22 +188,23 @@ void ParameterManager::Initialize(double cycle_time_ms,
                                   int warmup_samples, int cycles_per_sample,
                                   int max_samples, double gp_noise) {
   current_ = {cycle_time_ms, fusion_threshold, cache_enabled, algo_crossover,
-              hier_enabled, wire_compression};
+              sa_enabled, hier_enabled, wire_compression};
   tune_crossover_ = tune_crossover;
+  tune_sa_ = tune_sa;
   tune_hier_ = tune_hier;
   tune_compression_ = tune_compression;
   warmup_samples_ = warmup_samples;
   warmup_left_ = warmup_samples;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
-  opt_ = BayesianOptimizer(3 + (tune_crossover ? 1 : 0) + (tune_hier ? 1 : 0) +
-                               (tune_compression ? 1 : 0),
+  opt_ = BayesianOptimizer(3 + (tune_crossover ? 1 : 0) + (tune_sa ? 1 : 0) +
+                               (tune_hier ? 1 : 0) + (tune_compression ? 1 : 0),
                            gp_noise);
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_ != nullptr) {
       fputs("cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
-            "algo_crossover_bytes,hier_enabled,wire_compression,"
+            "algo_crossover_bytes,sa_enabled,hier_enabled,wire_compression,"
             "score_bytes_per_sec\n",
             log_);
     }
@@ -230,6 +232,7 @@ std::vector<double> ParameterManager::ToVector(const Params& p) const {
     x.push_back(
         ToUnit(static_cast<double>(p.algo_crossover), kCrossMin, kCrossMax));
   }
+  if (tune_sa_) x.push_back(p.sa_enabled ? 1.0 : 0.0);
   if (tune_hier_) x.push_back(p.hier_enabled ? 1.0 : 0.0);
   if (tune_compression_) {
     // 3-way categorical {none, fp16, int8} mapped onto [0, 1] at
@@ -254,6 +257,12 @@ void ParameterManager::SetFromVector(const std::vector<double>& x) {
         std::llround(FromUnit(x[next], kCrossMin, kCrossMax)));
     ++next;
   }
+  if (tune_sa_ && x.size() > next) {
+    // Categorical like the cache switch: big-message AUTO dispatch prefers
+    // scatter-allgather when on, the pipelined ring when off.
+    current_.sa_enabled = x[next] >= 0.5;
+    ++next;
+  }
   if (tune_hier_ && x.size() > next) {
     // Categorical like the cache switch: explored continuously, thresholded
     // here (reference: CategoricalParameter, parameter_manager.h:225).
@@ -270,11 +279,11 @@ void ParameterManager::SetFromVector(const std::vector<double>& x) {
 
 void ParameterManager::LogSample(double score) {
   if (log_ == nullptr) return;
-  fprintf(log_, "%.3f,%lld,%d,%lld,%d,%d,%.1f\n", current_.cycle_time_ms,
+  fprintf(log_, "%.3f,%lld,%d,%lld,%d,%d,%d,%.1f\n", current_.cycle_time_ms,
           static_cast<long long>(current_.fusion_threshold),
           current_.cache_enabled ? 1 : 0,
           static_cast<long long>(current_.algo_crossover),
-          current_.hier_enabled ? 1 : 0,
+          current_.sa_enabled ? 1 : 0, current_.hier_enabled ? 1 : 0,
           static_cast<int>(current_.wire_compression), score);
   fflush(log_);
 }

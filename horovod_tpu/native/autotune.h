@@ -93,6 +93,11 @@ class ParameterManager {
     // the latency algorithm (recursive doubling), larger ones the pipelined
     // ring (data_plane.h AllreduceAlgo).
     int64_t algo_crossover;
+    // Scatter-allgather for large tensors under AUTO (data_plane.h
+    // sa_auto): a categorical on/off switch over whether big-message
+    // dispatch prefers SCATTER_ALLGATHER to RING once the group clears
+    // the sa_min_group floor.
+    bool sa_enabled;
     // Hierarchical two-level allreduce (data_plane.h HierMode::AUTO): a
     // categorical on/off dimension like the cache switch (reference analog:
     // hierarchical_allreduce in BayesianParameter, parameter_manager.h:186).
@@ -107,15 +112,19 @@ class ParameterManager {
   // tune_crossover: include the algo crossover as an extra GP dimension
   // only when the data plane is in AUTO mode — with a pinned algorithm the
   // coordinate cannot affect the score and would just dilute the sample
-  // budget; the value is then held constant at algo_crossover. tune_hier:
-  // include the hierarchical switch only when HVDTPU_ALLREDUCE_HIER=auto
-  // AND the topology is non-trivial (multiple hosts, multi-rank hosts).
-  // tune_compression: include the wire-compression categorical only when
-  // HVDTPU_COMPRESSION=auto — with a pinned mode the coordinate is inert
-  // and would dilute the sample budget, like the crossover/hier gates.
+  // budget; the value is then held constant at algo_crossover. tune_sa:
+  // include the scatter-allgather switch only when the algorithm is AUTO
+  // and the world clears the sa_min_group floor (otherwise the coordinate
+  // is inert). tune_hier: include the hierarchical switch only when
+  // HVDTPU_ALLREDUCE_HIER=auto AND the topology is non-trivial (multiple
+  // hosts, multi-rank hosts). tune_compression: include the
+  // wire-compression categorical only when HVDTPU_COMPRESSION=auto — with
+  // a pinned mode the coordinate is inert and would dilute the sample
+  // budget, like the crossover/hier gates.
   void Initialize(double cycle_time_ms, int64_t fusion_threshold,
                   bool cache_enabled, int64_t algo_crossover,
-                  bool tune_crossover, bool hier_enabled, bool tune_hier,
+                  bool tune_crossover, bool sa_enabled, bool tune_sa,
+                  bool hier_enabled, bool tune_hier,
                   int32_t wire_compression, bool tune_compression,
                   const std::string& log_path,
                   int warmup_samples, int cycles_per_sample, int max_samples,
@@ -141,9 +150,10 @@ class ParameterManager {
   bool active_ = false;
   bool frozen_ = false;
   bool tune_crossover_ = true;
+  bool tune_sa_ = false;
   bool tune_hier_ = false;
   bool tune_compression_ = false;
-  Params current_{1.0, 64 << 20, true, 32 << 10, false, 0};
+  Params current_{1.0, 64 << 20, true, 32 << 10, true, false, 0};
   BayesianOptimizer opt_{4};
   int warmup_samples_ = 3;
   int cycles_per_sample_ = 50;

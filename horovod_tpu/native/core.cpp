@@ -233,6 +233,84 @@ class RequestCache {
   std::list<std::string> lru_;
 };
 
+// Batched control-plane sender (HVDTPU_CTRL_BATCH): per-tensor READY /
+// RESPONSES / NEED_FULL plus the CLOCK and GRADCHECK piggyback frames queued
+// during one background cycle coalesce into ONE vectored SendAllVec per peer
+// at flush — one syscall per peer per cycle instead of one per message,
+// which is where w16+ coordination cost actually lives. The wire stream is
+// byte-identical to a SendFrame sequence (each frame keeps its own u64
+// length prefix), so the receive side is untouched. Owned by the background
+// thread, like the fds it writes.
+class CtrlOutbox {
+ public:
+  void set_enabled(bool on) { enabled_ = on; }
+  bool enabled() const { return enabled_; }
+  void set_counters(Counter* frames, Counter* batches) {
+    frames_total_ = frames;
+    batches_total_ = batches;
+  }
+
+  // Queue one frame for fd. Disabled -> immediate SendFrame (same return);
+  // enabled -> queued, returns 0, send failures surface at Flush.
+  int Queue(int fd, std::vector<uint8_t> payload) {
+    if (frames_total_ != nullptr) frames_total_->Inc();
+    if (!enabled_) {
+      if (batches_total_ != nullptr) batches_total_->Inc();
+      return SendFrame(fd, payload);
+    }
+    queues_[fd].push_back(std::move(payload));
+    return 0;
+  }
+
+  bool pending() const { return !queues_.empty(); }
+
+  // Ship everything queued, one vectored send per fd (chunked well under
+  // POSIX's IOV_MAX floor of 1024). Returns 0 when every peer's send
+  // succeeded, else -1 with *bad_fd naming the first failure; the remaining
+  // peers still flush — one dead worker must not strand a broadcast.
+  int Flush(int* bad_fd) {
+    int rc = 0;
+    for (auto& kv : queues_) {
+      const int fd = kv.first;
+      auto& frames = kv.second;
+      // Length prefixes must outlive the iovecs that point at them.
+      std::vector<uint64_t> lens(frames.size());
+      for (size_t i = 0; i < frames.size(); ++i) lens[i] = frames[i].size();
+      size_t done = 0;
+      bool fd_ok = true;
+      while (fd_ok && done < frames.size()) {
+        const size_t n = std::min<size_t>(frames.size() - done, 500);
+        std::vector<iovec> iov;
+        iov.reserve(2 * n);
+        for (size_t i = done; i < done + n; ++i) {
+          iov.push_back({&lens[i], sizeof(uint64_t)});
+          if (!frames[i].empty()) {
+            iov.push_back({frames[i].data(), frames[i].size()});
+          }
+        }
+        if (batches_total_ != nullptr) batches_total_->Inc();
+        if (SendAllVec(fd, iov.data(), static_cast<int>(iov.size())) != 0) {
+          if (rc == 0 && bad_fd != nullptr) *bad_fd = fd;
+          rc = -1;
+          fd_ok = false;
+        }
+        done += n;
+      }
+    }
+    queues_.clear();
+    return rc;
+  }
+
+  // Drop whatever is queued for a disconnecting peer.
+  void Forget(int fd) { queues_.erase(fd); }
+
+ private:
+  bool enabled_ = true;
+  std::map<int, std::vector<std::vector<uint8_t>>> queues_;
+  Counter* frames_total_ = nullptr;
+  Counter* batches_total_ = nullptr;
+};
+
 struct CoreConfig {
   int rank = 0;
   int size = 1;
@@ -320,6 +398,14 @@ struct CoreConfig {
   int32_t allreduce_algo = 0;  // AUTO
   int64_t allreduce_crossover = 0;
   int64_t allreduce_segment = 0;
+  // Scale-out knobs. allreduce_sa_group (HVDTPU_ALLREDUCE_SA_GROUP): the
+  // group-size floor at which AUTO's big-message dispatch prefers
+  // scatter-allgather over the ring; < 0 keeps the data-plane default,
+  // 0 removes scatter-allgather from the AUTO menu entirely. ctrl_batch
+  // (HVDTPU_CTRL_BATCH): nonzero coalesces each background cycle's
+  // control-plane frames into one vectored send per peer.
+  int64_t allreduce_sa_group = -1;
+  int32_t ctrl_batch = 1;
   // Transport subsystem (HVDTPU_SHM / HVDTPU_SHM_RING_BYTES /
   // HVDTPU_ALLREDUCE_HIER; data_plane.h). shm defaults on — same-host pairs
   // negotiate shared-memory lanes at Connect and fall back to TCP when
@@ -453,6 +539,7 @@ class Core {
   void WaitForWork() EXCLUDES(mu_);  // poll control fds + wake pipe
   void Wake();                       // nudge the background loop
   void PumpControlPlane() EXCLUDES(mu_);  // role-dependent per-cycle work
+  void FlushCtrlOutbox();                  // ship queued control frames
   void CoordinatorIngest() EXCLUDES(mu_);  // rank 0: read worker frames
   // rank 0: match + fuse + broadcast
   void CoordinatorEmitResponses() EXCLUDES(mu_);
@@ -662,6 +749,11 @@ class Core {
   // coordinator role uses the per-rank table.
   RequestCache cache_;
 
+  // Batched control-plane sender (see CtrlOutbox above). Background-thread
+  // owned like the fds it writes; flushed before any collective runs and at
+  // the end of every pump.
+  CtrlOutbox outbox_;
+
   // Autotune: coordinator-only decisions, broadcast via CtrlMsg::PARAMS.
   ParameterManager param_manager_;
 
@@ -731,6 +823,12 @@ class Core {
   // adoption so the aggregator/console can flag degraded ranks.
   Gauge* m_clock_offset_gauge_ = nullptr;
   Gauge* m_clock_err_gauge_ = nullptr;
+  // Negotiation-cache effectiveness: requests that rode the bare-name fast
+  // path vs full announcements while the cache was tracking (workers count
+  // their send decision, rank 0 counts bare-name rematerializations and
+  // NEED_FULL repairs).
+  Counter* m_cache_hits_ = nullptr;
+  Counter* m_cache_misses_ = nullptr;
   // One failure-cascade count per core incarnation: after the plane aborts,
   // every queued op fails with the same coherent status — only the first
   // detection is a new failure (background thread only).
@@ -1014,13 +1112,13 @@ void Core::MaybeGradcheck(const std::string& name, const void* data,
   if (control_fd_ < 0) return;
   // Piggybacked control-plane frame: rides the already-open coordinator
   // connection, one small frame per sampled op (cost model in
-  // docs/numerics.md).
+  // docs/numerics.md) — batched with the cycle's other control traffic.
   Writer w;
   w.I32(static_cast<int32_t>(CtrlMsg::GRADCHECK));
   w.I64(seq);
   w.I64(static_cast<int64_t>(crc));
   w.Str(name);
-  SendFrame(control_fd_, w.buffer());
+  outbox_.Queue(control_fd_, w.Take());
 }
 
 void Core::RecordFingerprint(int64_t seq, int rank, uint32_t crc,
@@ -1289,11 +1387,38 @@ Status Core::Start() {
   m_rss_peak_gauge_ = metrics_.GetGauge(
       "hvdtpu_rss_peak_bytes",
       "Peak resident set size of this worker process (getrusage ru_maxrss)");
+  // Negotiation-cache effectiveness (docs/metrics.md): steady-state cycles
+  // over a repeating tensor set should be all hits after the first
+  // negotiation — a rising miss rate means eviction churn (capacity too
+  // small) or requests that keep changing shape.
+  m_cache_hits_ = metrics_.GetCounter(
+      "hvdtpu_negotiation_cache_hits_total",
+      "Negotiation requests that rode the response-cache bare-name fast "
+      "path (workers: sent name-only; rank 0: rematerialized from cache)");
+  m_cache_misses_ = metrics_.GetCounter(
+      "hvdtpu_negotiation_cache_misses_total",
+      "Negotiation requests sent or received in full while the cache was "
+      "tracking (first sight, changed request, eviction, or NEED_FULL "
+      "repair)");
+  // Control-plane batching (docs/metrics.md): frames/batches is the
+  // syscall amplification the CtrlOutbox removes — with HVDTPU_CTRL_BATCH=0
+  // the two counters advance in lockstep.
+  outbox_.set_enabled(cfg_.ctrl_batch != 0);
+  outbox_.set_counters(
+      metrics_.GetCounter(
+          "hvdtpu_ctrl_frames_total",
+          "Control-plane frames this rank produced (READY/RESPONSES/CLOCK/"
+          "GRADCHECK/...; each is one syscall when batching is off)"),
+      metrics_.GetCounter(
+          "hvdtpu_ctrl_batches_total",
+          "Vectored control-plane sends issued (one per peer per flush "
+          "under HVDTPU_CTRL_BATCH=1; equals frames_total when off)"));
 
   data_plane_.set_allreduce_algo(
       static_cast<AllreduceAlgo>(cfg_.allreduce_algo));
   data_plane_.set_crossover_bytes(cfg_.allreduce_crossover);
   data_plane_.set_segment_bytes(cfg_.allreduce_segment);
+  data_plane_.set_sa_min_group(cfg_.allreduce_sa_group);
   data_plane_.set_shm_enabled(cfg_.shm_enabled != 0);
   data_plane_.set_shm_ring_bytes(cfg_.shm_ring_bytes);
   data_plane_.set_hier_mode(static_cast<HierMode>(cfg_.allreduce_hier));
@@ -1606,11 +1731,20 @@ Status Core::Start() {
         cfg_.wire_compression ==
             static_cast<int32_t>(WireCompression::AUTO) &&
         cfg_.size > 1;
+    // The scatter-allgather switch joins the GP only when AUTO's
+    // big-message dispatch can actually reach it: algorithm unpinned and a
+    // world at or past the sa_min_group floor (a smaller world makes the
+    // coordinate inert, like the hier/comp gates).
+    const bool tune_sa =
+        data_plane_.allreduce_algo() == AllreduceAlgo::AUTO &&
+        data_plane_.sa_min_group() > 0 &&
+        cfg_.size >= data_plane_.sa_min_group();
     param_manager_.Initialize(cycle_ms_now, fusion_now,
                               cfg_.cache_capacity > 0,
                               data_plane_.crossover_bytes(),
                               data_plane_.allreduce_algo() ==
                                   AllreduceAlgo::AUTO,
+                              data_plane_.sa_auto(), tune_sa,
                               /*hier_enabled=*/false, tune_hier,
                               /*wire_compression=*/0, tune_comp,
                               cfg_.autotune_log, cfg_.autotune_warmup_samples,
@@ -1846,6 +1980,10 @@ void Core::BackgroundLoop() {
     if (cfg_.timeline_mark_cycles) timeline_.MarkCycle();
     const double t0 = NowSeconds();
     PumpControlPlane();
+    // End-of-cycle flush: whatever the pump queued and no collective forced
+    // out earlier (CLOCK pings/echoes, GRADCHECK piggybacks, PARAMS, READY
+    // lists on quiet cycles) ships as one vectored send per peer.
+    FlushCtrlOutbox();
     // Coordination-tick accounting: latency of the productive part of the
     // cycle (the idle poll in WaitForWork is deliberately excluded — an
     // idle worker would otherwise bury the signal under cycle_time_ms
@@ -1968,7 +2106,9 @@ void Core::PumpControlPlane() {
         bool hit = cache_.tracking() && cache_.CheckAndPut(q);
         if (hit && cache_.enabled()) {
           cached.push_back(q.name);
+          m_cache_hits_->Inc();
         } else {
+          if (cache_.tracking()) m_cache_misses_->Inc();
           fulls.push_back(std::move(q));
         }
       }
@@ -1978,7 +2118,7 @@ void Core::PumpControlPlane() {
       Writer w;
       w.I32(static_cast<int32_t>(CtrlMsg::JOIN));
       w.I32(cfg_.rank);
-      SendFrame(control_fd_, w.buffer());
+      outbox_.Queue(control_fd_, w.Take());
     }
     // Periodic clock-sync refresh while a timeline runs (docs/tracing.md):
     // at most one CLOCK ping in flight; the reply is handled in the drain
@@ -1998,7 +2138,10 @@ void Core::PumpControlPlane() {
       w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
       w.I64(Timeline::SteadyAbsUs());
       w.I64(0);
-      if (SendFrame(control_fd_, w.buffer()) == 0) {
+      // Queued frames ship at the next flush (same pump); a batched send
+      // failure surfaces there, and the two-interval re-arm above recovers
+      // the lost ping either way.
+      if (outbox_.Queue(control_fd_, w.Take()) == 0) {
         clock_ping_inflight_ = true;
         clock_ping_sent_at_ = NowSeconds();
       }
@@ -2097,12 +2240,14 @@ void Core::PumpControlPlane() {
         int64_t crossover = r.I64();
         bool hier_on = r.I32() != 0;
         int32_t comp = r.I32();
+        bool sa_on = r.I32() != 0;
         if (!r.ok()) {
           LogBadFrame(cfg_.rank, "worker PARAMS", frame);
           continue;
         }
         // data_plane_ is driven by this (background) thread only.
         data_plane_.set_crossover_bytes(crossover);
+        data_plane_.set_sa_auto(sa_on);
         data_plane_.set_hier_auto(hier_on);
         comp_auto_ = comp;
         {
@@ -2138,8 +2283,19 @@ void Core::WorkerSendReady(std::vector<Request> reqs,
   for (const auto& q : reqs) SerializeRequest(q, &w);
   w.I64(static_cast<int64_t>(cached.size()));
   for (const auto& name : cached) w.Str(name);
-  if (SendFrame(control_fd_, w.buffer()) != 0 && !shutdown_) {
+  if (outbox_.Queue(control_fd_, w.Take()) != 0 && !shutdown_) {
     LogWarn(cfg_.rank, "failed to send ready list to coordinator");
+  }
+}
+
+void Core::FlushCtrlOutbox() {
+  if (!outbox_.pending()) return;
+  int bad_fd = -1;
+  if (outbox_.Flush(&bad_fd) != 0 && !shutdown_) {
+    // Same policy as the unbatched sends: a failed control write is only
+    // logged — the authoritative disconnect signal is the RecvFrame EOF
+    // (coordinator ingest / worker drain), which runs the failover path.
+    LogWarn(cfg_.rank, "control-plane flush failed (fd %d)", bad_fd);
   }
 }
 
@@ -2170,6 +2326,7 @@ void Core::CoordinatorIngest() {
           // so it must fail over, not hang (HandleReadyRequests checks this).
           if (!joined_ranks_.count(rank)) dead_ranks_.insert(rank);
           worker_fds_[rank] = -1;
+          outbox_.Forget(fd);
           CloseFd(fd);
         }
         break;
@@ -2194,8 +2351,10 @@ void Core::CoordinatorIngest() {
           if (!r.ok()) break;
           Request q;
           if (cache_.GetRank(name, rank, &q)) {
+            m_cache_hits_->Inc();
             reqs.push_back(std::move(q));
           } else {
+            m_cache_misses_->Inc();
             need_full.push_back(std::move(name));
           }
         }
@@ -2208,7 +2367,7 @@ void Core::CoordinatorIngest() {
           w.I32(static_cast<int32_t>(CtrlMsg::NEED_FULL));
           w.I64(static_cast<int64_t>(need_full.size()));
           for (const auto& name : need_full) w.Str(name);
-          SendFrame(fd, w.buffer());
+          outbox_.Queue(fd, w.Take());
         }
         HandleReadyRequests(std::move(reqs));
       } else if (type == CtrlMsg::JOIN) {
@@ -2229,7 +2388,7 @@ void Core::CoordinatorIngest() {
         w.I32(static_cast<int32_t>(CtrlMsg::CLOCK));
         w.I64(t1);
         w.I64(Timeline::SteadyAbsUs());
-        SendFrame(fd, w.buffer());
+        outbox_.Queue(fd, w.Take());
       } else if (type == CtrlMsg::GRADCHECK) {
         // Divergence probe report (docs/numerics.md): one sampled op's
         // post-allreduce fingerprint from this worker.
@@ -2565,7 +2724,7 @@ void Core::CoordinatorEmitResponses() {
     for (const auto& resp : list) SerializeResponse(resp, &w);
     std::vector<uint8_t> payload = w.Take();
     for (int rank = 1; rank < cfg_.size; ++rank) {
-      if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
+      if (worker_fds_[rank] >= 0) outbox_.Queue(worker_fds_[rank], payload);
     }
   }
 
@@ -2594,6 +2753,7 @@ void Core::CoordinatorEmitResponses() {
     if (bytes > 0 && param_manager_.Update(bytes, NowSeconds())) {
       ParameterManager::Params p = param_manager_.Current();
       data_plane_.set_crossover_bytes(p.algo_crossover);
+      data_plane_.set_sa_auto(p.sa_enabled);
       data_plane_.set_hier_auto(p.hier_enabled);
       comp_auto_ = p.wire_compression;
       {
@@ -2613,9 +2773,12 @@ void Core::CoordinatorEmitResponses() {
         w.I64(p.algo_crossover);
         w.I32(p.hier_enabled ? 1 : 0);
         w.I32(p.wire_compression);
+        w.I32(p.sa_enabled ? 1 : 0);
         std::vector<uint8_t> payload = w.Take();
         for (int rank = 1; rank < cfg_.size; ++rank) {
-          if (worker_fds_[rank] >= 0) SendFrame(worker_fds_[rank], payload);
+          // Queued (flushed at pump end): a PARAMS frame lands on the wire
+          // strictly after the RESPONSES list it was adopted behind.
+          if (worker_fds_[rank] >= 0) outbox_.Queue(worker_fds_[rank], payload);
         }
       }
     }
@@ -2623,6 +2786,11 @@ void Core::CoordinatorEmitResponses() {
 }
 
 void Core::ExecuteResponseList(const std::vector<Response>& list) {
+  // Everything queued so far MUST hit the wire before any collective below
+  // can block: on rank 0 that includes the RESPONSES list itself (workers
+  // cannot join the collective they never heard about), on workers the
+  // READY/NEED_FULL repairs the coordinator is polling for.
+  FlushCtrlOutbox();
   for (const auto& resp : list) ExecuteResponse(resp);
 }
 
@@ -3517,17 +3685,31 @@ int hvdtpu_set_secret(void* core, const char* secret) {
 }
 
 // Allreduce algorithm selection (data_plane.h AllreduceAlgo: 0 auto, 1 ring,
-// 2 recursive_doubling, 3 tree). crossover_bytes tunes the AUTO ring/latency
-// switchover, segment_bytes the ring pipeline granularity; values <= 0 keep
-// the defaults (and AUTO's crossover stays under autotune ownership).
+// 2 recursive_doubling, 3 tree, 4 scatter_allgather, 5 parameter_server).
+// crossover_bytes tunes the AUTO ring/latency switchover, segment_bytes the
+// ring pipeline granularity; values <= 0 keep the defaults (and AUTO's
+// crossover stays under autotune ownership).
 int hvdtpu_set_allreduce_tuning(void* core, int algo,
                                 long long crossover_bytes,
                                 long long segment_bytes) {
-  if (algo < 0 || algo > 3) return -1;
+  if (algo < 0 || algo > 5) return -1;
   hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
   cfg->allreduce_algo = algo;
   cfg->allreduce_crossover = crossover_bytes;
   cfg->allreduce_segment = segment_bytes;
+  return 0;
+}
+
+// Scale-out knobs (docs/collectives.md "Scaling out"). sa_group: group-size
+// floor at which AUTO's big-message dispatch prefers scatter-allgather over
+// the ring (HVDTPU_ALLREDUCE_SA_GROUP; < 0 keeps the default, 0 removes it
+// from the AUTO menu). ctrl_batch: nonzero coalesces each background
+// cycle's control-plane frames into one vectored send per peer
+// (HVDTPU_CTRL_BATCH). Pre-Start() only.
+int hvdtpu_set_scale_tuning(void* core, long long sa_group, int ctrl_batch) {
+  hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
+  cfg->allreduce_sa_group = sa_group;
+  cfg->ctrl_batch = ctrl_batch;
   return 0;
 }
 

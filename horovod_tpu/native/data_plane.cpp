@@ -1070,12 +1070,26 @@ Status DataPlane::AllreduceGroup(void* data, int64_t count, DataType dtype,
   AllreduceAlgo algo = algo_;
   if (algo == AllreduceAlgo::AUTO) {
     const int64_t bytes = count * static_cast<int64_t>(DataTypeSize(dtype));
-    algo = bytes <= crossover_bytes_ ? AllreduceAlgo::RECURSIVE_DOUBLING
-                                     : AllreduceAlgo::RING;
+    if (bytes <= crossover_bytes_) {
+      algo = AllreduceAlgo::RECURSIVE_DOUBLING;
+    } else if (sa_auto_ && sa_min_group_ > 0 &&
+               static_cast<int>(group.size()) >= sa_min_group_) {
+      // Large message, large group: scatter-allgather's 2 rounds of depth
+      // beat the ring's 2(gs-1) serialized hops. The static gate is the
+      // HVDTPU_ALLREDUCE_SA_GROUP floor; sa_auto_ is the autotuner's pick.
+      // The decision depends only on world-agreed values (group size,
+      // adopted knobs), so the schedule cannot split across ranks.
+      algo = AllreduceAlgo::SCATTER_ALLGATHER;
+    } else {
+      algo = AllreduceAlgo::RING;
+    }
   }
-  last_algo_label_ = algo == AllreduceAlgo::RECURSIVE_DOUBLING
-                         ? "recursive_doubling"
-                         : algo == AllreduceAlgo::TREE ? "tree" : "ring";
+  last_algo_label_ =
+      algo == AllreduceAlgo::RECURSIVE_DOUBLING  ? "recursive_doubling"
+      : algo == AllreduceAlgo::TREE              ? "tree"
+      : algo == AllreduceAlgo::SCATTER_ALLGATHER ? "scatter_allgather"
+      : algo == AllreduceAlgo::PARAMETER_SERVER  ? "parameter_server"
+                                                 : "ring";
   switch (algo) {
     case AllreduceAlgo::RECURSIVE_DOUBLING:
       if (CompressionActive(dtype, op)) {
@@ -1089,6 +1103,21 @@ Status DataPlane::AllreduceGroup(void* data, int64_t count, DataType dtype,
       // would re-quantize log2(p) times with no bandwidth structure to
       // exploit — compression covers ring + recursive doubling.
       return TreeAllreduceGroup(data, count, dtype, op, group);
+    case AllreduceAlgo::SCATTER_ALLGATHER:
+      if (CompressionActive(dtype, op)) {
+        const int gi = GroupIndex(group, rank_);
+        std::vector<int64_t> starts =
+            ChunkStarts(count, static_cast<int>(group.size()));
+        return CompressedScatterAllgather(static_cast<float*>(data), starts,
+                                          group, gi);
+      }
+      return ScatterAllgatherGroup(data, count, dtype, op, group);
+    case AllreduceAlgo::PARAMETER_SERVER:
+      if (CompressionActive(dtype, op)) {
+        return CompressedParameterServer(static_cast<float*>(data), count,
+                                         group, GroupIndex(group, rank_));
+      }
+      return ParameterServerGroup(data, count, dtype, op, group);
     case AllreduceAlgo::AUTO:
     case AllreduceAlgo::RING:
       break;
@@ -1505,6 +1534,292 @@ Status DataPlane::TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
       Status st = SendTo(group[gi + d], data, bytes, "tree bcast send");
       if (!st.ok()) return st;
     }
+  }
+  return Status::OK();
+}
+
+Status DataPlane::ScatterAllgatherGroup(void* data, int64_t count,
+                                        DataType dtype, ReduceOp op,
+                                        const std::vector<int>& group) {
+  const size_t elem = DataTypeSize(dtype);
+  uint8_t* buf = static_cast<uint8_t*>(data);
+  const int gs = static_cast<int>(group.size());
+  const int gi = GroupIndex(group, rank_);
+  std::vector<int64_t> starts = ChunkStarts(count, gs);
+  auto chunk_ptr = [&](int c) { return buf + starts[c] * elem; };
+  auto chunk_bytes = [&](int c) {
+    return (starts[c + 1] - starts[c]) * static_cast<int64_t>(elem);
+  };
+  // Ring-identical chunk ownership: member j owns chunk (j+1) % gs.
+  auto owned = [&](int j) { return (j + 1) % gs; };
+  const int own_c = owned(gi);
+  const int64_t mine = chunk_bytes(own_c);
+
+  // Accumulator for the gs-1 incoming copies of my owned chunk, plus a
+  // landing buffer for the segmented exchanges (the shm lanes consume
+  // segments in place and leave it untouched; the TCP lanes stage there).
+  std::vector<uint8_t> tmp(static_cast<size_t>(mine));
+  std::vector<uint8_t> scratch(static_cast<size_t>(mine));
+  int64_t seg = segment_bytes_ - segment_bytes_ % static_cast<int64_t>(elem);
+  if (seg <= 0) seg = static_cast<int64_t>(elem);
+
+  // Phase 1 — direct-exchange reduce-scatter: at step k, ship peer
+  // (gi - k)'s owned slice straight out of MY buffer while receiving peer
+  // (gi + k)'s copy of MY owned chunk. The copies arrive from members
+  // own_c, own_c+1, ..., gi-1 in that order — exactly the ring
+  // reduce-scatter's accumulation order — and my own contribution folds in
+  // last, so the reduced chunk is bitwise the ring's (commutative
+  // per-application IEEE ops; see data_plane.h).
+  for (int k = 1; k < gs; ++k) {
+    const int send_i = (gi - k + gs) % gs;
+    const int recv_i = (gi + k) % gs;
+    const int send_c = owned(send_i);
+    const int64_t send_bytes = chunk_bytes(send_c);
+    AddOpBytes(send_bytes, send_bytes);
+    Status st;
+    if (mine == 0) {
+      st = Exchange(group[send_i], chunk_ptr(send_c), send_bytes,
+                    group[recv_i], nullptr, 0);
+    } else if (k == 1) {
+      // First copy lands plain: the accumulator starts as x_{own_c}.
+      st = Exchange(group[send_i], chunk_ptr(send_c), send_bytes,
+                    group[recv_i], tmp.data(), mine);
+    } else {
+      // Later copies stream through the segmented exchange so the
+      // reduction of segment s overlaps the transfer of segment s+1, like
+      // the ring reduce-scatter.
+      int64_t reduce_first_us = 0, reduce_last_us = 0, reduce_busy_us = 0;
+      st = Exchange(
+          group[send_i], chunk_ptr(send_c), send_bytes, group[recv_i],
+          scratch.data(), mine, seg,
+          [&](const uint8_t* d, size_t off, size_t len) {
+            ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
+            const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+            ReduceBuffer(tmp.data() + off, d,
+                         static_cast<int64_t>(len / elem), dtype, op);
+            if (rec_hops_) {
+              const int64_t rt1 = Timeline::SteadyAbsUs();
+              if (reduce_first_us == 0) reduce_first_us = rt0;
+              reduce_last_us = rt1;
+              reduce_busy_us += rt1 - rt0;
+            }
+          },
+          elem);
+      if (st.ok() && rec_hops_ && reduce_first_us != 0) {
+        op_reduce_us_ += reduce_busy_us;
+        if (flight_ != nullptr) {
+          flight_->Record(FlightEvent::REDUCE, -1, mine, -1, -1,
+                          reduce_first_us, reduce_last_us, reduce_busy_us,
+                          0);
+        }
+        if (trace_op_) {
+          tracer_->Span(
+              "hops", "REDUCE", reduce_first_us, reduce_last_us,
+              "{\"bytes\": " + std::to_string(mine) +
+                  ", \"busy_us\": " + std::to_string(reduce_busy_us) +
+                  ", \"seg\": " + std::to_string(trace_hop_seq_++) + "}");
+        }
+      }
+    }
+    if (!st.ok()) return st;
+  }
+  if (mine > 0) {
+    // My contribution folds in last, where the ring's final reduce-scatter
+    // step puts it: chunk = x_gi OP (x_c OP ... OP x_{gi-1}).
+    ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
+    const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    ReduceBuffer(chunk_ptr(own_c), tmp.data(), starts[own_c + 1] - starts[own_c],
+                 dtype, op);
+    TraceHop("REDUCE", -1, -1, mine, rt0, io_ctl_.WaitUs());
+  }
+
+  // Phase 2 — direct allgather: every peer gets my reduced chunk straight
+  // from its owner (one hop of depth; no store-and-forward reshipping).
+  for (int k = 1; k < gs; ++k) {
+    const int to_i = (gi + k) % gs;
+    const int from_i = (gi - k + gs) % gs;
+    const int from_c = owned(from_i);
+    AddOpBytes(mine, mine);
+    Status st = Exchange(group[to_i], chunk_ptr(own_c), mine, group[from_i],
+                         chunk_ptr(from_c), chunk_bytes(from_c));
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::CompressedScatterAllgather(
+    float* buf, const std::vector<int64_t>& starts,
+    const std::vector<int>& group, int gi) {
+  const WireCompression c = op_comp_;
+  const int gs = static_cast<int>(group.size());
+  auto chunk_count = [&](int ch) { return starts[ch + 1] - starts[ch]; };
+  auto owned = [&](int j) { return (j + 1) % gs; };
+  const int own_c = owned(gi);
+  const int64_t mc = chunk_count(own_c);
+  int64_t max_chunk = 0;
+  for (int ch = 0; ch < gs; ++ch) {
+    max_chunk = std::max(max_chunk, chunk_count(ch));
+  }
+  std::vector<uint8_t> send_wire(static_cast<size_t>(WireBytes(c, max_chunk)));
+  std::vector<uint8_t> recv_wire(send_wire.size());
+
+  // Phase 1: quantize each peer's slice out of MY buffer (error feedback at
+  // that region — together with phase 2's own-chunk quantize, every region
+  // is compressed exactly once per rank per op) and dequantize-add the
+  // incoming copies straight into my owned chunk, which starts as x_gi.
+  for (int k = 1; k < gs; ++k) {
+    const int send_i = (gi - k + gs) % gs;
+    const int recv_i = (gi + k) % gs;
+    const int send_c = owned(send_i);
+    const int64_t sc = chunk_count(send_c);
+    const int64_t sw = WireBytes(c, sc);
+    const int64_t rw = WireBytes(c, mc);
+    const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireCompress(c, buf + starts[send_c], sc, send_wire.data(),
+                   op_residual_ != nullptr ? op_residual_ + starts[send_c]
+                                           : nullptr,
+                   nullptr, op_quality_);
+    }
+    TraceHop("QUANTIZE", -1, -1, sc * 4, qt0, io_ctl_.WaitUs());
+    AddOpBytes(sc * 4, sw);
+    Status st = Exchange(group[send_i], send_wire.data(), sw, group[recv_i],
+                         recv_wire.data(), rw);
+    if (!st.ok()) return st;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompressAdd(c, recv_wire.data(), mc, buf + starts[own_c]);
+    }
+    TraceHop("DEQUANTIZE", -1, -1, mc * 4, dt0, io_ctl_.WaitUs());
+  }
+
+  // Phase 2: the owner quantizes its fully reduced chunk ONCE (residual
+  // applied, own copy replaced by the dequantized values) and the direct
+  // rotation ships those same wire bytes to every peer — the whole group
+  // decodes identical codes, so the final vectors agree bitwise.
+  const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+  {
+    ProfPhaseScope prof_codec(PerfPhase::CODEC);
+    WireCompress(c, buf + starts[own_c], mc, send_wire.data(),
+                 op_residual_ != nullptr ? op_residual_ + starts[own_c]
+                                         : nullptr,
+                 buf + starts[own_c], op_quality_);
+  }
+  TraceHop("QUANTIZE", -1, -1, mc * 4, qt0, io_ctl_.WaitUs());
+  const int64_t ow = WireBytes(c, mc);
+  for (int k = 1; k < gs; ++k) {
+    const int to_i = (gi + k) % gs;
+    const int from_i = (gi - k + gs) % gs;
+    const int from_c = owned(from_i);
+    const int64_t rc = chunk_count(from_c);
+    const int64_t rw = WireBytes(c, rc);
+    AddOpBytes(mc * 4, ow);
+    Status st = Exchange(group[to_i], send_wire.data(), ow, group[from_i],
+                         recv_wire.data(), rw);
+    if (!st.ok()) return st;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompress(c, recv_wire.data(), rc, buf + starts[from_c]);
+    }
+    TraceHop("DEQUANTIZE", -1, -1, rc * 4, dt0, io_ctl_.WaitUs());
+  }
+  return Status::OK();
+}
+
+Status DataPlane::ParameterServerGroup(void* data, int64_t count,
+                                       DataType dtype, ReduceOp op,
+                                       const std::vector<int>& group) {
+  const size_t elem = DataTypeSize(dtype);
+  const int64_t bytes = count * static_cast<int64_t>(elem);
+  const int gs = static_cast<int>(group.size());
+  const int gi = GroupIndex(group, rank_);
+
+  if (gi != 0) {
+    AddOpBytes(bytes, bytes);
+    Status st = SendTo(group[0], data, bytes, "ps gather send");
+    if (!st.ok()) return st;
+    return RecvFrom(group[0], data, bytes, "ps bcast recv");
+  }
+  // Root: absorb every worker's vector in rank order (the same sequential
+  // one-directional drain as the tree reduce — no cycle, no deadlock),
+  // then broadcast the single reduced buffer. One reducer, one buffer:
+  // cross-rank bitwise equality is trivial.
+  std::vector<uint8_t> other(static_cast<size_t>(bytes));
+  for (int j = 1; j < gs; ++j) {
+    Status st = RecvFrom(group[j], other.data(), bytes, "ps gather recv");
+    if (!st.ok()) return st;
+    ProfPhaseScope prof_reduce(PerfPhase::REDUCE);
+    const int64_t rt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    ReduceBuffer(data, other.data(), count, dtype, op);
+    TraceHop("REDUCE", -1, -1, bytes, rt0, io_ctl_.WaitUs());
+  }
+  for (int j = 1; j < gs; ++j) {
+    AddOpBytes(bytes, bytes);
+    Status st = SendTo(group[j], data, bytes, "ps bcast send");
+    if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::CompressedParameterServer(float* buf, int64_t count,
+                                            const std::vector<int>& group,
+                                            int gi) {
+  const WireCompression c = op_comp_;
+  const int gs = static_cast<int>(group.size());
+  const int64_t raw_bytes = count * 4;
+  const int64_t wb = WireBytes(c, count);
+  std::vector<uint8_t> wire(static_cast<size_t>(wb));
+
+  if (gi != 0) {
+    // Quantized uplink with error feedback at the worker...
+    const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireCompress(c, buf, count, wire.data(), op_residual_, nullptr,
+                   op_quality_);
+    }
+    TraceHop("QUANTIZE", -1, -1, raw_bytes, qt0, io_ctl_.WaitUs());
+    AddOpBytes(raw_bytes, wb);
+    Status st = SendTo(group[0], wire.data(), wb, "ps gather send");
+    if (!st.ok()) return st;
+    // ...then decode the root's single quantized broadcast: every rank
+    // sees the same codes (quantize-once-at-owner).
+    st = RecvFrom(group[0], wire.data(), wb, "ps bcast recv");
+    if (!st.ok()) return st;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompress(c, wire.data(), count, buf);
+    }
+    TraceHop("DEQUANTIZE", -1, -1, raw_bytes, dt0, io_ctl_.WaitUs());
+    return Status::OK();
+  }
+  std::vector<uint8_t> peer_wire(static_cast<size_t>(wb));
+  for (int j = 1; j < gs; ++j) {
+    Status st = RecvFrom(group[j], peer_wire.data(), wb, "ps gather recv");
+    if (!st.ok()) return st;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompressAdd(c, peer_wire.data(), count, buf);
+    }
+    TraceHop("DEQUANTIZE", -1, -1, raw_bytes, dt0, io_ctl_.WaitUs());
+  }
+  // The root quantizes the reduced vector ONCE (self-decoding its own
+  // copy) and ships the identical wire bytes to every worker.
+  const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+  {
+    ProfPhaseScope prof_codec(PerfPhase::CODEC);
+    WireCompress(c, buf, count, wire.data(), op_residual_, buf, op_quality_);
+  }
+  TraceHop("QUANTIZE", -1, -1, raw_bytes, qt0, io_ctl_.WaitUs());
+  for (int j = 1; j < gs; ++j) {
+    AddOpBytes(raw_bytes, wb);
+    Status st = SendTo(group[j], wire.data(), wb, "ps bcast send");
+    if (!st.ok()) return st;
   }
   return Status::OK();
 }

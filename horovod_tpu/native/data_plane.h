@@ -36,15 +36,22 @@ struct PeerAddr {
 };
 
 // Allreduce algorithm menu (reference fork: the IST-DASLab layer's
-// ring / scatter-allgather / tree reduction selection). AUTO picks the
-// latency algorithm (recursive doubling) at or below the crossover size and
-// the pipelined ring above it; the crossover is owned by the autotune
-// machinery (autotune.h ParameterManager).
+// ring / scatter-allgather / parameter-server / tree reduction selection).
+// AUTO picks the latency algorithm (recursive doubling) at or below the
+// crossover size and a bandwidth algorithm above it — the pipelined ring, or
+// scatter-allgather once the group reaches sa_min_group ranks (where the
+// ring's 2(n-1) serialized hops lose to SA's one round-trip of depth); the
+// crossover and the SA choice are owned by the autotune machinery
+// (autotune.h ParameterManager). PARAMETER_SERVER is explicit-selection
+// only: workers ship the whole vector to a root that reduces and
+// broadcasts — the reference PS baseline, never a win AUTO should pick.
 enum class AllreduceAlgo : int32_t {
   AUTO = 0,
   RING = 1,
   RECURSIVE_DOUBLING = 2,
   TREE = 3,
+  SCATTER_ALLGATHER = 4,
+  PARAMETER_SERVER = 5,
 };
 
 // Default ring/latency-algorithm crossover: messages at or below this ride
@@ -56,6 +63,12 @@ constexpr int64_t kDefaultAlgoCrossoverBytes = 32 * 1024;
 // Default ring pipeline segment: each ring chunk is streamed in segments of
 // this size so reduction of segment k overlaps the transfer of segment k+1.
 constexpr int64_t kDefaultSegmentBytes = 1 << 20;
+// Default group size at which AUTO prefers scatter-allgather over the ring
+// above the crossover: SA's direct exchange finishes in ~2 rounds of depth
+// vs the ring's 2(n-1) serialized hops, but posts n-1 concurrent lanes —
+// oversubscribed small worlds do better on the ring's two-lane schedule.
+// Override with HVDTPU_ALLREDUCE_SA_GROUP (0 = never auto-pick SA).
+constexpr int kDefaultSaMinGroup = 16;
 
 // Hierarchical two-level allreduce (HVDTPU_ALLREDUCE_HIER / hvdrun --hier):
 // intra-host ring reduce-scatter/allgather over the (shm) local lanes, one
@@ -161,9 +174,16 @@ class DataPlane {
   void set_allreduce_algo(AllreduceAlgo algo) { algo_ = algo; }
   void set_crossover_bytes(int64_t b) { if (b > 0) crossover_bytes_ = b; }
   void set_segment_bytes(int64_t b) { if (b > 0) segment_bytes_ = b; }
+  // AUTO's scatter-allgather gate: groups of at least this many ranks take
+  // SA above the crossover (0 = never). set_sa_auto is the autotuner's
+  // per-cycle choice on top of the static gate, mirroring set_hier_auto.
+  void set_sa_min_group(int64_t n) { if (n >= 0) sa_min_group_ = static_cast<int>(n); }
+  void set_sa_auto(bool on) { sa_auto_ = on; }
   AllreduceAlgo allreduce_algo() const { return algo_; }
   int64_t crossover_bytes() const { return crossover_bytes_; }
   int64_t segment_bytes() const { return segment_bytes_; }
+  int sa_min_group() const { return sa_min_group_; }
+  bool sa_auto() const { return sa_auto_; }
 
   // Transport / topology knobs. set_shm_enabled and set_shm_ring_bytes must
   // be called before Connect (the lanes are negotiated there); hier mode may
@@ -286,9 +306,10 @@ class DataPlane {
   // anomaly's named suspect. Background thread only, like the accumulators.
   int op_slow_peer() const { return op_slow_peer_; }
   // Label of the algorithm the LAST Allreduce actually ran ("ring",
-  // "recursive_doubling", "tree", with AUTO resolved by size; "hier" phases
-  // report the top-level "hierarchical"). Background thread only — set by
-  // Allreduce, read by the core's per-op metric labels.
+  // "recursive_doubling", "tree", "scatter_allgather", "parameter_server",
+  // with AUTO resolved by size; "hier" phases report the top-level
+  // "hierarchical"). Background thread only — set by Allreduce, read by the
+  // core's per-op metric labels.
   const char* last_algo_label() const { return last_algo_label_; }
 
   // Gather variable-length byte blocks from every rank; out = concatenated in
@@ -379,6 +400,21 @@ class DataPlane {
   // entry; half the exchange volume of recursive doubling, twice the depth).
   Status TreeAllreduceGroup(void* data, int64_t count, DataType dtype,
                             ReduceOp op, const std::vector<int>& group);
+  // Direct-exchange two-phase reduce (reference fork's scatter-allgather
+  // menu entry): phase 1 rotates gs-1 pairwise exchanges so each rank
+  // receives its owned chunk's slice from EVERY peer and reduces locally in
+  // ascending source-rank order — the same accumulation order the ring's
+  // reduce-scatter produces, so the result is bitwise identical to the
+  // ring's; phase 2 is the rotation allgather. Same chunk ownership as the
+  // ring: member gi owns chunk (gi+1) % gs.
+  Status ScatterAllgatherGroup(void* data, int64_t count, DataType dtype,
+                               ReduceOp op, const std::vector<int>& group);
+  // Parameter-server baseline (reference PS mode): every worker ships the
+  // whole vector to group[0], which reduces in rank order and broadcasts
+  // the result — 2 hops of depth, n x the root's wire volume. The single
+  // reduced buffer makes cross-rank bitwise equality trivial.
+  Status ParameterServerGroup(void* data, int64_t count, DataType dtype,
+                              ReduceOp op, const std::vector<int>& group);
 
   // Compressed-hop variants of the ring phases (fp32 SUM only; gated by
   // CompressionActive). Reduce-scatter: each hop quantizes the outgoing
@@ -401,6 +437,22 @@ class DataPlane {
   // folded ranks match the main group exactly.
   Status CompressedRecursiveDoubling(float* data, int64_t count,
                                      const std::vector<int>& group);
+  // Compressed scatter-allgather: phase 1 quantizes every outgoing slice
+  // (error feedback applied at its buffer region — each region is
+  // compressed exactly once per op: gs-1 peer slices here, the owned chunk
+  // in phase 2) and the receiver dequantize-adds into its owned chunk;
+  // phase 2 is the quantize-once-at-owner rotation the ring allgather uses,
+  // so every rank decodes identical codes and the vectors stay bitwise
+  // identical world-wide.
+  Status CompressedScatterAllgather(float* buf,
+                                    const std::vector<int64_t>& starts,
+                                    const std::vector<int>& group, int gi);
+  // Compressed parameter-server: workers quantize the uplink (error
+  // feedback at the worker), the root dequantize-adds in rank order, then
+  // quantizes the result ONCE (self-decoding its own copy) and ships the
+  // same wire bytes to every worker — bitwise identity by construction.
+  Status CompressedParameterServer(float* buf, int64_t count,
+                                   const std::vector<int>& group, int gi);
 
   bool CompressionActive(DataType dtype, ReduceOp op) const {
     return op_comp_ != WireCompression::NONE &&
@@ -443,6 +495,8 @@ class DataPlane {
   AllreduceAlgo algo_ = AllreduceAlgo::AUTO;
   int64_t crossover_bytes_ = kDefaultAlgoCrossoverBytes;
   int64_t segment_bytes_ = kDefaultSegmentBytes;
+  int sa_min_group_ = kDefaultSaMinGroup;
+  bool sa_auto_ = true;  // autotuner's SA-vs-ring pick under AUTO
   bool shm_enabled_ = true;
   int64_t shm_ring_bytes_ = 0;  // 0 = shm_transport.h kDefaultShmRingBytes
   std::string transport_label_ = "local";

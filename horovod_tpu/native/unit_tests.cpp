@@ -1078,7 +1078,9 @@ void TestDataPlaneAllreduceAlgos() {
     for (int world : {2, 3, 4}) {
       for (AllreduceAlgo algo :
            {AllreduceAlgo::AUTO, AllreduceAlgo::RING,
-            AllreduceAlgo::RECURSIVE_DOUBLING, AllreduceAlgo::TREE}) {
+            AllreduceAlgo::RECURSIVE_DOUBLING, AllreduceAlgo::TREE,
+            AllreduceAlgo::SCATTER_ALLGATHER,
+            AllreduceAlgo::PARAMETER_SERVER}) {
         TestWorld w = MakeWorld(
             std::vector<std::string>(world, "127.0.0.1"));
         for (int r = 0; r < world; ++r) {
@@ -1231,6 +1233,165 @@ void TestDataPlaneZeroCopyDropAborts() {
   CHECK_TRUE(waited < 10.0);
   CHECK_TRUE(w.planes[1]->aborted());
   for (auto& p : w.planes) p->Shutdown();
+}
+
+// Scale-out algorithms (scatter-allgather, parameter-server) against the
+// ring across worlds x transports x wire modes, on fp32 values whose sums
+// are NOT exactly representable:
+//   - every algorithm must agree BITWISE across ranks (compressed modes
+//     included — quantize-once-at-owner makes every rank decode the same
+//     codes);
+//   - scatter-allgather under compression=NONE must match the ring BITWISE
+//     (it replays the ring reduce-scatter's exact fold order);
+//   - parameter-server is a LEFT fold (x_0 + x_1 + ...), a different IEEE
+//     summation order than the ring's owner-rotated fold, so it is only
+//     held to cross-rank identity plus a loose numeric tolerance.
+void TestDataPlaneScaleAlgosBitwise() {
+  const int64_t n = 3001;  // odd: ragged chunks, empty none at w<=5
+  const AllreduceAlgo algos[] = {AllreduceAlgo::RING,
+                                 AllreduceAlgo::SCATTER_ALLGATHER,
+                                 AllreduceAlgo::PARAMETER_SERVER};
+  for (bool shm : {false, true}) {
+    for (int world : {2, 3, 5}) {
+      for (WireCompression comp :
+           {WireCompression::NONE, WireCompression::FP16,
+            WireCompression::INT8, WireCompression::INT4}) {
+        // outs[algo][rank] — filled per algorithm run below.
+        std::vector<std::vector<std::vector<float>>> outs(
+            3, std::vector<std::vector<float>>(world));
+        std::vector<double> expect(n, 0.0);
+        for (int r = 0; r < world; ++r) {
+          for (int64_t i = 0; i < n; ++i) {
+            expect[i] +=
+                0.1 * static_cast<double>((i % 97) + r) + 1e-3;
+          }
+        }
+        for (int a = 0; a < 3; ++a) {
+          TestWorld w = MakeWorld(
+              std::vector<std::string>(world, "127.0.0.1"));
+          for (int r = 0; r < world; ++r) {
+            w.planes[r]->set_allreduce_algo(algos[a]);
+            w.planes[r]->set_segment_bytes(512);
+            w.planes[r]->set_shm_enabled(shm);
+            w.planes[r]->set_shm_ring_bytes(8192);
+            w.planes[r]->set_hier_mode(HierMode::OFF);
+          }
+          std::atomic<int> bad{0};
+          std::vector<std::thread> threads;
+          for (int r = 0; r < world; ++r) {
+            threads.emplace_back([&, r] {
+              if (!w.planes[r]->Connect(w.peers).ok()) {
+                ++bad;
+                return;
+              }
+              outs[a][r].resize(n);
+              for (int64_t i = 0; i < n; ++i) {
+                outs[a][r][i] =
+                    0.1f * static_cast<float>((i % 97) + r) + 1e-3f;
+              }
+              std::vector<float> residual;
+              if (comp != WireCompression::NONE) {
+                residual.assign(n, 0.0f);
+                w.planes[r]->BeginCompressedOp(comp, residual.data());
+              }
+              Status st = w.planes[r]->Allreduce(
+                  outs[a][r].data(), n, DataType::FLOAT32, ReduceOp::SUM);
+              if (comp != WireCompression::NONE) {
+                w.planes[r]->EndCompressedOp();
+              }
+              if (!st.ok()) {
+                std::fprintf(stderr, "scale algo rank %d allreduce: %s\n",
+                             r, st.reason.c_str());
+                ++bad;
+              }
+            });
+          }
+          for (auto& t : threads) t.join();
+          if (bad == 0) {
+            for (int r = 1; r < world; ++r) {
+              if (outs[a][r] != outs[a][0]) ++bad;  // cross-rank bitwise
+            }
+            // Loose numeric sanity (any fold order, any wire mode).
+            const double tol =
+                (comp == WireCompression::NONE   ? 1e-3
+                 : comp == WireCompression::FP16 ? 2e-2
+                 : comp == WireCompression::INT8 ? 0.2
+                                                 : 2.0) *
+                static_cast<double>(world);
+            for (int64_t i = 0; i < n && bad == 0; ++i) {
+              if (std::fabs(outs[a][0][i] - expect[i]) > tol) ++bad;
+            }
+          }
+          if (bad != 0) {
+            std::fprintf(stderr,
+                         "FAIL scale algos world=%d algo=%d comp=%s shm=%d "
+                         "(%d bad)\n",
+                         world, static_cast<int>(algos[a]),
+                         WireCompressionName(comp), shm ? 1 : 0, bad.load());
+            ++failures;
+          }
+          for (auto& p : w.planes) p->Shutdown();
+        }
+        if (comp == WireCompression::NONE) {
+          // scatter-allgather == ring, bitwise, on the raw wire.
+          CHECK_TRUE(outs[1][0] == outs[0][0]);
+        }
+      }
+    }
+  }
+}
+
+// Chaos `drop` (silent partition) mid-collective on the scale-out
+// algorithms: the blackholed hop must trip the read deadline, abort the
+// plane, and cascade — never wedge. Covers both the scatter-allgather
+// direct exchanges and the parameter-server star (worker <-> root lanes).
+void TestDataPlaneScaleAlgosDropAborts() {
+  for (AllreduceAlgo algo : {AllreduceAlgo::SCATTER_ALLGATHER,
+                             AllreduceAlgo::PARAMETER_SERVER}) {
+    const int world = 3;  // ragged chunks + a bystander rank for the cascade
+    TestWorld w = MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+    for (int r = 0; r < world; ++r) {
+      w.planes[r]->set_allreduce_algo(algo);
+      w.planes[r]->set_shm_enabled(false);
+      w.planes[r]->set_hier_mode(HierMode::OFF);
+      w.planes[r]->set_failure_detect_ms(100);
+      w.planes[r]->set_read_deadline_secs(0.3);
+    }
+    ChaosSpec drop;
+    drop.action = ChaosSpec::Action::DROP;
+    drop.hop_index = 1;
+    drop.peer = 0;
+    w.planes[1]->set_chaos(drop);
+    const int64_t n = 100001;
+    std::atomic<int> failed{0};
+    auto t0 = std::chrono::steady_clock::now();
+    std::vector<std::thread> threads;
+    for (int r = 0; r < world; ++r) {
+      threads.emplace_back([&, r] {
+        if (!w.planes[r]->Connect(w.peers).ok()) {
+          ++failed;
+          return;
+        }
+        std::vector<float> v(n, static_cast<float>(r + 1));
+        Status st = w.planes[r]->Allreduce(v.data(), n, DataType::FLOAT32,
+                                           ReduceOp::SUM);
+        if (!st.ok()) ++failed;
+      });
+    }
+    for (auto& t : threads) t.join();
+    double waited = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - t0)
+                        .count();
+    if (failed < 1 || waited >= 10.0 || !w.planes[1]->aborted()) {
+      std::fprintf(stderr,
+                   "FAIL scale algo drop abort algo=%d failed=%d "
+                   "waited=%.1f aborted=%d\n",
+                   static_cast<int>(algo), failed.load(), waited,
+                   w.planes[1]->aborted() ? 1 : 0);
+      ++failures;
+    }
+    for (auto& p : w.planes) p->Shutdown();
+  }
 }
 
 // Hierarchical two-level allreduce across synthetic host topologies: two
@@ -1666,6 +1827,7 @@ void TestParameterManagerFreezesAtBest() {
   ParameterManager pm;
   pm.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
                 /*algo_crossover=*/256 << 10, /*tune_crossover=*/true,
+                /*sa_enabled=*/true, /*tune_sa=*/true,
                 /*hier_enabled=*/false, /*tune_hier=*/true,
                 /*wire_compression=*/0, /*tune_compression=*/true,
                 /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
@@ -1694,6 +1856,7 @@ void TestParameterManagerFreezesAtBest() {
   ParameterManager pinned;
   pinned.Initialize(/*cycle=*/1.0, /*fusion=*/64 << 20, /*cache=*/true,
                     /*algo_crossover=*/123456, /*tune_crossover=*/false,
+                    /*sa_enabled=*/false, /*tune_sa=*/false,
                     /*hier_enabled=*/true, /*tune_hier=*/false,
                     /*wire_compression=*/3, /*tune_compression=*/false,
                     /*log=*/"", /*warmup=*/1, /*cycles_per_sample=*/1,
@@ -2962,6 +3125,8 @@ int main() {
   TestDataPlaneAllreduceAlgos();
   TestDataPlaneZeroCopyMatchesCopyPathBitwise();
   TestDataPlaneZeroCopyDropAborts();
+  TestDataPlaneScaleAlgosBitwise();
+  TestDataPlaneScaleAlgosDropAborts();
   TestDataPlaneHierarchicalAllreduce();
   TestWireQuantizerRoundTrip();
   TestWireInt4PackingAndTail();

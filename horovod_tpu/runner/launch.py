@@ -85,8 +85,17 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                    choices=list(ev.ALLREDUCE_ALGOS),
                    help="native allreduce algorithm: auto picks recursive "
                         "doubling below the (autotuned) crossover size and "
-                        "the pipelined ring above it "
-                        "(HVDTPU_ALLREDUCE_ALGO)")
+                        "scatter-allgather or the pipelined ring above it "
+                        "depending on world size (HVDTPU_ALLREDUCE_ALGO)")
+    p.add_argument("--sa-group", type=int, default=None,
+                   help="group-size floor at which auto's big-message "
+                        "dispatch prefers scatter-allgather over the ring "
+                        "(HVDTPU_ALLREDUCE_SA_GROUP; default 16, 0 removes "
+                        "it from the auto menu)")
+    p.add_argument("--no-ctrl-batch", action="store_true",
+                   help="send each control-plane frame on its own syscall "
+                        "instead of one vectored send per peer per cycle "
+                        "(HVDTPU_CTRL_BATCH=0)")
     p.add_argument("--hier", action="store_true",
                    help="force the hierarchical two-level allreduce: "
                         "intra-host reduce-scatter/allgather over "
@@ -356,6 +365,14 @@ def _apply_tuning_env(env: dict, args) -> dict:
     env[ev.HVDTPU_FUSION_THRESHOLD] = str(
         int(args.fusion_threshold_mb * 1024 * 1024))
     env[ev.HVDTPU_ALLREDUCE_ALGO] = args.allreduce_algo
+    # Scale-out knobs: the flags own them only when passed (a user-exported
+    # HVDTPU_ALLREDUCE_SA_GROUP / HVDTPU_CTRL_BATCH wins otherwise).
+    if args.sa_group is not None:
+        if args.sa_group < 0:
+            raise SystemExit("hvdrun: --sa-group must be >= 0")
+        env[ev.HVDTPU_ALLREDUCE_SA_GROUP] = str(args.sa_group)
+    if args.no_ctrl_batch:
+        env[ev.HVDTPU_CTRL_BATCH] = "0"
     # Transport subsystem: shm lanes + hierarchical allreduce (the native
     # side groups ranks by their advertised HVDTPU_HOSTNAME, so the env only
     # carries the on/off knobs — topology detection is hosts.py's slot

@@ -46,16 +46,27 @@ HVDTPU_FUSION_THRESHOLD = "HVDTPU_FUSION_THRESHOLD"
 HVDTPU_CYCLE_TIME = "HVDTPU_CYCLE_TIME"
 
 # Native allreduce algorithm selection (reference fork: the IST-DASLab
-# ring/scatter-allgather/tree menu; native/data_plane.h AllreduceAlgo).
-# ALGO: auto | ring | recursive_doubling | tree. CROSSOVER: AUTO's
-# ring/latency switchover in bytes (also autotuned). SEGMENT_BYTES: ring
-# pipeline segment granularity.
+# ring/scatter-allgather/parameter-server/tree menu; native/data_plane.h
+# AllreduceAlgo). ALGO: auto | ring | recursive_doubling | tree |
+# scatter_allgather | parameter_server. CROSSOVER: AUTO's ring/latency
+# switchover in bytes (also autotuned). SEGMENT_BYTES: ring pipeline
+# segment granularity. SA_GROUP: group-size floor at which AUTO's
+# big-message dispatch prefers scatter-allgather over the ring (default 16;
+# 0 removes scatter-allgather from the AUTO menu).
 HVDTPU_ALLREDUCE_ALGO = "HVDTPU_ALLREDUCE_ALGO"
 HVDTPU_ALLREDUCE_CROSSOVER = "HVDTPU_ALLREDUCE_CROSSOVER"
 HVDTPU_ALLREDUCE_SEGMENT_BYTES = "HVDTPU_ALLREDUCE_SEGMENT_BYTES"
+HVDTPU_ALLREDUCE_SA_GROUP = "HVDTPU_ALLREDUCE_SA_GROUP"
 
 # Valid HVDTPU_ALLREDUCE_ALGO values, mapped to hvdtpu::AllreduceAlgo.
-ALLREDUCE_ALGOS = ("auto", "ring", "recursive_doubling", "tree")
+ALLREDUCE_ALGOS = ("auto", "ring", "recursive_doubling", "tree",
+                   "scatter_allgather", "parameter_server")
+
+# Control-plane frame batching (native/core.cpp CtrlOutbox): "1" (default)
+# coalesces each background cycle's per-tensor READY/RESPONSES/CLOCK/
+# GRADCHECK frames into one vectored send per peer — one syscall per peer
+# per cycle instead of one per message; "0" restores frame-per-send.
+HVDTPU_CTRL_BATCH = "HVDTPU_CTRL_BATCH"
 
 # Transport subsystem (native/transport.h + shm_transport.h; reference
 # analog: the fork's MPI / NCCL / CUDA-IPC SHM / P2P communicator menu).

@@ -34,7 +34,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvdtpu_core.so")
 
-ALGOS = {"auto": 0, "ring": 1, "recursive_doubling": 2, "tree": 3}
+ALGOS = {"auto": 0, "ring": 1, "recursive_doubling": 2, "tree": 3,
+         "scatter_allgather": 4, "parameter_server": 5}
 HIER_MODES = {"off": 0, "on": 1, "auto": 2}
 # hvdtpu::ZeroCopyMode / hvdtpu::ShmNumaMode (native/transport.h,
 # shm_transport.h).
@@ -45,7 +46,7 @@ NUMA_MODES = {"auto": 0, "on": 1, "off": 2}
 # unpaired sweeps, ±10% drift windows apart, on this box).
 AB_FLAGS = ("transport", "hier", "compression", "tcp-zerocopy", "shm-numa",
             "doorbell-batch", "shm-ring-bytes", "segment", "lib", "trace",
-            "flightrec", "perfstats", "prof", "gradstats")
+            "flightrec", "perfstats", "prof", "gradstats", "algo")
 # hvdtpu::WireCompression (native/compressed.h); relative result tolerance
 # per mode (quantized sums are approximate by design).
 COMPRESSION = {"none": (0, 2e-3), "fp16": (1, 5e-3), "int8": (2, 5e-2),
@@ -91,6 +92,12 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_longlong]
     except AttributeError:
         pass  # seed build: no algorithm selection
+    try:
+        lib.hvdtpu_set_scale_tuning.restype = ctypes.c_int
+        lib.hvdtpu_set_scale_tuning.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int]
+    except AttributeError:
+        pass  # pre-scale-out build: no SA group floor / ctrl batching
     try:
         lib.hvdtpu_set_transport.restype = ctypes.c_int
         lib.hvdtpu_set_transport.argtypes = [
@@ -202,8 +209,15 @@ def run_worker(args) -> int:
                   file=sys.stderr)
             return 0
     if hasattr(lib, "hvdtpu_set_allreduce_tuning"):
-        lib.hvdtpu_set_allreduce_tuning(core, ALGOS[args.algo],
-                                        args.crossover, args.segment)
+        # rc-checked: a library predating an algorithm rejects its code
+        # (e.g. scatter_allgather on a 4-algo build) — SKIP, don't measure
+        # a silently-substituted ring.
+        if lib.hvdtpu_set_allreduce_tuning(core, ALGOS[args.algo],
+                                           args.crossover,
+                                           args.segment) != 0:
+            print(f"SKIP algo {args.algo}: library rejects this algorithm",
+                  file=sys.stderr)
+            return 0
     elif args.algo not in ("auto", "ring"):
         print(f"SKIP algo {args.algo}: library has no algorithm selection",
               file=sys.stderr)
@@ -394,9 +408,10 @@ def run_config(args, world: int, algo: str, sizes: list,
            "shm-ring-bytes": args.shm_ring_bytes, "segment": args.segment,
            "lib": args.lib, "trace": args.trace,
            "flightrec": args.flightrec, "perfstats": args.perfstats,
-           "prof": args.prof, "gradstats": args.gradstats}
+           "prof": args.prof, "gradstats": args.gradstats, "algo": algo}
     if overrides:
         cfg.update(overrides)
+    algo = cfg["algo"]  # `--ab algo=ring:scatter_allgather` flips it here
     port = free_port()
     procs = []
     for r in range(world):
@@ -484,6 +499,12 @@ def run_ab(args, sizes: list, worlds: list, algos: list) -> int:
               file=sys.stderr)
         return 2
     val_a, _, val_b = vals.partition(":")
+    if flag == "algo":
+        for v in (val_a, val_b):
+            if v not in ALGOS:
+                print(f"--ab algo arm {v!r} unknown; choices: "
+                      f"{sorted(ALGOS)}", file=sys.stderr)
+                return 2
     report = {"lib": args.lib, "dtype": args.dtype, "ab": {
         "flag": flag, "a": val_a, "b": val_b, "pairs": args.pairs,
         "configs": []}}

@@ -39,7 +39,8 @@ sys.path.insert(0, REPO)
 
 WORKER = os.path.join(REPO, "tests", "data", "chaos_worker.py")
 
-ALGOS = ("ring", "recursive_doubling", "tree")
+ALGOS = ("ring", "recursive_doubling", "tree", "scatter_allgather",
+         "parameter_server")
 TRANSPORTS = ("tcp", "shm")
 HIERS = ("0", "1")
 COMPRESSIONS = ("none", "fp16", "int8", "int4")

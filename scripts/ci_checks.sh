@@ -188,6 +188,26 @@ EOF
   return 0
 }
 run_check "perf_diff-smoke" perf_diff_smoke
+# Scale-out smoke (docs/collectives.md "Choosing an algorithm"): a w16
+# oversubscribed world runs EVERY allreduce algorithm (ring, recursive
+# doubling, tree, scatter-allgather, parameter server) on small tensors
+# through scripts/scale_bench.py — crash/stall/format gate, no timings —
+# then a real 16-rank hvdrun job must produce one well-formed --top-once
+# frame naming all 16 ranks, so the observability surface is proven at
+# scale-out widths, not just -np 2.
+scale_smoke() {
+  local out
+  python3 scripts/scale_bench.py --smoke || return 1
+  out=$(env JAX_PLATFORMS=cpu TEST_PERF_ITERS=600 \
+    TEST_PERF_ITER_SLEEP_MS=20 "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 16 --metrics-port 19620 \
+    --top --top-once python3 tests/data/perf_worker.py 2>&1) || return 1
+  echo "${out}" | grep -q "hvdtop — 16/16 ranks up" || return 1
+  echo "${out}" | grep -qE "^ +0 " || return 1
+  echo "${out}" | grep -qE "^ +15 " || return 1
+  return 0
+}
+run_check "scale-smoke" scale_smoke
 
 echo
 echo "============ CI summary ============"

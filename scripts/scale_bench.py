@@ -1,0 +1,474 @@
+#!/usr/bin/env python3
+"""Scale-out harness: oversubscribed w16-w64 worlds over localhost TCP.
+
+Complements bench_native_allreduce.py (careful paired A/Bs at w2-w8) with
+the scale regime the algorithm crossovers actually care about: MANY ranks
+per core, small-to-medium tensors, every allreduce algorithm (ring,
+recursive_doubling, tree, scatter_allgather, parameter_server). Drives the
+real native core through the same minimal ctypes binding (no JAX, no
+horovod_tpu package), so a w32 world is 32 lightweight processes.
+
+Two measurements ride each run:
+
+* **per-algo crossover data** — avg step time per (world, size, algo),
+  plus the derived fastest-algo table (pasted into docs/benchmarks.md and
+  docs/collectives.md);
+* **control-plane batching** — both sides' steady-state
+  ``hvdtpu_ctrl_frames_total`` / ``hvdtpu_ctrl_batches_total`` /
+  ``hvdtpu_cycles_total`` counters with HVDTPU_CTRL_BATCH on vs off, at
+  fixed per-tensor control traffic (8 unfused tensors/step with the
+  divergence probe at sample=1): the measured sends-per-cycle reduction
+  of the vectored control plane.
+
+Usage:
+    python scripts/scale_bench.py                      # w16 + w32 sweep
+    python scripts/scale_bench.py --world-sizes 16,32,64 -o BENCH_r11.json
+    python scripts/scale_bench.py --smoke               # CI scale-smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import ctypes
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench_native_allreduce import (  # noqa: E402
+    ALGOS, free_port, human, load_lib)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LIB = os.path.join(REPO, "horovod_tpu", "native", "libhvdtpu_core.so")
+SCALE_ALGOS = ("ring", "recursive_doubling", "tree", "scatter_allgather",
+               "parameter_server")
+DTYPE_FLOAT32 = 7
+OP_ALLREDUCE = 0
+REDUCE_SUM = 1
+# Counters scraped from the coordinator's metrics dump after the timed
+# loop (native/metrics.cpp text format; names in docs/metrics.md).
+CTRL_COUNTERS = ("hvdtpu_ctrl_frames_total", "hvdtpu_ctrl_batches_total",
+                 "hvdtpu_cycles_total", "hvdtpu_gradcheck_probes_total",
+                 "hvdtpu_negotiation_cache_hits_total",
+                 "hvdtpu_negotiation_cache_misses_total")
+
+
+def parse_metrics(text: str) -> dict:
+    """Sum Prometheus-text samples per metric name (labels collapsed)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            continue
+        name = parts[0].split("{", 1)[0].strip()
+        try:
+            out[name] = out.get(name, 0.0) + float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+# --------------------------------------------------------------------------
+# Worker
+# --------------------------------------------------------------------------
+
+def run_worker(args) -> int:
+    lib = load_lib(args.lib)
+    lib.hvdtpu_metrics_dump.restype = ctypes.c_longlong
+    lib.hvdtpu_metrics_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_longlong]
+    rank, n = args.rank, args.world
+    core = lib.hvdtpu_create(rank, n, rank, n, 0, 1, b"127.0.0.1", args.port,
+                             b"127.0.0.1", args.cycle_time_ms,
+                             args.fusion, b"", 0, 600.0)
+    if not hasattr(lib, "hvdtpu_set_allreduce_tuning") or \
+            lib.hvdtpu_set_allreduce_tuning(
+                core, ALGOS[args.algo], -1, -1) != 0:
+        print(f"SKIP algo {args.algo}: library rejects this algorithm",
+              file=sys.stderr)
+        return 0
+    if hasattr(lib, "hvdtpu_set_scale_tuning"):
+        lib.hvdtpu_set_scale_tuning(core, args.sa_group, args.ctrl_batch)
+    elif args.ctrl_batch == 0:
+        print("SKIP ctrl-batch config: library has no scale tuning",
+              file=sys.stderr)
+        return 0
+    if hasattr(lib, "hvdtpu_set_transport"):
+        # Oversubscribed worlds stay on loopback TCP: w64 shm would build
+        # 64*63 ring segments on a box whose point is process pressure,
+        # not lane bandwidth.
+        lib.hvdtpu_set_transport(core, 0, 0, 0)
+    if args.gradcheck and hasattr(lib, "hvdtpu_set_gradstats"):
+        # Control-plane A/B arms probe EVERY op: each fingerprint is one
+        # per-tensor control frame — the steady per-tensor traffic the
+        # vectored flush coalesces (READY and RESPONSES already carry all
+        # of a cycle's names in one frame).
+        lib.hvdtpu_set_gradstats(core, 1, 1, 1, b"")
+    err = ctypes.create_string_buffer(1024)
+    if lib.hvdtpu_start(core, err, len(err)) != 0:
+        print(f"start failed: {err.value.decode()}", file=sys.stderr)
+        return 1
+
+    def allreduce(name: bytes, buf, count: int, out) -> None:
+        shape = (ctypes.c_longlong * 1)(count)
+        h = lib.hvdtpu_enqueue(core, name, OP_ALLREDUCE, REDUCE_SUM,
+                               DTYPE_FLOAT32, shape, 1, buf, 1.0, 1.0, 0,
+                               None, 0, err, len(err))
+        if h < 0:
+            raise RuntimeError(f"enqueue: {err.value.decode()}")
+        if lib.hvdtpu_wait(core, h, err, len(err)) != 0:
+            raise RuntimeError(f"wait: {err.value.decode()}")
+        if lib.hvdtpu_copy_result(core, h, out, ctypes.sizeof(out), err,
+                                  len(err)) != 0:
+            raise RuntimeError(f"copy: {err.value.decode()}")
+
+    def step(names, bufs, count, outs) -> None:
+        # A training step's shape: enqueue EVERY tensor, then wait — the
+        # per-tensor READY/response frames of one step land in the same
+        # coordinator cycle, which is what the vectored control plane
+        # coalesces.
+        handles = []
+        for name, buf in zip(names, bufs):
+            shape = (ctypes.c_longlong * 1)(count)
+            h = lib.hvdtpu_enqueue(core, name, OP_ALLREDUCE, REDUCE_SUM,
+                                   DTYPE_FLOAT32, shape, 1, buf, 1.0, 1.0,
+                                   0, None, 0, err, len(err))
+            if h < 0:
+                raise RuntimeError(f"enqueue: {err.value.decode()}")
+            handles.append(h)
+        for h, out in zip(handles, outs):
+            if lib.hvdtpu_wait(core, h, err, len(err)) != 0:
+                raise RuntimeError(f"wait: {err.value.decode()}")
+            if lib.hvdtpu_copy_result(core, h, out, ctypes.sizeof(out),
+                                      err, len(err)) != 0:
+                raise RuntimeError(f"copy: {err.value.decode()}")
+
+    rc = 0
+    try:
+        for nbytes in [int(s) for s in args.sizes.split(",")]:
+            count = max(1, nbytes // 4)
+            bufs, outs, names = [], [], []
+            for t in range(args.tensors):
+                buf = (ctypes.c_char * (count * 4))()
+                fbuf = ctypes.cast(buf, ctypes.POINTER(ctypes.c_float))
+                fbuf[0] = float(rank + 1)
+                bufs.append(buf)
+                outs.append((ctypes.c_char * (count * 4))())
+                names.append(f"scale.{nbytes}.{t}".encode())
+            for _ in range(args.warmup):
+                step(names, bufs, count, outs)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                step(names, bufs, count, outs)
+            dt = (time.perf_counter() - t0) / args.iters
+            fout = ctypes.cast(outs[0], ctypes.POINTER(ctypes.c_float))
+            want = n * (n + 1) / 2.0
+            if abs(fout[0] - want) > 1e-3 * want:
+                raise RuntimeError(
+                    f"bad allreduce result at {nbytes}B: {fout[0]}, "
+                    f"want {want}")
+            if rank == 0:
+                print(json.dumps({
+                    "bytes": nbytes, "iters": args.iters,
+                    "tensors": args.tensors, "avg_s": dt,
+                    "algbw_gbps": nbytes * args.tensors / dt / 1e9}),
+                    flush=True)
+        if rank <= 1:
+            # Control-plane counters from BOTH sides of the wire: rank 0
+            # (the coordinator queues the per-peer RESPONSES fan-out) and
+            # rank 1 (a worker queues one READY per tensor per step — the
+            # traffic the vectored flush coalesces).
+            mbuf = ctypes.create_string_buffer(1 << 20)
+            got = lib.hvdtpu_metrics_dump(core, mbuf, len(mbuf))
+            metrics = parse_metrics(mbuf.value[:max(0, got)].decode(
+                "utf-8", "replace"))
+            print(json.dumps({"rank": rank, "ctrl": {
+                k: metrics.get(k, 0.0) for k in CTRL_COUNTERS}}),
+                flush=True)
+    except Exception as e:  # pragma: no cover - surfaced by the parent
+        print(f"worker rank {rank} failed: {e}", file=sys.stderr)
+        rc = 1
+    finally:
+        lib.hvdtpu_shutdown(core)
+        lib.hvdtpu_destroy(core)
+    return rc
+
+
+# --------------------------------------------------------------------------
+# Parent
+# --------------------------------------------------------------------------
+
+def run_config(args, world: int, algo: str, sizes: list, iters: int,
+               warmup: int, ctrl_batch: int = 1, tensors: int = 1,
+               gradcheck: int = 0, fusion: int = 64 * 1024 * 1024) -> tuple:
+    """Returns (rows, ctrl, stderr_text, failed). `ctrl` maps
+    "coordinator" (rank 0) and "worker" (rank 1) to counter snapshots."""
+    port = free_port()
+    procs = []
+    for r in range(world):
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               "--rank", str(r), "--world", str(world), "--port", str(port),
+               "--algo", algo, "--sizes", ",".join(map(str, sizes)),
+               "--iters", str(iters), "--warmup", str(warmup),
+               "--tensors", str(tensors),
+               "--ctrl-batch", str(ctrl_batch),
+               "--gradcheck", str(gradcheck),
+               "--fusion", str(fusion),
+               "--sa-group", str(args.sa_group), "--lib", args.lib,
+               "--cycle-time-ms", str(args.cycle_time_ms)]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True))
+    rows, ctrl, errs, failed = [], {}, [], False
+    try:
+        for r, p in enumerate(procs):
+            out, errtxt = p.communicate(timeout=args.timeout)
+            errs.append(errtxt)
+            if p.returncode != 0:
+                failed = True
+                print(f"[w{world} {algo}] rank {r} rc={p.returncode}:\n"
+                      f"{errtxt[-2000:]}", file=sys.stderr)
+            if r <= 1:
+                for line in out.splitlines():
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    row = json.loads(line)
+                    if "ctrl" in row:
+                        ctrl["coordinator" if row.get("rank", r) == 0
+                             else "worker"] = row["ctrl"]
+                    elif r == 0:
+                        rows.append(row)
+    except subprocess.TimeoutExpired:
+        failed = True
+        print(f"[w{world} {algo}] timed out", file=sys.stderr)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for row in rows:
+        row.update({"world": world, "algo": algo})
+    return rows, ctrl, "\n".join(errs), failed
+
+
+def ctrl_summary(ctrl: dict) -> dict:
+    cycles = max(1.0, ctrl.get("hvdtpu_cycles_total", 0.0))
+    frames = ctrl.get("hvdtpu_ctrl_frames_total", 0.0)
+    batches = ctrl.get("hvdtpu_ctrl_batches_total", 0.0)
+    return {
+        "frames_total": frames, "batches_total": batches,
+        "cycles_total": cycles,
+        "frames_per_cycle": round(frames / cycles, 3),
+        "sends_per_cycle": round(batches / cycles, 3),
+        "frames_per_send": round(frames / max(1.0, batches), 3),
+        "probes_total": ctrl.get("hvdtpu_gradcheck_probes_total", 0.0),
+        "cache_hits": ctrl.get(
+            "hvdtpu_negotiation_cache_hits_total", 0.0),
+        "cache_misses": ctrl.get(
+            "hvdtpu_negotiation_cache_misses_total", 0.0),
+    }
+
+
+def measure_ctrl_plane(args, world: int) -> dict:
+    """HVDTPU_CTRL_BATCH on vs off at fixed traffic: the measured frame
+    reduction of the vectored control plane. Each step enqueues
+    --ctrl-tensors tensors at once (a training step's gradient fan-out)
+    with the divergence probe sampling every op and fusion defeated, so
+    each worker emits one fingerprint control frame per tensor per step on
+    top of READY/CLOCK — the per-tensor traffic the flush coalesces into
+    one vectored send per peer. (READY and RESPONSES already carry all of
+    a cycle's tensor names in a single frame, and fusion would merge the
+    step's tensors into one probed op, so without per-op probes on unfused
+    tensors there is nothing left to coalesce.) Counters from both sides:
+    rank 0 (coordinator) and rank 1 (worker)."""
+    out = {"world": world, "steps": args.ctrl_iters,
+           "tensors_per_step": args.ctrl_tensors}
+    for arm, batch in (("batch_on", 1), ("batch_off", 0)):
+        rows, ctrl, _, failed = run_config(
+            args, world, "ring", [4096], args.ctrl_iters, 2,
+            ctrl_batch=batch, tensors=args.ctrl_tensors, gradcheck=1,
+            fusion=1)
+        if failed or not ctrl:
+            out[arm] = {"failed": True}
+            continue
+        out[arm] = {side: ctrl_summary(c) for side, c in ctrl.items()}
+    on = out.get("batch_on", {}).get("worker", {})
+    off = out.get("batch_off", {}).get("worker", {})
+    if on.get("sends_per_cycle") and off.get("sends_per_cycle"):
+        # The headline number: wire sends per cycle on a worker's control
+        # lane, before (one syscall per READY frame) vs after (one
+        # vectored send per flush).
+        out["send_reduction_x"] = round(
+            off["sends_per_cycle"] / max(1e-9, on["sends_per_cycle"]), 2)
+        out["frames_per_send_batched"] = on.get("frames_per_send")
+    return out
+
+
+def crossover_tables(results: list) -> dict:
+    """Per world: fastest algo per size, plus each algorithm's speedup over
+    the ring — the measured crossover data for docs/collectives.md."""
+    tables = {}
+    by_ws = {}
+    for row in results:
+        by_ws.setdefault((row["world"], row["bytes"]),
+                         {})[row["algo"]] = row["avg_s"]
+    for (world, nbytes), cells in sorted(by_ws.items()):
+        t = tables.setdefault(f"w{world}", {})
+        best = min(cells, key=cells.get)
+        t[str(nbytes)] = {
+            "fastest": best,
+            "avg_s": {a: round(s, 6) for a, s in sorted(cells.items())},
+        }
+        if "ring" in cells:
+            t[str(nbytes)]["speedup_vs_ring"] = {
+                a: round(cells["ring"] / s, 3)
+                for a, s in sorted(cells.items()) if a != "ring"}
+    return tables
+
+
+def markdown_table(results: list, algos: list) -> str:
+    by_key = {}
+    for row in results:
+        by_key.setdefault((row["world"], row["bytes"]),
+                          {})[row["algo"]] = row
+    lines = ["| world | size | " + " | ".join(algos) + " | fastest |",
+             "|---|---|" + "---|" * (len(algos) + 1)]
+    for (world, nbytes), cells in sorted(by_key.items()):
+        vals = []
+        for a in algos:
+            row = cells.get(a)
+            vals.append("—" if row is None
+                        else f"{row['avg_s'] * 1e3:.2f} ms")
+        best = min(cells, key=lambda a: cells[a]["avg_s"])
+        lines.append(f"| {world} | {human(nbytes)} | " + " | ".join(vals) +
+                     f" | {best} |")
+    return "\n".join(lines)
+
+
+def run_smoke(args) -> int:
+    """CI scale-smoke: a w16 oversubscribed world runs EVERY algorithm on a
+    small tensor — crash/format gate only (timings on a loaded CI box are
+    noise). Fails on any rank error, missing rows, or a stall warning in
+    any worker's stderr."""
+    ok = True
+    for algo in SCALE_ALGOS:
+        rows, _, errtxt, failed = run_config(args, 16, algo, [4096], 2, 1)
+        if failed:
+            print(f"scale-smoke: w16 {algo} crashed", file=sys.stderr)
+            ok = False
+            continue
+        if len(rows) != 1 or rows[0]["avg_s"] <= 0:
+            print(f"scale-smoke: w16 {algo} produced {len(rows)} rows",
+                  file=sys.stderr)
+            ok = False
+            continue
+        if "stall" in errtxt.lower():
+            print(f"scale-smoke: w16 {algo} logged a stall warning",
+                  file=sys.stderr)
+            ok = False
+            continue
+        print(f"scale-smoke: w16 {algo} OK", file=sys.stderr)
+    print(f"scale-smoke: {'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--world", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--sizes", default="", help=argparse.SUPPRESS)
+    p.add_argument("--algo", default="ring", help=argparse.SUPPRESS)
+    p.add_argument("--iters", type=int, default=5, help=argparse.SUPPRESS)
+    p.add_argument("--warmup", type=int, default=2, help=argparse.SUPPRESS)
+    p.add_argument("--ctrl-batch", type=int, default=1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--tensors", type=int, default=1, help=argparse.SUPPRESS)
+    p.add_argument("--gradcheck", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--fusion", type=int, default=64 * 1024 * 1024,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--lib", default=os.environ.get("HVDTPU_NATIVE_LIB",
+                                                   DEFAULT_LIB))
+    p.add_argument("--world-sizes", default="16,32",
+                   help="oversubscribed worlds to sweep (16-64)")
+    p.add_argument("--algos", default=",".join(SCALE_ALGOS))
+    p.add_argument("--size-list", default="4096,65536,1048576",
+                   help="comma-separated message sizes in bytes")
+    p.add_argument("--sa-group", type=int, default=-1,
+                   help="scatter-allgather AUTO group floor "
+                        "(HVDTPU_ALLREDUCE_SA_GROUP; -1: library default)")
+    p.add_argument("--ctrl-iters", type=int, default=40,
+                   help="steps per arm of the control-plane A/B")
+    p.add_argument("--ctrl-tensors", type=int, default=8,
+                   help="tensors enqueued per step in the control-plane "
+                        "A/B (a step's gradient fan-out)")
+    p.add_argument("--cycle-time-ms", type=float, default=1.0)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI scale-smoke: w16, every algo, crash/stall gate")
+    p.add_argument("-o", "--output", default=None, help="write JSON here")
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args)
+    if not os.path.exists(args.lib):
+        print(f"native library not found: {args.lib} (make -C "
+              f"horovod_tpu/native)", file=sys.stderr)
+        return 1
+    if args.smoke:
+        args.timeout = min(args.timeout, 300.0)
+        return run_smoke(args)
+
+    sizes = [int(s) for s in args.size_list.split(",")]
+    worlds = [int(w) for w in args.world_sizes.split(",")]
+    algos = args.algos.split(",")
+    for a in algos:
+        if a not in ALGOS:
+            print(f"unknown algo {a!r}; choices: {sorted(ALGOS)}",
+                  file=sys.stderr)
+            return 2
+
+    results, failed_configs = [], []
+    for world in worlds:
+        for algo in algos:
+            t0 = time.time()
+            rows, _, _, failed = run_config(args, world, algo, sizes, 5, 2)
+            results.extend(rows)
+            if failed:
+                failed_configs.append(f"world={world} algo={algo}")
+            print(f"[w{world} {algo}] {len(rows)} sizes in "
+                  f"{time.time() - t0:.1f}s"
+                  f"{' (FAILED)' if failed else ''}", file=sys.stderr)
+
+    ctrl = measure_ctrl_plane(args, worlds[0])
+    report = {
+        "lib": args.lib, "worlds": worlds, "sizes": sizes,
+        "results": results, "failed_configs": failed_configs,
+        "crossover": crossover_tables(results),
+        "ctrl_plane": ctrl,
+    }
+    text = json.dumps(report, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    print(markdown_table(results, algos), file=sys.stderr)
+    if "send_reduction_x" in ctrl:
+        on = ctrl["batch_on"]["worker"]
+        off = ctrl["batch_off"]["worker"]
+        print(f"control plane (worker lane): {off['sends_per_cycle']} -> "
+              f"{on['sends_per_cycle']} sends/cycle "
+              f"({ctrl['send_reduction_x']}x fewer wire sends; "
+              f"{on['frames_per_send']} frames per vectored send)",
+              file=sys.stderr)
+    return 1 if failed_configs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,25 @@ for it in range(steps):
     assert out.shape == (sum(range(1, n + 1)), 2), out.shape
     np.testing.assert_allclose(out, float(it))
 
+# Steady-state counter shape (docs/metrics.md): every name after its first
+# negotiation rides the bare-name fast path, so hits dominate misses by
+# roughly steps x names to names. Asserted only at default capacity — the
+# tiny-capacity and disabled arms churn or bypass the cache on purpose.
+if os.environ.get("TEST_ASSERT_CACHE_COUNTERS") == "1":
+    from horovod_tpu.observability import sample_value
+    parsed = hvd.metrics()
+    hits = sample_value(parsed, "hvdtpu_negotiation_cache_hits_total")
+    misses = sample_value(parsed, "hvdtpu_negotiation_cache_misses_total")
+    # 7 distinct names (6 grads + 1 gather) over `steps` iterations: one
+    # full negotiation each, everything else cached. Workers count that
+    # first full send as a miss; the coordinator takes fulls without a
+    # miss (its misses mean evictions) and counts a hit every time it
+    # rematerializes a bare name.
+    assert hits >= (steps - 1) * 7, (hits, misses)
+    assert misses <= hits / 10.0, (hits, misses)
+    if r != 0:
+        assert misses >= 7, (hits, misses)
+
 # Changing the shape of a cached name must invalidate, not corrupt.
 x = np.full((8, 2), float(r), np.float32)
 out = np.asarray(hvd.allreduce(x, name="grad_0", op=hvd.Sum))

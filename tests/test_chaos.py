@@ -91,7 +91,8 @@ class TestChaosSmoke:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("algo", ["ring", "recursive_doubling", "tree"])
+@pytest.mark.parametrize("algo", ["ring", "recursive_doubling", "tree",
+                                  "scatter_allgather", "parameter_server"])
 @pytest.mark.parametrize("transport", ["tcp", "shm"])
 @pytest.mark.parametrize("hier", ["0", "1"])
 @pytest.mark.parametrize("compression", ["none", "fp16", "int8", "int4"])

@@ -32,6 +32,17 @@ def test_response_cache_world_4():
     assert_all_ok(results)
 
 
+def test_response_cache_counters_steady_state():
+    """The cache-effectiveness counters (docs/metrics.md): a repeating
+    tensor set at default capacity negotiates each name in full exactly
+    once, then every later announcement is a bare-name hit — the worker
+    asserts hits ~ steps x names with misses an order of magnitude
+    smaller on every rank."""
+    results = launch_world(2, os.path.join(DATA, "cache_worker.py"),
+                           extra_env={"TEST_ASSERT_CACHE_COUNTERS": "1"})
+    assert_all_ok(results)
+
+
 def test_autotune(tmp_path):
     """The parameter manager explores (params move off defaults), logs scored
     samples, and collectives stay correct throughout."""

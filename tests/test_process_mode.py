@@ -44,7 +44,8 @@ def test_true_async_collectives(n):
         assert "ALL OK" in out
 
 
-@pytest.mark.parametrize("algo", ["ring", "recursive_doubling", "tree"])
+@pytest.mark.parametrize("algo", ["ring", "recursive_doubling", "tree",
+                                  "scatter_allgather", "parameter_server"])
 def test_allreduce_algorithms(algo):
     """Every native allreduce algorithm produces exact results end to end
     (HVDTPU_ALLREDUCE_ALGO -> basics.py -> hvdtpu_set_allreduce_tuning).
@@ -60,6 +61,50 @@ def test_allreduce_algorithms(algo):
     for r, (rc, out, err) in enumerate(results):
         assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
         assert "ALL OK" in out
+
+
+# Non-power-of-two worlds: every algorithm must handle remainder ranks —
+# the ring's uneven chunking, recursive doubling's non-participant fold,
+# the tree's odd fan-in, scatter-allgather's uneven ownership rotation and
+# the parameter server's (world-1)-worker star. Cross-rank bitwise
+# equality is asserted through the divergence-probe fingerprints
+# (HVDTPU_GRADCHECK_SAMPLE=1: the worker CRCs every collective output and
+# rank 0 convicts any rank whose fingerprint differs). Tier-1 runs w3 for
+# every algorithm x transport; w5/w6 ride the slow marker.
+_NPO2_ALGOS = ["ring", "recursive_doubling", "tree", "scatter_allgather",
+               "parameter_server"]
+
+
+def _npo2_world(n, algo, shm):
+    results = _launch_world(
+        n, os.path.join(REPO, "tests", "data", "grad_worker.py"),
+        extra_env={
+            "TEST_GRAD_ITERS": "2",
+            "HVDTPU_ALLREDUCE_ALGO": algo,
+            "HVDTPU_GRADCHECK_SAMPLE": "1",
+            "HVDTPU_SHM": shm,
+        },
+        timeout=240)
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
+@pytest.mark.parametrize("shm", ["0", "1"])
+@pytest.mark.parametrize("algo", _NPO2_ALGOS)
+def test_npo2_world_bitwise(algo, shm):
+    """w3: the smallest world where every algorithm hits its remainder
+    path, over both TCP and shared-memory lanes."""
+    _npo2_world(3, algo, shm)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [5, 6])
+@pytest.mark.parametrize("algo", _NPO2_ALGOS)
+def test_npo2_world_bitwise_large(algo, n):
+    """w5 (prime) and w6 (even, non-power) over TCP: deeper remainder
+    coverage for the recursive-doubling fold and SA ownership rotation."""
+    _npo2_world(n, algo, "0")
 
 
 @pytest.mark.parametrize("shm", ["1", "0"])

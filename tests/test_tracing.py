@@ -150,41 +150,56 @@ def test_analyze_cli_and_merged_trace(tmp_path):
 def test_four_rank_trace_identifies_delayed_straggler(tmp_path):
     """Acceptance: a 4-rank traced job with rank 2 deliberately delayed
     (HVDTPU_CHAOS delay) produces one merged clock-aligned trace and a
-    critical-path report naming rank 2 as the straggler."""
-    trace_dir = tmp_path / "trace"
-    results = launch_world(
-        4, os.path.join(DATA, "trace_worker.py"),
-        extra_env={
-            "HVDTPU_TRACE": str(trace_dir),
-            "HVDTPU_TRACE_SAMPLE": "1",
-            "HVDTPU_CHAOS": "rank2:delay=300@op=2",
-        })
-    for r, (rc, out, err) in enumerate(results):
-        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
-        assert "ALL OK" in out
+    critical-path report naming rank 2 as the straggler.
 
-    report = build_report(str(trace_dir))
-    assert report["ranks"] == [0, 1, 2, 3]
-    # Every rank clock-synced at form-up; localhost bounds are tiny.
-    for r in range(4):
-        assert report["clock"][r]["err_us"] >= 0, report["clock"]
-        assert report["clock"][r]["err_us"] < 100_000, report["clock"]
-    assert report["critical_path"], "no sampled ops in the trace"
-    # The delayed rank tops the straggler ranking as compute-late (the
-    # sleep lands between the op starting and its first hop).
-    top = report["stragglers"][0]
-    assert top["rank"] == 2, report["stragglers"]
-    assert top["attribution"] == "compute-late", top
-    # The delayed op's own row names rank 2 as the gating leg.
-    slow = max(report["critical_path"], key=lambda r_: r_["duration_us"])
-    assert slow["duration_us"] > 250_000, slow
-    assert slow["gating_rank"] == 2, slow
+    One retry (the test_chaos pattern): on a loaded 4-ranks-per-core CI
+    box a scheduler stall on another rank can out-straggle the injected
+    300 ms delay. Crashes and malformed traces never retry — only the
+    straggler-ranking assertions, which depend on wall-clock contention.
+    """
+    for attempt in range(2):
+        trace_dir = tmp_path / f"trace{attempt}"
+        results = launch_world(
+            4, os.path.join(DATA, "trace_worker.py"),
+            extra_env={
+                "HVDTPU_TRACE": str(trace_dir),
+                "HVDTPU_TRACE_SAMPLE": "1",
+                "HVDTPU_CHAOS": "rank2:delay=300@op=2",
+            })
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+            assert "ALL OK" in out
 
-    # The merged trace is one valid JSON event list spanning all ranks.
-    merged, _ = merge_events(load_trace_dir(str(trace_dir)))
-    pids = {e["pid"] for e in merged}
-    assert {"rank 0", "rank 1", "rank 2", "rank 3"} <= pids
-    assert all(e["ts"] >= 0 for e in merged if "ts" in e)
+        report = build_report(str(trace_dir))
+        assert report["ranks"] == [0, 1, 2, 3]
+        # Every rank clock-synced at form-up; localhost bounds are tiny.
+        for r in range(4):
+            assert report["clock"][r]["err_us"] >= 0, report["clock"]
+            assert report["clock"][r]["err_us"] < 100_000, report["clock"]
+        assert report["critical_path"], "no sampled ops in the trace"
+        top = report["stragglers"][0]
+        slow = max(report["critical_path"],
+                   key=lambda r_: r_["duration_us"])
+        load_flaked = not (top["rank"] == 2 and
+                           top["attribution"] == "compute-late" and
+                           slow["duration_us"] > 250_000 and
+                           slow["gating_rank"] == 2)
+        if load_flaked and attempt == 0:
+            continue
+        # The delayed rank tops the straggler ranking as compute-late (the
+        # sleep lands between the op starting and its first hop).
+        assert top["rank"] == 2, report["stragglers"]
+        assert top["attribution"] == "compute-late", top
+        # The delayed op's own row names rank 2 as the gating leg.
+        assert slow["duration_us"] > 250_000, slow
+        assert slow["gating_rank"] == 2, slow
+
+        # The merged trace is one valid JSON event list spanning all ranks.
+        merged, _ = merge_events(load_trace_dir(str(trace_dir)))
+        pids = {e["pid"] for e in merged}
+        assert {"rank 0", "rank 1", "rank 2", "rank 3"} <= pids
+        assert all(e["ts"] >= 0 for e in merged if "ts" in e)
+        return
 
 
 def test_hvdrun_trace_end_to_end(tmp_path):
