@@ -91,6 +91,22 @@ def _load_lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_int,
         ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
         ctypes.c_int]
+    if hasattr(lib, "hvdtpu_enqueue_reducescatter"):  # older libs lack it
+        lib.hvdtpu_enqueue_reducescatter.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_reducescatter.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_double, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+    if hasattr(lib, "hvdtpu_enqueue_allgather"):  # older libs lack it
+        lib.hvdtpu_enqueue_allgather.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_int]
+    if hasattr(lib, "hvdtpu_set_optimizer_state_bytes"):
+        lib.hvdtpu_set_optimizer_state_bytes.restype = ctypes.c_int
+        lib.hvdtpu_set_optimizer_state_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong]
     lib.hvdtpu_wait.restype = ctypes.c_int
     lib.hvdtpu_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                 ctypes.c_char_p, ctypes.c_int]
@@ -562,6 +578,17 @@ class NativeCore:
                                     ctypes.byref(wire))
         return raw.value, wire.value
 
+    def set_optimizer_state_bytes(self, nbytes: int) -> None:
+        """Publish this rank's resident optimizer-state footprint to the
+        native ``hvdtpu_optimizer_state_bytes`` gauge (docs/optimizer.md
+        "Sharded optimizer state") so ``/metrics`` can attest the ZeRO-1
+        1/world memory claim next to the PR-11 RSS gauges. No-op on an
+        older library without the symbol."""
+        if self._core and hasattr(self._lib,
+                                  "hvdtpu_set_optimizer_state_bytes"):
+            self._lib.hvdtpu_set_optimizer_state_bytes(self._core,
+                                                       int(nbytes))
+
     def _probe_then_copy(self, cfunc) -> bytes:
         """Drain a probe-then-copy C API (``cfunc(core, NULL, 0)`` returns
         the full size; a second call copies): loop in case the payload
@@ -618,10 +645,30 @@ class NativeCore:
             splits_ptr = None
             nsplits = 0
         # Keep a reference so the input buffer outlives the async op.
-        handle = self._lib.hvdtpu_enqueue(
-            self._core, name.encode(), _OP_TYPES[kind], op, dtype_code,
-            shape, arr.ndim, arr.ctypes.data_as(ctypes.c_void_p),
-            prescale, postscale, root_rank, splits_ptr, nsplits, err, len(err))
+        # Reduce-scatter/allgather prefer their dedicated narrow entry
+        # points when the library exports them (docs/collectives.md
+        # "Reduce-scatter & allgather"); the generic hvdtpu_enqueue stays
+        # the fallback so an older .so keeps working.
+        if (kind == "reducescatter"
+                and hasattr(self._lib, "hvdtpu_enqueue_reducescatter")
+                and splits is None and root_rank == 0):
+            handle = self._lib.hvdtpu_enqueue_reducescatter(
+                self._core, name.encode(), op, dtype_code, shape, arr.ndim,
+                arr.ctypes.data_as(ctypes.c_void_p), prescale, postscale,
+                err, len(err))
+        elif (kind == "allgather"
+                and hasattr(self._lib, "hvdtpu_enqueue_allgather")
+                and splits is None and root_rank == 0
+                and prescale == 1.0 and postscale == 1.0):
+            handle = self._lib.hvdtpu_enqueue_allgather(
+                self._core, name.encode(), dtype_code, shape, arr.ndim,
+                arr.ctypes.data_as(ctypes.c_void_p), err, len(err))
+        else:
+            handle = self._lib.hvdtpu_enqueue(
+                self._core, name.encode(), _OP_TYPES[kind], op, dtype_code,
+                shape, arr.ndim, arr.ctypes.data_as(ctypes.c_void_p),
+                prescale, postscale, root_rank, splits_ptr, nsplits,
+                err, len(err))
         if handle < 0:
             _raise_for(err.value.decode())
         self._inflight[handle] = arr

@@ -133,6 +133,10 @@ bool SameRequest(const Request& a, const Request& b) {
          a.root_rank == b.root_rank && a.splits == b.splits;
 }
 
+// Defined below Core (same anonymous namespace); ExecuteResponse needs it
+// for reduce-scatter prescale/postscale before the definition appears.
+void ScaleBuffer(void* data, int64_t count, DataType dtype, double factor);
+
 }  // namespace
 
 // LRU response cache (reference: horovod/common/response_cache.{h,cc}).
@@ -500,6 +504,18 @@ class Core {
   void WireStats(int64_t* raw_bytes, int64_t* wire_bytes) {
     *raw_bytes = data_plane_.total_raw_bytes();
     *wire_bytes = data_plane_.total_wire_bytes();
+  }
+  // ZeRO-1 memory proof (docs/optimizer.md): the Python sharded optimizer
+  // reports its resident optimizer-state footprint here so the PR-11 memory
+  // gauges can attest the 1/world claim next to hvdtpu_rss_bytes. Lazy
+  // GetGauge (registry lock) + atomic Gauge::Set — safe from user threads
+  // at any point in the core lifecycle, no member caching needed.
+  void SetOptimizerStateBytes(int64_t bytes) {
+    metrics_
+        .GetGauge("hvdtpu_optimizer_state_bytes",
+                  "Resident optimizer-state bytes on this rank (ZeRO-1 "
+                  "shards report ~1/world of the replicated footprint)")
+        ->Set(static_cast<double>(bytes));
   }
   // Prometheus text exposition of every registered series (C API:
   // hvdtpu_metrics_dump; served over HTTP by horovod_tpu/observability.py).
@@ -1841,7 +1857,10 @@ int64_t Core::Enqueue(TensorEntry entry, Status* status) {
     return -1;
   }
   // AVERAGE == SUM with postscale 1/size (reference: operations.cc:928).
-  if (entry.op_type == OpType::ALLREDUCE &&
+  // Applies to reduce-scatter too: its output chunk is postscaled after the
+  // ring phase, exactly like the allreduce's per-entry postscale.
+  if ((entry.op_type == OpType::ALLREDUCE ||
+       entry.op_type == OpType::REDUCESCATTER) &&
       entry.reduce_op == ReduceOp::AVERAGE) {
     entry.reduce_op = ReduceOp::SUM;
     entry.postscale /= static_cast<double>(cfg_.size);
@@ -2492,12 +2511,21 @@ Response Core::BuildResponse(const std::string& name) {
           return error("Mismatched reduce ops for tensor '" + name + "'");
         }
       }
-      if (first.op_type == OpType::REDUCESCATTER && !first.shape.empty() &&
-          first.shape[0] % cfg_.size != 0) {
-        return error("reducescatter first dimension (" +
-                     std::to_string(first.shape[0]) +
-                     ") must be divisible by world size (" +
-                     std::to_string(cfg_.size) + ") for tensor '" + name + "'");
+      if (first.op_type == OpType::REDUCESCATTER) {
+        if (!first.shape.empty() && first.shape[0] % cfg_.size != 0) {
+          return error("reducescatter first dimension (" +
+                       std::to_string(first.shape[0]) +
+                       ") must be divisible by world size (" +
+                       std::to_string(cfg_.size) + ") for tensor '" + name +
+                       "'");
+        }
+        // RESPONSES carry the per-rank output shape (dim 0 of each rank's
+        // chunk), like allgather — uniform today, but on the wire so the
+        // execute path and any future ragged extension key off the
+        // negotiated value, not a recomputation.
+        resp.first_dims.assign(
+            cfg_.size,
+            first.shape.empty() ? 0 : first.shape[0] / cfg_.size);
       }
       break;
     }
@@ -2879,19 +2907,23 @@ void Core::ExecuteResponse(const Response& resp) {
         NumElements(s) * static_cast<int64_t>(DataTypeSize(resp.dtype));
   }
   WireCompression comp = WireCompression::NONE;
-  if (resp.op_type == OpType::ALLREDUCE) {
-    if (data_plane_.hier_active()) lane += "+hier";
-    comp = EffectiveCompression(resp, batch_bytes);
+  if (resp.op_type == OpType::ALLREDUCE && data_plane_.hier_active()) {
+    lane += "+hier";
   }
+  // Allreduce, reduce-scatter and allgather all carry the wire-compression
+  // dimension (EffectiveCompression returns NONE for the rest).
+  const bool comp_capable = resp.op_type == OpType::ALLREDUCE ||
+                            resp.op_type == OpType::REDUCESCATTER ||
+                            resp.op_type == OpType::ALLGATHER;
+  if (comp_capable) comp = EffectiveCompression(resp, batch_bytes);
   const char* opname = resp.op_type == OpType::ALLREDUCE ? "ALLREDUCE"
                        : resp.op_type == OpType::ALLGATHER ? "ALLGATHER"
                        : resp.op_type == OpType::BROADCAST ? "BROADCAST"
                        : resp.op_type == OpType::ALLTOALL ? "ALLTOALL"
                                                           : "REDUCESCATTER";
   for (auto* e : entries) {
-    timeline_.ActivityStart(
-        e->name, opname, lane,
-        resp.op_type == OpType::ALLREDUCE ? WireCompressionName(comp) : "");
+    timeline_.ActivityStart(e->name, opname, lane,
+                            comp_capable ? WireCompressionName(comp) : "");
   }
 
   // Flight ring: one OP_BEGIN per dispatched collective under its primary
@@ -2936,10 +2968,34 @@ void Core::ExecuteResponse(const Response& resp) {
       for (int r = 0; r < cfg_.size; ++r) {
         block_bytes[r] = resp.first_dims[r] * row_bytes;
       }
+      // Compressed allgather (PR 18): quantize-once owner codes on the ring
+      // rotation — fp32 only (EffectiveCompression), no error-feedback
+      // residual (a gathered payload is a value, not a gradient stream the
+      // next iteration can correct).
+      const bool grad_on =
+          gradstats_.enabled() && resp.dtype == DataType::FLOAT32;
+      if (comp != WireCompression::NONE) {
+        data_plane_.BeginCompressedOp(comp, nullptr,
+                                      grad_on ? &grad_quality_ : nullptr);
+      }
       ByteBuf out;
       st = data_plane_.Allgatherv(e->input, my_first * row_bytes, block_bytes,
                                   &out);
-      if (st.ok()) e->output = std::move(out);
+      data_plane_.EndCompressedOp();
+      if (st.ok()) {
+        if (grad_on && comp != WireCompression::NONE) {
+          gradstats_.RecordQuality(gradstats_.KeySlot(e->name), comp,
+                                   grad_quality_);
+        }
+        // Divergence probe on the GATHERED vector (PR-12 extension): every
+        // rank holds bitwise-identical bytes — the raw paths move exact
+        // blocks, the compressed ring decodes the owners' codes verbatim —
+        // which is exactly the allgathered-params invariant the ZeRO-1
+        // sharded update stands on (docs/numerics.md).
+        MaybeGradcheck(e->name, out.data(),
+                       static_cast<int64_t>(out.size()));
+        e->output = std::move(out);
+      }
       break;
     }
     case OpType::BROADCAST: {
@@ -2976,36 +3032,86 @@ void Core::ExecuteResponse(const Response& resp) {
     }
     case OpType::REDUCESCATTER: {
       TensorEntry* e = entries[0];
+      const int64_t total_elems = NumElements(resp.shapes[0]);
       std::vector<uint8_t> input_copy;
       const void* src = e->input;
       if (src == nullptr) {
         input_copy.assign(static_cast<size_t>(e->byte_size()), 0);
         src = input_copy.data();
+      } else if (e->prescale != 1.0) {
+        // Prescale without touching the user's pinned input buffer.
+        input_copy.assign(static_cast<const uint8_t*>(src),
+                          static_cast<const uint8_t*>(src) + e->byte_size());
+        ScaleBuffer(input_copy.data(), total_elems, resp.dtype, e->prescale);
+        src = input_copy.data();
+      }
+      // Compressed reduce-scatter (PR 18): the compressed ring allreduce's
+      // first half, with the same per-tensor error-feedback residual.
+      const bool grad_on =
+          gradstats_.enabled() && resp.dtype == DataType::FLOAT32;
+      if (comp != WireCompression::NONE) {
+        bool residual_reset = false;
+        float* residual =
+            residual_store_.Get(e->name, total_elems, &residual_reset);
+        if (residual_reset) {
+          m_residual_resets_->Inc();
+          gradstats_.NoteResidualReset();
+        }
+        data_plane_.BeginCompressedOp(comp, residual,
+                                      grad_on ? &grad_quality_ : nullptr);
       }
       ByteBuf out;
-      st = data_plane_.ReduceScatter(src, e->num_elements(), e->dtype,
+      st = data_plane_.ReduceScatter(src, total_elems, e->dtype,
                                      e->reduce_op, &out);
-      if (st.ok()) e->output = std::move(out);
+      data_plane_.EndCompressedOp();
+      if (st.ok()) {
+        if (grad_on && comp != WireCompression::NONE) {
+          gradstats_.RecordQuality(gradstats_.KeySlot(e->name), comp,
+                                   grad_quality_);
+        }
+        // AVERAGE arrives as SUM + postscale 1/size (Enqueue), applied to
+        // this rank's chunk only — the reduced full vector never exists.
+        ScaleBuffer(out.data(),
+                    static_cast<int64_t>(out.size()) /
+                        static_cast<int64_t>(DataTypeSize(resp.dtype)),
+                    resp.dtype, e->postscale);
+        e->output = std::move(out);
+      }
       break;
     }
     case OpType::JOIN:
       break;
   }
 
-  // Non-allreduce ops carry no algorithm/compression dimension; label them
-  // neutrally so the op/transport/dtype breakdown still aggregates cleanly.
+  // Reduce-scatter/allgather carry real algorithm + compression labels
+  // (PR 18) — same dimensions the allreduce baselines key on; broadcast/
+  // alltoall stay neutral so the op/transport/dtype breakdown aggregates.
   if (!entries.empty()) {
-    ObserveOp(opname, NowSeconds() - op_t0, entries[0]->byte_size(), "none",
-              data_plane_.transport_label(), false, "none", resp.dtype,
+    ObserveOp(opname, NowSeconds() - op_t0, entries[0]->byte_size(),
+              comp_capable ? data_plane_.last_algo_label() : "none",
+              data_plane_.transport_label(), false,
+              comp_capable ? WireCompressionName(comp) : "none", resp.dtype,
               st.ok(), entries[0]->name);
   }
   flightrec_.Record(FlightEvent::OP_END, fr_name, batch_bytes, -1, -1,
                     fr_t0, Timeline::SteadyAbsUs(), st.ok() ? 0 : 1, 0);
   if (!st.ok() && data_plane_.aborted()) HandleDataPlaneFailure(st);
 
+  // Reduce-scatter/allgather feed the cumulative raw/wire byte counters
+  // (their data-plane entry points reset + publish the per-op
+  // accumulators), so their timeline op-done events must carry the same
+  // figures — /metrics and the timeline tell one story
+  // (tests/data/metrics_worker.py pins sum(timeline) == counter).
+  // Broadcast/alltoall never reset the accumulators; passing them here
+  // would attribute the PREVIOUS op's bytes, so they stay omitted.
+  const bool byte_metered = resp.op_type == OpType::REDUCESCATTER ||
+                            resp.op_type == OpType::ALLGATHER;
+  const int64_t done_raw = byte_metered ? data_plane_.op_raw_bytes() : -1;
+  const int64_t done_wire = byte_metered ? data_plane_.op_wire_bytes() : -1;
   for (auto* e : entries) {
     timeline_.ActivityEnd(e->name);
-    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason);
+    timeline_.OpDone(e->name, st.ok() ? "ok" : st.reason, done_raw,
+                     done_wire);
     if (e->handle >= 0) CompleteEntry(e, st);
   }
 }
@@ -3084,11 +3190,18 @@ WireCompression Core::EffectiveCompression(const Response& resp,
     return WireCompression::NONE;
   }
   if (resp.dtype != DataType::FLOAT32) return WireCompression::NONE;
-  if (resp.op_type != OpType::ALLREDUCE) return WireCompression::NONE;
+  // The reducing ops (allreduce, reduce-scatter) and allgather all have
+  // compressed schedules (PR 18); broadcast/alltoall stay raw.
+  if (resp.op_type != OpType::ALLREDUCE &&
+      resp.op_type != OpType::REDUCESCATTER &&
+      resp.op_type != OpType::ALLGATHER) {
+    return WireCompression::NONE;
+  }
   // Adasum's adaptive combine needs the exact partials; MIN/MAX/PRODUCT
   // have no meaningful quantized-sum form. reduce_op is per-response (all
-  // fused entries share it).
-  if (resp.reduce_op != ReduceOp::SUM &&
+  // fused entries share it); allgather carries no reduction to gate on.
+  if (resp.op_type != OpType::ALLGATHER &&
+      resp.reduce_op != ReduceOp::SUM &&
       resp.reduce_op != ReduceOp::AVERAGE) {
     return WireCompression::NONE;
   }
@@ -3638,6 +3751,31 @@ long long hvdtpu_enqueue(void* core, const char* name, int op_type,
   return st.ok() ? h : -1;
 }
 
+// Dedicated entry points for the first-class reduce-scatter / allgather
+// collectives (docs/collectives.md "Reduce-scatter & allgather") — thin
+// delegates over hvdtpu_enqueue so ctypes callers get a stable narrow
+// signature and a probe-able symbol (basics.py hasattr-gates on these).
+long long hvdtpu_enqueue_reducescatter(void* core, const char* name,
+                                       int reduce_op, int dtype,
+                                       const long long* shape, int ndim,
+                                       const void* data, double prescale,
+                                       double postscale, char* err,
+                                       int errlen) {
+  return hvdtpu_enqueue(core, name,
+                        static_cast<int>(hvdtpu::OpType::REDUCESCATTER),
+                        reduce_op, dtype, shape, ndim, data, prescale,
+                        postscale, 0, nullptr, 0, err, errlen);
+}
+
+long long hvdtpu_enqueue_allgather(void* core, const char* name, int dtype,
+                                   const long long* shape, int ndim,
+                                   const void* data, char* err, int errlen) {
+  return hvdtpu_enqueue(core, name,
+                        static_cast<int>(hvdtpu::OpType::ALLGATHER),
+                        static_cast<int>(hvdtpu::ReduceOp::SUM), dtype, shape,
+                        ndim, data, 1.0, 1.0, 0, nullptr, 0, err, errlen);
+}
+
 int hvdtpu_wait(void* core, long long handle, char* err, int errlen) {
   Status st = static_cast<Core*>(core)->WaitHandle(handle);
   FillErr(st, err, errlen);
@@ -3815,7 +3953,19 @@ int hvdtpu_set_compression(void* core, int mode, long long min_bytes,
   return 0;
 }
 
-// Cumulative bytes-on-wire accounting for this rank's allreduce payloads:
+// ZeRO-1 memory attestation (docs/optimizer.md "Sharded optimizer state"):
+// the Python sharded optimizer reports its resident optimizer-state bytes
+// so /metrics can prove the 1/world footprint. Callable from any thread at
+// any point in the core lifecycle.
+int hvdtpu_set_optimizer_state_bytes(void* core, long long bytes) {
+  static_cast<Core*>(core)->SetOptimizerStateBytes(bytes);
+  return 0;
+}
+
+// Cumulative bytes-on-wire accounting for this rank's allreduce payloads
+// (reduce-scatter and allgather feed the same counters — their raw/wire
+// accounting shares the allreduce series so the equal-wire-bytes claim is
+// checkable from one pair of numbers):
 // raw = what the data plane would have sent uncompressed, wire = what it
 // actually sent (equal when compression is off). Thin shim over the metrics
 // registry's hvdtpu_allreduce_{raw,wire}_bytes_total counters — the single

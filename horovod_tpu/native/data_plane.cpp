@@ -1905,20 +1905,127 @@ Status DataPlane::HierarchicalAllreduce(void* data, int64_t count,
 Status DataPlane::Allgatherv(const void* in, int64_t in_bytes,
                              const std::vector<int64_t>& block_bytes,
                              ByteBuf* out) {
-  BeginOpTrace();
+  op_raw_bytes_ = 0;
+  op_wire_bytes_ = 0;
+  last_algo_label_ = "none";
+  trace_op_ = false;
   std::vector<int64_t> offsets(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) offsets[r + 1] = offsets[r] + block_bytes[r];
   out->resize(static_cast<size_t>(offsets[size_]));
   memcpy(out->data() + offsets[rank_], in, static_cast<size_t>(in_bytes));
-  if (size_ == 1) return Status::OK();
-  // Pairwise rotation: step k sends my block to rank (rank+k), receives the
-  // block of rank (rank-k).
-  for (int k = 1; k < size_; ++k) {
-    int to = (rank_ + k) % size_;
-    int from = (rank_ - k + size_) % size_;
-    Status st = Exchange(to, in, in_bytes, from,
-                         out->data() + offsets[from], block_bytes[from]);
+  if (size_ == 1 || offsets[size_] == 0) {
+    // No hops; see Allreduce — ObserveOp still reads the accumulators.
+    ResetOpPhaseAccum();
+    return Status::OK();
+  }
+  BeginOpTrace();
+  MaybeChaosOp();
+  Status st;
+  if (op_comp_ != WireCompression::NONE) {
+    // Compression requires the ring: quantize-once owner codes only stay
+    // identical world-wide when every hop forwards them verbatim. The core
+    // arms the mode for fp32 payloads only (EffectiveCompression).
+    last_algo_label_ = "ring";
+    st = CompressedRingAllgatherv(offsets, block_bytes, out->data());
+  } else if (offsets[size_] > crossover_bytes_) {
+    // Bandwidth path: store-and-forward over neighbor lanes only — big
+    // gathers ride the shm/zero-copy neighbor lanes instead of opening all
+    // n-1 TCP streams at once.
+    last_algo_label_ = "ring";
+    st = RingAllgathervPhase(offsets, block_bytes, out->data());
+  } else {
+    // Latency path: direct pairwise rotation — step k sends my block to
+    // rank (rank+k), receives the block of rank (rank-k); every block
+    // travels exactly one hop.
+    last_algo_label_ = "direct";
+    st = Status::OK();
+    for (int k = 1; k < size_; ++k) {
+      int to = (rank_ + k) % size_;
+      int from = (rank_ - k + size_) % size_;
+      AddOpBytes(in_bytes, in_bytes);
+      st = Exchange(to, in, in_bytes, from,
+                    out->data() + offsets[from], block_bytes[from]);
+      if (!st.ok()) break;
+    }
+  }
+  raw_bytes_total_->Add(op_raw_bytes_);
+  wire_bytes_total_->Add(op_wire_bytes_);
+  PublishZeroCopyCounters();
+  if (corrupt_pending_ && st.ok() && !out->empty()) {
+    // Seeded SDC (HVDTPU_CHAOS corrupt@op=N): flip one byte of the gathered
+    // output — the divergence probe fingerprints allgather results too.
+    corrupt_pending_ = false;
+    out->data()[0] ^= 0x01;
+  }
+  return st;
+}
+
+Status DataPlane::RingAllgathervPhase(const std::vector<int64_t>& offsets,
+                                      const std::vector<int64_t>& block_bytes,
+                                      uint8_t* out) {
+  const int right = (rank_ + 1) % size_;
+  const int left = (rank_ - 1 + size_) % size_;
+  // Standard ring allgather generalized to ragged blocks: at step s forward
+  // block (rank - s) — own block first, then whatever just arrived — and
+  // receive block (rank - s - 1) straight into its slot.
+  for (int s = 0; s < size_ - 1; ++s) {
+    const int send_b = ((rank_ - s) % size_ + size_) % size_;
+    const int recv_b = ((rank_ - s - 1) % size_ + size_) % size_;
+    AddOpBytes(block_bytes[send_b], block_bytes[send_b]);
+    Status st = Exchange(right, out + offsets[send_b], block_bytes[send_b],
+                         left, out + offsets[recv_b], block_bytes[recv_b]);
     if (!st.ok()) return st;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::CompressedRingAllgatherv(
+    const std::vector<int64_t>& offsets,
+    const std::vector<int64_t>& block_bytes, uint8_t* out) {
+  const WireCompression c = op_comp_;
+  const int right = (rank_ + 1) % size_;
+  const int left = (rank_ - 1 + size_) % size_;
+  auto block_count = [&](int b) {
+    return block_bytes[b] / static_cast<int64_t>(sizeof(float));
+  };
+  int64_t max_count = 0;
+  for (int b = 0; b < size_; ++b) {
+    max_count = std::max(max_count, block_count(b));
+  }
+  std::vector<uint8_t> cur(static_cast<size_t>(WireBytes(c, max_count)));
+  std::vector<uint8_t> next(cur.size());
+
+  // Quantize-once at the owner, exactly like the compressed ring
+  // allreduce's allgather phase: my block's codes are produced here (no
+  // error-feedback residual — an allgather payload is a value, not a
+  // gradient stream) with self-decode, so my own copy holds the same lossy
+  // values every receiver will decode; each later hop forwards the codes
+  // verbatim and the gathered vectors agree bitwise world-wide.
+  float* own = reinterpret_cast<float*>(out + offsets[rank_]);
+  const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+  {
+    ProfPhaseScope prof_codec(PerfPhase::CODEC);
+    WireCompress(c, own, block_count(rank_), cur.data(), nullptr, own,
+                 op_quality_);
+  }
+  TraceHop("QUANTIZE", -1, -1, block_bytes[rank_], qt0, io_ctl_.WaitUs());
+  for (int s = 0; s < size_ - 1; ++s) {
+    const int send_b = ((rank_ - s) % size_ + size_) % size_;
+    const int recv_b = ((rank_ - s - 1) % size_ + size_) % size_;
+    const int64_t sw = WireBytes(c, block_count(send_b));
+    const int64_t rw = WireBytes(c, block_count(recv_b));
+    AddOpBytes(block_bytes[send_b], sw);
+    Status st = Exchange(right, cur.data(), sw, left, next.data(), rw);
+    if (!st.ok()) return st;
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompress(c, next.data(), block_count(recv_b),
+                     reinterpret_cast<float*>(out + offsets[recv_b]));
+    }
+    TraceHop("DEQUANTIZE", -1, -1, block_bytes[recv_b], dt0,
+             io_ctl_.WaitUs());
+    cur.swap(next);
   }
   return Status::OK();
 }
@@ -2078,19 +2185,61 @@ Status DataPlane::AdasumAllreduce(void* data, int64_t count, DataType dtype) {
 
 Status DataPlane::ReduceScatter(const void* in, int64_t count, DataType dtype,
                                 ReduceOp op, ByteBuf* out) {
-  // Simple implementation on top of ring allreduce: reduce a copy, keep my
-  // chunk. (A dedicated reduce-scatter would halve traffic; the coordinator
-  // only dispatches small eager tensors here — the compiled path owns the hot
-  // loop.)
+  op_raw_bytes_ = 0;
+  op_wire_bytes_ = 0;
+  last_algo_label_ = "none";
+  trace_op_ = false;
   const size_t elem = DataTypeSize(dtype);
-  std::vector<uint8_t> tmp(static_cast<size_t>(count) * elem);
-  memcpy(tmp.data(), in, tmp.size());
-  Status st = Allreduce(tmp.data(), count, dtype, op);
-  if (!st.ok()) return st;
-  int64_t chunk = count / size_;
-  out->assign(tmp.begin() + rank_ * chunk * static_cast<int64_t>(elem),
-              tmp.begin() + (rank_ + 1) * chunk * static_cast<int64_t>(elem));
-  return Status::OK();
+  if (size_ == 1) {
+    out->resize(static_cast<size_t>(count) * elem);
+    memcpy(out->data(), in, out->size());
+    ResetOpPhaseAccum();  // ObserveOp reads the accumulators regardless
+    return Status::OK();
+  }
+  if (count == 0) {
+    out->clear();
+    ResetOpPhaseAccum();
+    return Status::OK();
+  }
+  BeginOpTrace();
+  MaybeChaosOp();
+  // The ring reduces in place: stage the input in a full-length scratch
+  // (the caller's buffer is const and may be the user's pinned array).
+  std::vector<uint8_t> work(static_cast<size_t>(count) * elem);
+  memcpy(work.data(), in, work.size());
+  // The ring's reduce-scatter phase with PUBLIC chunk ownership: the phase
+  // leaves member gi owning chunk (gi+1) % gs, so run it over the rotated
+  // group [1, 2, ..., n-1, 0]. Rank r sits at group index (r-1+n)%n and
+  // therefore owns chunk r — while its physical ring neighbors (right =
+  // r+1, left = r-1) are exactly the flat ring's, so the segmented
+  // exchanges, shm in-place views and zero-copy lanes are reused unchanged.
+  std::vector<int> rot(size_);
+  for (int i = 0; i < size_; ++i) rot[i] = (i + 1) % size_;
+  const int gi = (rank_ - 1 + size_) % size_;
+  std::vector<int64_t> starts = ChunkStarts(count, size_);
+  last_algo_label_ = "ring";
+  Status st;
+  if (CompressionActive(dtype, op)) {
+    st = CompressedRingReduceScatter(reinterpret_cast<float*>(work.data()),
+                                     starts, rot, gi);
+  } else {
+    st = RingReduceScatterPhase(work.data(), starts, elem, dtype, op, rot,
+                                gi);
+  }
+  if (st.ok()) {
+    out->assign(
+        work.begin() + starts[rank_] * static_cast<int64_t>(elem),
+        work.begin() + starts[rank_ + 1] * static_cast<int64_t>(elem));
+  }
+  raw_bytes_total_->Add(op_raw_bytes_);
+  wire_bytes_total_->Add(op_wire_bytes_);
+  PublishZeroCopyCounters();
+  if (corrupt_pending_ && st.ok() && !out->empty()) {
+    // Seeded SDC in this rank's reduced chunk (docs/numerics.md).
+    corrupt_pending_ = false;
+    out->data()[0] ^= 0x01;
+  }
+  return st;
 }
 
 }  // namespace hvdtpu

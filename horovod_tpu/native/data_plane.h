@@ -82,7 +82,8 @@ enum class HierMode : int32_t {
 
 // Fault injection (chaos harness; docs/fault-tolerance.md): at most one
 // action armed per process via HVDTPU_CHAOS -> hvdtpu_set_chaos. Fires once,
-// at the op_index-th allreduce this rank starts or the hop_index-th pairwise
+// at the op_index-th collective this rank starts (allreduce, adasum,
+// reduce-scatter and allgather all count) or the hop_index-th pairwise
 // exchange it runs (1-based; exchanges count across every phase — segmented
 // ring hops, recursive-doubling rounds, tree edges, hier leader phases and
 // compressed hops alike, so a randomized hop lands anywhere in the
@@ -95,7 +96,7 @@ struct ChaosSpec {
     HANG = 2,     // wedge the collective thread forever (live but silent)
     DELAY = 3,    // one-shot sleep of delay_ms (must NOT trip detection)
     DROP = 4,     // blackhole one peer lane (partition: silent, no EOF)
-    CORRUPT = 5,  // flip one byte of the op's post-allreduce output —
+    CORRUPT = 5,  // flip one byte of the op's reduced/gathered output —
                   // seeded silent data corruption the divergence probe
                   // (docs/numerics.md) must catch. op trigger only.
   };
@@ -312,8 +313,18 @@ class DataPlane {
   // core's per-op metric labels.
   const char* last_algo_label() const { return last_algo_label_; }
 
-  // Gather variable-length byte blocks from every rank; out = concatenated in
-  // rank order. block_bytes[r] gives each rank's contribution size.
+  // First-class allgather (PR 18): gather variable-length byte blocks from
+  // every rank; out = concatenated in rank order. block_bytes[r] gives each
+  // rank's contribution size (negotiated per-rank dim-0 in the RESPONSES
+  // frame). Dispatches like Allreduce: at or below the crossover the direct
+  // pairwise rotation ("direct", n-1 full-duplex lanes), above it the ring
+  // store-and-forward rotation ("ring", neighbor lanes only — the
+  // allreduce's allgather phase generalized to ragged blocks). When the
+  // core armed wire compression for the op (BeginCompressedOp; fp32 blocks
+  // only), the ring variant ships quantize-once owner codes: every rank —
+  // the owner included, via self-decode — decodes identical codes, so the
+  // gathered vectors are bitwise identical world-wide. Full op lifecycle
+  // (chaos trigger, cumulative byte counters, perf phases) like Allreduce.
   Status Allgatherv(const void* in, int64_t in_bytes,
                     const std::vector<int64_t>& block_bytes,
                     ByteBuf* out);
@@ -326,8 +337,18 @@ class DataPlane {
                    const std::vector<int64_t>& recv_bytes,
                    ByteBuf* out);
 
-  // Reduce then keep this rank's contiguous chunk (count must divide evenly;
-  // validated by the coordinator before dispatch).
+  // First-class reduce-scatter (PR 18): reduce `count` elements across the
+  // world and keep this rank's contiguous dim-0 chunk — the ring allreduce's
+  // reduce-scatter phase promoted to a public op, at half an allreduce's
+  // wire bytes ((n-1)/n of the payload per rank). Runs the existing ring
+  // machinery over the rotated group [1, 2, ..., n-1, 0]: rank r sits at
+  // group index (r-1+n)%n, so the phase's owner rule (member gi owns chunk
+  // (gi+1)%gs) lands chunk r on rank r while the physical ring neighbors —
+  // and therefore the segmented/zero-copy lane schedule — are unchanged.
+  // Compressed mode (BeginCompressedOp, fp32 SUM/AVERAGE) rides the same
+  // quantized hops as the compressed ring allreduce's first half. The
+  // public op requires count % size == 0 (validated by the coordinator);
+  // standalone callers may pass ragged counts and get the ragged chunk.
   Status ReduceScatter(const void* in, int64_t count, DataType dtype,
                        ReduceOp op, ByteBuf* out);
 
@@ -473,6 +494,22 @@ class DataPlane {
   Status RingAllgatherPhase(uint8_t* buf, const std::vector<int64_t>& starts,
                             size_t elem, const std::vector<int>& group,
                             int gi);
+
+  // First-class allgather internals (PR 18), both over the natural world
+  // ring (rank r owns block r; offsets[r] = byte start of block r in out).
+  // RingAllgathervPhase: store-and-forward rotation of ragged blocks — at
+  // step s ship block (rank-s), receive block (rank-s-1) from the left
+  // neighbor, n-1 hops over neighbor lanes only. CompressedRingAllgatherv:
+  // same rotation, but each block travels as its owner's quantize-once wire
+  // codes (fp32 blocks; the owner self-decodes), forwarded verbatim so all
+  // ranks decode identical codes and the result is bitwise identical
+  // world-wide.
+  Status RingAllgathervPhase(const std::vector<int64_t>& offsets,
+                             const std::vector<int64_t>& block_bytes,
+                             uint8_t* out);
+  Status CompressedRingAllgatherv(const std::vector<int64_t>& offsets,
+                                  const std::vector<int64_t>& block_bytes,
+                                  uint8_t* out);
 
   // Two-level path: intra-host ring reduce-scatter -> chunks gathered to the
   // host leader -> leaders run the flat algorithm over TCP -> chunks

@@ -1689,6 +1689,210 @@ void TestDataPlaneCompressedAllreduce() {
   }
 }
 
+// First-class reduce-scatter (PR 18): worlds {2,3} x TCP/shm x
+// {dense,fp16,int8,int4}. Every rank must land exactly its own contiguous
+// chunk of the reduced vector — the rotated-group ring leaves chunk r on
+// rank r — within the wire mode's error budget, including a ragged count
+// (standalone DataPlane callers may pass count % world != 0).
+void TestDataPlaneReduceScatter() {
+  for (bool shm : {false, true}) {
+    for (WireCompression comp :
+         {WireCompression::NONE, WireCompression::FP16,
+          WireCompression::INT8, WireCompression::INT4}) {
+      for (int world : {2, 3}) {
+        // Ragged only on the dense path: the compressed ring quantizes
+        // whole chunks, and the coordinator enforces divisibility for the
+        // public op anyway.
+        const int64_t n = comp == WireCompression::NONE ? 3001 : 3000;
+        TestWorld w =
+            MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+        for (int r = 0; r < world; ++r) {
+          w.planes[r]->set_segment_bytes(512);
+          w.planes[r]->set_shm_enabled(shm);
+          w.planes[r]->set_shm_ring_bytes(8192);
+          w.planes[r]->set_hier_mode(HierMode::OFF);
+        }
+        std::vector<std::vector<float>> ins(world, std::vector<float>(n));
+        std::vector<double> expect(n, 0.0);
+        for (int r = 0; r < world; ++r) {
+          for (int64_t i = 0; i < n; ++i) {
+            ins[r][i] =
+                0.25f * static_cast<float>((i * 5 + r * 17) % 19 - 9);
+            expect[i] += ins[r][i];
+          }
+        }
+        double max_abs = 0.0;
+        for (double v : expect) max_abs = std::max(max_abs, std::fabs(v));
+        const double tol =
+            (comp == WireCompression::NONE   ? 1e-6
+             : comp == WireCompression::FP16 ? 2e-3
+             : comp == WireCompression::INT8 ? 0.03
+                                             : 0.4) *
+            std::max(max_abs, 1.0);
+        std::vector<ByteBuf> outs(world);
+        std::atomic<int> bad{0};
+        std::vector<std::thread> threads;
+        for (int r = 0; r < world; ++r) {
+          threads.emplace_back([&, r] {
+            if (!w.planes[r]->Connect(w.peers).ok()) {
+              ++bad;
+              return;
+            }
+            std::vector<float> residual(n, 0.0f);
+            if (comp != WireCompression::NONE) {
+              w.planes[r]->BeginCompressedOp(comp, residual.data());
+            }
+            Status st = w.planes[r]->ReduceScatter(
+                ins[r].data(), n, DataType::FLOAT32, ReduceOp::SUM,
+                &outs[r]);
+            w.planes[r]->EndCompressedOp();
+            if (!st.ok()) ++bad;
+            // Half an allreduce: raw accounting is (n-1)/n of the payload
+            // this rank forwarded; dense wire == raw.
+            if (comp == WireCompression::NONE &&
+                w.planes[r]->op_wire_bytes() !=
+                    w.planes[r]->op_raw_bytes()) {
+              ++bad;
+            }
+          });
+        }
+        for (auto& t : threads) t.join();
+        // Chunk starts mirror ChunkStarts(): base + remainder spread.
+        const int64_t base = n / world, rem = n % world;
+        int64_t start = 0;
+        for (int r = 0; r < world && bad == 0; ++r) {
+          const int64_t len = base + (r < rem ? 1 : 0);
+          if (static_cast<int64_t>(outs[r].size()) != len * 4) {
+            ++bad;
+            break;
+          }
+          const float* got = reinterpret_cast<const float*>(outs[r].data());
+          for (int64_t i = 0; i < len; ++i) {
+            if (std::fabs(got[i] - expect[start + i]) > tol) {
+              ++bad;
+              break;
+            }
+          }
+          start += len;
+        }
+        if (bad != 0) {
+          std::fprintf(stderr,
+                       "FAIL reduce-scatter world=%d comp=%s shm=%d\n",
+                       world, WireCompressionName(comp), shm ? 1 : 0);
+          ++failures;
+        }
+        for (auto& p : w.planes) p->Shutdown();
+      }
+    }
+  }
+}
+
+// First-class ragged allgather (PR 18): worlds {2,3} x TCP/shm x
+// {dense-direct,dense-ring,fp16,int8,int4}. Dense results must be exact
+// on both dispatch arms (pairwise rotation under the crossover, ring
+// store-and-forward above it); compressed results ride quantize-once
+// owner codes, so every rank's gathered vector must be BITWISE identical
+// even though the codes are lossy vs the originals.
+void TestDataPlaneAllgatherv() {
+  for (bool shm : {false, true}) {
+    struct Arm {
+      WireCompression comp;
+      int64_t crossover;  // 0 = keep the 32 KB default (direct arm)
+    };
+    const Arm arms[] = {
+        {WireCompression::NONE, 0},     // direct pairwise rotation
+        {WireCompression::NONE, 1024},  // forced ring store-and-forward
+        {WireCompression::FP16, 0},     {WireCompression::INT8, 0},
+        {WireCompression::INT4, 0},
+    };
+    for (const Arm& arm : arms) {
+      for (int world : {2, 3}) {
+        TestWorld w =
+            MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+        for (int r = 0; r < world; ++r) {
+          w.planes[r]->set_segment_bytes(512);
+          w.planes[r]->set_shm_enabled(shm);
+          w.planes[r]->set_shm_ring_bytes(8192);
+          w.planes[r]->set_hier_mode(HierMode::OFF);
+          if (arm.crossover > 0) {
+            w.planes[r]->set_crossover_bytes(arm.crossover);
+          }
+        }
+        // Ragged per-rank blocks (fp32 counts; ~3-5 KB each).
+        std::vector<std::vector<float>> ins(world);
+        std::vector<int64_t> block_bytes(world);
+        std::vector<double> expect;
+        for (int r = 0; r < world; ++r) {
+          const int64_t cnt = 800 + 131 * r;
+          ins[r].resize(cnt);
+          for (int64_t i = 0; i < cnt; ++i) {
+            ins[r][i] =
+                0.5f * static_cast<float>((i * 3 + r * 7) % 17 - 8);
+            expect.push_back(ins[r][i]);
+          }
+          block_bytes[r] = cnt * 4;
+        }
+        double max_abs = 0.0;
+        for (double v : expect) max_abs = std::max(max_abs, std::fabs(v));
+        const double tol =
+            (arm.comp == WireCompression::NONE   ? 0.0
+             : arm.comp == WireCompression::FP16 ? 2e-3
+             : arm.comp == WireCompression::INT8 ? 0.03
+                                                 : 0.4) *
+            std::max(max_abs, 1.0);
+        std::vector<ByteBuf> outs(world);
+        std::atomic<int> bad{0};
+        std::vector<std::thread> threads;
+        for (int r = 0; r < world; ++r) {
+          threads.emplace_back([&, r] {
+            if (!w.planes[r]->Connect(w.peers).ok()) {
+              ++bad;
+              return;
+            }
+            if (arm.comp != WireCompression::NONE) {
+              w.planes[r]->BeginCompressedOp(arm.comp, nullptr);
+            }
+            Status st = w.planes[r]->Allgatherv(
+                ins[r].data(), block_bytes[r], block_bytes, &outs[r]);
+            w.planes[r]->EndCompressedOp();
+            if (!st.ok()) ++bad;
+          });
+        }
+        for (auto& t : threads) t.join();
+        const size_t total = expect.size();
+        for (int r = 0; r < world && bad == 0; ++r) {
+          if (outs[r].size() != total * 4) {
+            ++bad;
+            break;
+          }
+          const float* got = reinterpret_cast<const float*>(outs[r].data());
+          for (size_t i = 0; i < total; ++i) {
+            const double err = std::fabs(got[i] - expect[i]);
+            if (arm.comp == WireCompression::NONE ? err != 0.0
+                                                  : err > tol) {
+              ++bad;
+              break;
+            }
+          }
+          // Bitwise world-wide, lossy or not (quantize-once owner codes).
+          if (memcmp(outs[r].data(), outs[0].data(), total * 4) != 0) {
+            ++bad;
+          }
+        }
+        if (bad != 0) {
+          std::fprintf(stderr,
+                       "FAIL allgatherv world=%d comp=%s crossover=%lld "
+                       "shm=%d\n",
+                       world, WireCompressionName(arm.comp),
+                       static_cast<long long>(arm.crossover), shm ? 1 : 0);
+          ++failures;
+        }
+        for (auto& p : w.planes) p->Shutdown();
+      }
+    }
+  }
+}
+
 // Compressed hierarchical worlds: the leader (cross-host) phase carries the
 // quantized hops, intra-host stages stay dense; result must still agree
 // with the oracle and bitwise across every rank.
@@ -3132,6 +3336,8 @@ int main() {
   TestWireInt4PackingAndTail();
   TestWireErrorFeedbackConvergence();
   TestDataPlaneCompressedAllreduce();
+  TestDataPlaneReduceScatter();
+  TestDataPlaneAllgatherv();
   TestDataPlaneCompressedHierarchical();
   TestReduceBufferOps();
   TestMetricsConcurrentIncrements();

@@ -156,6 +156,18 @@ def _fused_two_axis_allreduce(grads, op, inner: str, outer: str,
     return jax.tree.unflatten(treedef, outs)
 
 
+def _note_state_bytes(state) -> None:
+    """Publish the replicated optimizer-state footprint to the native
+    ``hvdtpu_optimizer_state_bytes`` gauge (process mode only) — the
+    baseline :class:`~.sharded_optimizer.ShardedDistributedOptimizer`'s
+    1/world footprint is measured against (docs/optimizer.md)."""
+    try:
+        from .sharded_optimizer import publish_optimizer_state_bytes
+        publish_optimizer_state_bytes(state)
+    except Exception:
+        pass  # tracing-time init or uninitialized runtime: gauge is best-effort
+
+
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          named_parameters: Any = None,
                          compression=None,
@@ -356,7 +368,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
         from ..compression.error_feedback import init_error_feedback
 
         def init_fn(params):
-            return (optimizer.init(params), init_error_feedback(params))
+            state = (optimizer.init(params), init_error_feedback(params))
+            _note_state_bytes(state)
+            return state
 
         def update_fn(grads, state, params=None, **extra):
             inner_state, residuals = state
@@ -366,7 +380,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             return updates, (inner_state, new_residuals)
     else:
         def init_fn(params):
-            return optimizer.init(params)
+            state = optimizer.init(params)
+            _note_state_bytes(state)
+            return state
 
         def update_fn(grads, state, params=None, **extra):
             if quantized:

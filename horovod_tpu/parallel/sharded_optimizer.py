@@ -57,6 +57,37 @@ def _flatten_pad(leaves, padded_len: int) -> jnp.ndarray:
     return jnp.concatenate(parts)
 
 
+def _flatten_pad_np(leaves, padded_len: int) -> np.ndarray:
+    """Host-side :func:`_flatten_pad`: one fp32 numpy vector, zero-padded.
+    The process-mode eager path stays in numpy so the native data plane
+    gets a stable pinned buffer without a device round-trip."""
+    out = np.zeros((padded_len,), np.float32)
+    off = 0
+    for leaf in leaves:
+        a = np.asarray(leaf, dtype=np.float32).reshape(-1)
+        out[off:off + a.size] = a
+        off += a.size
+    return out
+
+
+def publish_optimizer_state_bytes(state: Any) -> int:
+    """Report the resident optimizer-state footprint of ``state`` to the
+    native ``hvdtpu_optimizer_state_bytes`` gauge (process mode; no-op when
+    the core lacks the symbol). Returns the byte count either way so tests
+    and callers can assert the ZeRO-1 1/world claim (docs/optimizer.md)."""
+    nbytes = 0
+    for leaf in jax.tree.leaves(state):
+        if hasattr(leaf, "nbytes"):
+            nbytes += int(leaf.nbytes)
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            nbytes += int(leaf.size) * np.dtype(leaf.dtype).itemsize
+    if runtime.is_initialized() and runtime.mode() == "process":
+        core = runtime.core()
+        if core is not None and hasattr(core, "set_optimizer_state_bytes"):
+            core.set_optimizer_state_bytes(nbytes)
+    return nbytes
+
+
 class ShardedDistributedOptimizer:
     """Data-parallel optimizer with a cross-replica sharded update
     (arXiv:2004.13336). In-step only: ``update`` must run inside
@@ -73,6 +104,8 @@ class ShardedDistributedOptimizer:
 
     # ------------------------------------------------------------------
     def _n(self) -> int:
+        if runtime.mode() == "process":
+            return runtime.size()
         ax = self._axis if self._axis is not None else runtime.dp_axis()
         return int(runtime.mesh().shape[ax])
 
@@ -86,7 +119,14 @@ class ShardedDistributedOptimizer:
         must run over that same axis). The state is born SHARDED: init runs
         under jit with dp-sharded out_shardings, so the full fp32 moments
         never materialize on one device (the whole point of the paper is
-        that replicated state may not fit)."""
+        that replicated state may not fit).
+
+        Process mode (ZeRO-1 over the native data plane): the inner state
+        is created over only THIS rank's 1/world parameter shard and its
+        footprint is published to the ``hvdtpu_optimizer_state_bytes``
+        gauge, so ``/metrics`` attests the memory claim directly."""
+        if runtime.mode() == "process":
+            return self._init_process(params)
         from jax.sharding import NamedSharding
 
         leaves = jax.tree.leaves(params)
@@ -103,6 +143,20 @@ class ShardedDistributedOptimizer:
             is_leaf=lambda x: isinstance(x, P))
         return jax.jit(_init, out_shardings=shardings)(leaves)
 
+    def _init_process(self, params: Any):
+        """Process-mode init: state over the LOCAL 1/world shard only."""
+        leaves = jax.tree.leaves(params)
+        total = sum(_flat_sizes(leaves))
+        n = self._n()
+        shard_len = -(-total // n)
+        flat_p = _flatten_pad_np(leaves, shard_len * n)
+        idx = runtime.rank()
+        p_shard = jnp.asarray(
+            flat_p[idx * shard_len:(idx + 1) * shard_len])
+        state = self._inner.init(p_shard)
+        publish_optimizer_state_bytes(state)
+        return state
+
     def state_spec(self, state: Any):
         """PartitionSpec pytree for threading the state through
         ``run_step``: flat vector leaves shard over dp, scalars replicate."""
@@ -114,7 +168,13 @@ class ShardedDistributedOptimizer:
     # ------------------------------------------------------------------
     def update(self, grads: Any, state: Any, params: Any):
         """In-step: reduce-scatter fused grads, update the local shard with
-        the local optimizer-state shard, all-gather the updates."""
+        the local optimizer-state shard, all-gather the updates.
+
+        Process mode runs the same dataflow eagerly over the native
+        first-class collectives (reduce-scatter + allgather on the C++ data
+        plane) — the ZeRO-1 weight update with no mesh and no trace."""
+        if runtime.mode() == "process":
+            return self._update_process(grads, state, params)
         ax = self._axis if self._axis is not None else runtime.dp_axis()
         if not C.in_named_trace(ax):
             raise ValueError(
@@ -169,5 +229,47 @@ class ShardedDistributedOptimizer:
         for g, size in zip(leaves, sizes):
             outs.append(full[off:off + size].reshape(g.shape)
                         .astype(g.dtype))
+            off += size
+        return jax.tree.unflatten(treedef, outs), new_state
+
+    def _update_process(self, grads: Any, state: Any, params: Any):
+        """Eager ZeRO-1 step over the native data plane (process mode).
+
+        Same dataflow as the in-step path, one host round-trip per half:
+        reduce-scatter the fused fp32 gradient vector (the ring allreduce's
+        first half — AVERAGE rides the native postscale), run the inner
+        transform on this rank's 1/world shard against the LOCAL state,
+        then allgather the updated shards (the second half). Wire bytes
+        equal one allreduce of the fused vector; optimizer state and
+        update compute are 1/world (arXiv:2004.13336)."""
+        n = self._n()
+        idx = runtime.rank()
+        leaves, treedef = jax.tree.flatten(grads)
+        sizes = _flat_sizes(leaves)
+        total = sum(sizes)
+        shard_len = -(-total // n)
+        padded = shard_len * n
+
+        flat_g = _flatten_pad_np(leaves, padded)
+        g_shard = np.asarray(
+            C.reducescatter(flat_g, op=self._op, name="zero1.grads"),
+            dtype=np.float32).reshape(-1)
+
+        flat_p = _flatten_pad_np(jax.tree.leaves(params), padded)
+        p_shard = flat_p[idx * shard_len:(idx + 1) * shard_len]
+
+        upd_shard, new_state = self._inner.update(
+            jnp.asarray(g_shard), state, jnp.asarray(p_shard))
+        publish_optimizer_state_bytes(new_state)
+
+        full = np.asarray(
+            C.allgather(np.ascontiguousarray(upd_shard, dtype=np.float32),
+                        name="zero1.updates"),
+            dtype=np.float32).reshape(-1)[:total]
+
+        outs, off = [], 0
+        for g, size in zip(leaves, sizes):
+            outs.append(jnp.asarray(
+                full[off:off + size].reshape(g.shape)).astype(g.dtype))
             off += size
         return jax.tree.unflatten(treedef, outs), new_state

@@ -157,6 +157,19 @@ def load_lib(path: str) -> ctypes.CDLL:
         lib.hvdtpu_profiler_start.argtypes = [ctypes.c_void_p]
     except AttributeError:
         pass  # pre-profiler build
+    try:
+        lib.hvdtpu_enqueue_reducescatter.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_reducescatter.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_double, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+        lib.hvdtpu_enqueue_allgather.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_int]
+    except AttributeError:
+        pass  # pre-reduce-scatter/allgather build
     return lib
 
 

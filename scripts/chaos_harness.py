@@ -45,6 +45,11 @@ TRANSPORTS = ("tcp", "shm")
 HIERS = ("0", "1")
 COMPRESSIONS = ("none", "fp16", "int8", "int4")
 SCENARIOS = ("kill", "hang", "drop", "delay")
+# Which collective carries the fault: the first-class op menu
+# (docs/collectives.md "Reduce-scatter & allgather"). reducescatter and
+# allgather are single-schedule ops (the ring / the block rotation), so
+# their sweeps pin algo=ring, hier=0.
+OPS = ("allreduce", "reducescatter", "allgather")
 
 # Detection-to-reformation budgets (seconds, per recovery observation).
 # kill/drop: survivors only re-form — the acceptance bound. hang: recovery
@@ -64,7 +69,7 @@ def _worker_env(extra):
 
 
 def run_scenario(scenario, algo, transport, hier, compression, np_, batches,
-                 rng, verbose=False):
+                 rng, op="allreduce", verbose=False):
     """One elastic chaos run; returns a result dict (ok + diagnostics)."""
     from horovod_tpu.runner.elastic import (ElasticSettings,
                                             HostDiscoveryScript, run_elastic)
@@ -96,6 +101,7 @@ def run_scenario(scenario, algo, transport, hier, compression, np_, batches,
         "CHAOS_TARGET_BATCHES": str(batches),
         "HVDTPU_CHAOS": spec,
         "HVDTPU_CHAOS_MARKER": os.path.join(tmp, "chaos.marker"),
+        "CHAOS_OP": op,
         "HVDTPU_ALLREDUCE_ALGO": algo,
         "HVDTPU_SHM": "1" if transport == "shm" else "0",
         "HVDTPU_ALLREDUCE_HIER": hier,
@@ -114,9 +120,10 @@ def run_scenario(scenario, algo, transport, hier, compression, np_, batches,
                      [sys.executable, WORKER], env, verbose=verbose)
     wall = time.time() - t0
 
-    res = {"scenario": scenario, "algo": algo, "transport": transport,
-           "hier": hier, "compression": compression, "spec": spec,
-           "rc": rc, "wall_s": round(wall, 2), "ok": False, "why": ""}
+    res = {"scenario": scenario, "op": op, "algo": algo,
+           "transport": transport, "hier": hier, "compression": compression,
+           "spec": spec, "rc": rc, "wall_s": round(wall, 2), "ok": False,
+           "why": ""}
     lines = open(results).read().splitlines() if os.path.exists(results) \
         else []
     done = [ln for ln in lines if ln.startswith("done ")]
@@ -124,7 +131,7 @@ def run_scenario(scenario, algo, transport, hier, compression, np_, batches,
         res["why"] = f"job failed rc={rc}"
         return res
     if any(ln.startswith("WRONG") for ln in lines):
-        res["why"] = "incorrect allreduce result after recovery"
+        res["why"] = f"incorrect {op} result after recovery"
         return res
     if not done:
         res["why"] = "no worker finished"
@@ -170,6 +177,9 @@ def main(argv=None):
     p.add_argument("--transports", default=",".join(TRANSPORTS))
     p.add_argument("--hier", default=",".join(HIERS))
     p.add_argument("--compression", default=",".join(COMPRESSIONS))
+    p.add_argument("--ops", default="allreduce",
+                   help=f"comma list of {OPS}; reducescatter/allgather "
+                        "pin algo=ring, hier=0 (single-schedule ops)")
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
@@ -180,24 +190,31 @@ def main(argv=None):
 
     combos = []
     if args.smoke:
-        combos = [("kill", "ring", "tcp", "0", "none"),
-                  ("hang", "ring", "tcp", "0", "none")]
+        combos = [("kill", "allreduce", "ring", "tcp", "0", "none"),
+                  ("hang", "allreduce", "ring", "tcp", "0", "none")]
     else:
         for scenario in args.scenarios.split(","):
-            for algo in args.algos.split(","):
-                for transport in args.transports.split(","):
-                    for hier in args.hier.split(","):
-                        for comp in args.compression.split(","):
-                            combos.append((scenario, algo, transport, hier,
-                                           comp))
+            for op in args.ops.split(","):
+                # RS/AG run one fixed schedule: the algo/hier dimensions
+                # are allreduce-only, so collapse them to the ring.
+                algos = args.algos.split(",") if op == "allreduce" \
+                    else ["ring"]
+                hiers = args.hier.split(",") if op == "allreduce" else ["0"]
+                for algo in algos:
+                    for transport in args.transports.split(","):
+                        for hier in hiers:
+                            for comp in args.compression.split(","):
+                                combos.append((scenario, op, algo, transport,
+                                               hier, comp))
 
     results, failed = [], 0
-    for i, (scenario, algo, transport, hier, comp) in enumerate(combos):
-        label = f"{scenario:6s} {algo:18s} {transport:3s} hier={hier} {comp}"
+    for i, (scenario, op, algo, transport, hier, comp) in enumerate(combos):
+        label = (f"{scenario:6s} {op:13s} {algo:18s} {transport:3s} "
+                 f"hier={hier} {comp}")
         print(f"[{i + 1}/{len(combos)}] {label} ...", file=sys.stderr,
               flush=True)
         res = run_scenario(scenario, algo, transport, hier, comp, args.np_,
-                           args.batches, rng, verbose=args.verbose)
+                           args.batches, rng, op=op, verbose=args.verbose)
         results.append(res)
         status = "OK" if res["ok"] else f"FAIL ({res['why']})"
         rec = res.get("worst_recovery_s")

@@ -157,6 +157,24 @@ gradz_smoke() {
   return 0
 }
 run_check "gradz-smoke" gradz_smoke
+# ZeRO-1 smoke (docs/optimizer.md "Process-mode ZeRO-1"): a real 2-rank
+# sharded-update job over the native first-class reduce-scatter/allgather
+# must pass all three acceptance proofs — the optimizer-state gauge at
+# ~1/world of the replicated footprint, bitwise cross-rank parity against
+# the replicated-adam reference, and per-step wire bytes bounded by one
+# ring allreduce of the fused vector. The 4-rank version is
+# tests/test_sharded_optimizer.py::TestZero1ProcessMode.
+zero1_smoke() {
+  local out
+  out=$(env JAX_PLATFORMS=cpu TEST_ZERO1_STEPS=3 \
+    HVDTPU_ALLREDUCE_ALGO=ring "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 \
+    python3 tests/data/zero1_worker.py 2>&1) || { echo "${out}"; return 1; }
+  # grep -o: the launcher can interleave both ranks' lines onto one.
+  [ "$(echo "${out}" | grep -o "ALL OK" | wc -l)" -eq 2 ] || return 1
+  return 0
+}
+run_check "zero1-smoke" zero1_smoke
 # Cross-run regression-sentry smoke (docs/observability.md): a job writes
 # merged perf profiles; perf_diff must pass a profile against itself
 # (exit 0) and CONFIRM a doctored 3x slowdown (exit 1) — so the perf
@@ -190,7 +208,8 @@ EOF
 run_check "perf_diff-smoke" perf_diff_smoke
 # Scale-out smoke (docs/collectives.md "Choosing an algorithm"): a w16
 # oversubscribed world runs EVERY allreduce algorithm (ring, recursive
-# doubling, tree, scatter-allgather, parameter server) on small tensors
+# doubling, tree, scatter-allgather, parameter server) plus the
+# first-class reduce-scatter / allgather / zero1-step ops on small tensors
 # through scripts/scale_bench.py — crash/stall/format gate, no timings —
 # then a real 16-rank hvdrun job must produce one well-formed --top-once
 # frame naming all 16 ranks, so the observability surface is proven at

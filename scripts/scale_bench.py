@@ -47,6 +47,12 @@ SCALE_ALGOS = ("ring", "recursive_doubling", "tree", "scatter_allgather",
 DTYPE_FLOAT32 = 7
 OP_ALLREDUCE = 0
 REDUCE_SUM = 1
+# Collectives the --ops sweep can time. reducescatter/allgather run one
+# fixed schedule (ring / block rotation), so their arms pin algo=ring;
+# zero1-step times the ZeRO-1 wire shape: reduce-scatter of the fused
+# gradient followed by allgather of the updated shard (same bytes as one
+# ring allreduce — docs/optimizer.md "Sharded optimizer state").
+OPS = ("allreduce", "reducescatter", "allgather", "zero1-step")
 # Counters scraped from the coordinator's metrics dump after the timed
 # loop (native/metrics.cpp text format; names in docs/metrics.md).
 CTRL_COUNTERS = ("hvdtpu_ctrl_frames_total", "hvdtpu_ctrl_batches_total",
@@ -102,6 +108,11 @@ def run_worker(args) -> int:
         # 64*63 ring segments on a box whose point is process pressure,
         # not lane bandwidth.
         lib.hvdtpu_set_transport(core, 0, 0, 0)
+    if args.op != "allreduce" and \
+            not hasattr(lib, "hvdtpu_enqueue_reducescatter"):
+        print(f"SKIP op {args.op}: library lacks reduce-scatter/allgather",
+              file=sys.stderr)
+        return 0
     if args.gradcheck and hasattr(lib, "hvdtpu_set_gradstats"):
         # Control-plane A/B arms probe EVERY op: each fingerprint is one
         # per-tensor control frame — the steady per-tensor traffic the
@@ -126,50 +137,100 @@ def run_worker(args) -> int:
                                   len(err)) != 0:
             raise RuntimeError(f"copy: {err.value.decode()}")
 
+    def wait_copy(h, out) -> None:
+        if lib.hvdtpu_wait(core, h, err, len(err)) != 0:
+            raise RuntimeError(f"wait: {err.value.decode()}")
+        if lib.hvdtpu_copy_result(core, h, out, ctypes.sizeof(out),
+                                  err, len(err)) != 0:
+            raise RuntimeError(f"copy: {err.value.decode()}")
+
+    def enqueue_op(name, buf, count):
+        shape = (ctypes.c_longlong * 1)(count)
+        if args.op == "reducescatter":
+            h = lib.hvdtpu_enqueue_reducescatter(
+                core, name, REDUCE_SUM, DTYPE_FLOAT32, shape, 1, buf,
+                1.0, 1.0, err, len(err))
+        elif args.op == "allgather":
+            h = lib.hvdtpu_enqueue_allgather(core, name, DTYPE_FLOAT32,
+                                             shape, 1, buf, err, len(err))
+        else:
+            h = lib.hvdtpu_enqueue(core, name, OP_ALLREDUCE, REDUCE_SUM,
+                                   DTYPE_FLOAT32, shape, 1, buf, 1.0, 1.0,
+                                   0, None, 0, err, len(err))
+        if h < 0:
+            raise RuntimeError(f"enqueue: {err.value.decode()}")
+        return h
+
     def step(names, bufs, count, outs) -> None:
         # A training step's shape: enqueue EVERY tensor, then wait — the
         # per-tensor READY/response frames of one step land in the same
         # coordinator cycle, which is what the vectored control plane
         # coalesces.
-        handles = []
-        for name, buf in zip(names, bufs):
-            shape = (ctypes.c_longlong * 1)(count)
-            h = lib.hvdtpu_enqueue(core, name, OP_ALLREDUCE, REDUCE_SUM,
-                                   DTYPE_FLOAT32, shape, 1, buf, 1.0, 1.0,
-                                   0, None, 0, err, len(err))
-            if h < 0:
-                raise RuntimeError(f"enqueue: {err.value.decode()}")
-            handles.append(h)
+        handles = [enqueue_op(name, buf, count)
+                   for name, buf in zip(names, bufs)]
         for h, out in zip(handles, outs):
-            if lib.hvdtpu_wait(core, h, err, len(err)) != 0:
-                raise RuntimeError(f"wait: {err.value.decode()}")
-            if lib.hvdtpu_copy_result(core, h, out, ctypes.sizeof(out),
-                                      err, len(err)) != 0:
-                raise RuntimeError(f"copy: {err.value.decode()}")
+            wait_copy(h, out)
+
+    def step_zero1(names, bufs, count, outs, shard_bufs) -> None:
+        # One ZeRO-1 step's wire shape: reduce-scatter the fused gradient,
+        # (the shard update is elementwise/local — not timed here), then
+        # allgather the updated shard back to the full vector. Distinct
+        # names per phase: the negotiation cache keys on (name, op).
+        chunk = count // n + (1 if rank < count % n else 0)
+        handles = [lib.hvdtpu_enqueue_reducescatter(
+            core, name + b".rs", REDUCE_SUM, DTYPE_FLOAT32,
+            (ctypes.c_longlong * 1)(count), 1, buf, 1.0, 1.0, err, len(err))
+            for name, buf in zip(names, bufs)]
+        if any(h < 0 for h in handles):
+            raise RuntimeError(f"rs enqueue: {err.value.decode()}")
+        for h, sb in zip(handles, shard_bufs):
+            wait_copy(h, sb)
+        handles = [lib.hvdtpu_enqueue_allgather(
+            core, name + b".ag", DTYPE_FLOAT32,
+            (ctypes.c_longlong * 1)(chunk), 1, sb, err, len(err))
+            for name, sb in zip(names, shard_bufs)]
+        if any(h < 0 for h in handles):
+            raise RuntimeError(f"ag enqueue: {err.value.decode()}")
+        for h, out in zip(handles, outs):
+            wait_copy(h, out)
 
     rc = 0
     try:
         for nbytes in [int(s) for s in args.sizes.split(",")]:
             count = max(1, nbytes // 4)
-            bufs, outs, names = [], [], []
+            out_count = count * n if args.op == "allgather" else count
+            bufs, outs, names, shards = [], [], [], []
             for t in range(args.tensors):
                 buf = (ctypes.c_char * (count * 4))()
                 fbuf = ctypes.cast(buf, ctypes.POINTER(ctypes.c_float))
                 fbuf[0] = float(rank + 1)
                 bufs.append(buf)
-                outs.append((ctypes.c_char * (count * 4))())
+                outs.append((ctypes.c_char * (out_count * 4))())
+                shards.append((ctypes.c_char * ((count // n + 1) * 4))())
                 names.append(f"scale.{nbytes}.{t}".encode())
+            run = (lambda: step_zero1(names, bufs, count, outs, shards)) \
+                if args.op == "zero1-step" \
+                else (lambda: step(names, bufs, count, outs))
             for _ in range(args.warmup):
-                step(names, bufs, count, outs)
+                run()
             t0 = time.perf_counter()
             for _ in range(args.iters):
-                step(names, bufs, count, outs)
+                run()
             dt = (time.perf_counter() - t0) / args.iters
             fout = ctypes.cast(outs[0], ctypes.POINTER(ctypes.c_float))
-            want = n * (n + 1) / 2.0
-            if abs(fout[0] - want) > 1e-3 * want:
+            # Inputs are zero except element 0 = rank+1: the reduced
+            # element 0 lands in rank 0's reduce-scatter chunk, leads
+            # rank 0's block in the gathered output, and survives the
+            # zero1 round trip on every rank.
+            if args.op == "allgather":
+                want = 1.0
+            elif args.op == "reducescatter" and rank != 0:
+                want = 0.0
+            else:
+                want = n * (n + 1) / 2.0
+            if abs(fout[0] - want) > 1e-3 * max(want, 1.0):
                 raise RuntimeError(
-                    f"bad allreduce result at {nbytes}B: {fout[0]}, "
+                    f"bad {args.op} result at {nbytes}B: {fout[0]}, "
                     f"want {want}")
             if rank == 0:
                 print(json.dumps({
@@ -204,7 +265,8 @@ def run_worker(args) -> int:
 
 def run_config(args, world: int, algo: str, sizes: list, iters: int,
                warmup: int, ctrl_batch: int = 1, tensors: int = 1,
-               gradcheck: int = 0, fusion: int = 64 * 1024 * 1024) -> tuple:
+               gradcheck: int = 0, fusion: int = 64 * 1024 * 1024,
+               op: str = "allreduce") -> tuple:
     """Returns (rows, ctrl, stderr_text, failed). `ctrl` maps
     "coordinator" (rank 0) and "worker" (rank 1) to counter snapshots."""
     port = free_port()
@@ -217,7 +279,7 @@ def run_config(args, world: int, algo: str, sizes: list, iters: int,
                "--tensors", str(tensors),
                "--ctrl-batch", str(ctrl_batch),
                "--gradcheck", str(gradcheck),
-               "--fusion", str(fusion),
+               "--fusion", str(fusion), "--op", op,
                "--sa-group", str(args.sa_group), "--lib", args.lib,
                "--cycle-time-ms", str(args.cycle_time_ms)]
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
@@ -251,7 +313,7 @@ def run_config(args, world: int, algo: str, sizes: list, iters: int,
                 p.kill()
                 p.communicate()
     for row in rows:
-        row.update({"world": world, "algo": algo})
+        row.update({"world": world, "algo": algo, "op": op})
     return rows, ctrl, "\n".join(errs), failed
 
 
@@ -330,6 +392,39 @@ def crossover_tables(results: list) -> dict:
     return tables
 
 
+def op_tables(results: list) -> dict:
+    """Per world: avg step time per collective op at each size — the
+    reduce-scatter/allgather/zero1-step columns next to the ring allreduce
+    baseline (docs/benchmarks.md). zero1-step ~ allreduce is the measured
+    equal-wire-bytes claim of the sharded update."""
+    tables = {}
+    for row in results:
+        t = tables.setdefault(f"w{row['world']}", {})
+        cell = t.setdefault(str(row["bytes"]), {})
+        if row.get("op", "allreduce") != "allreduce" or \
+                row["algo"] == "ring":
+            cell[row.get("op", "allreduce")] = round(row["avg_s"], 6)
+    return tables
+
+
+def op_markdown_table(results: list, ops: list) -> str:
+    by_key = {}
+    for row in results:
+        if row.get("op", "allreduce") == "allreduce" and \
+                row["algo"] != "ring":
+            continue  # op columns compare against the ring baseline
+        by_key.setdefault((row["world"], row["bytes"]),
+                          {})[row.get("op", "allreduce")] = row
+    lines = ["| world | size | " + " | ".join(ops) + " |",
+             "|---|---|" + "---|" * len(ops)]
+    for (world, nbytes), cells in sorted(by_key.items()):
+        vals = ["—" if cells.get(o) is None
+                else f"{cells[o]['avg_s'] * 1e3:.2f} ms" for o in ops]
+        lines.append(f"| {world} | {human(nbytes)} | " + " | ".join(vals) +
+                     " |")
+    return "\n".join(lines)
+
+
 def markdown_table(results: list, algos: list) -> str:
     by_key = {}
     for row in results:
@@ -355,23 +450,27 @@ def run_smoke(args) -> int:
     noise). Fails on any rank error, missing rows, or a stall warning in
     any worker's stderr."""
     ok = True
-    for algo in SCALE_ALGOS:
-        rows, _, errtxt, failed = run_config(args, 16, algo, [4096], 2, 1)
+    arms = [(algo, "allreduce") for algo in SCALE_ALGOS] + \
+        [("ring", op) for op in OPS if op != "allreduce"]
+    for algo, op in arms:
+        label = algo if op == "allreduce" else op
+        rows, _, errtxt, failed = run_config(args, 16, algo, [4096], 2, 1,
+                                             op=op)
         if failed:
-            print(f"scale-smoke: w16 {algo} crashed", file=sys.stderr)
+            print(f"scale-smoke: w16 {label} crashed", file=sys.stderr)
             ok = False
             continue
         if len(rows) != 1 or rows[0]["avg_s"] <= 0:
-            print(f"scale-smoke: w16 {algo} produced {len(rows)} rows",
+            print(f"scale-smoke: w16 {label} produced {len(rows)} rows",
                   file=sys.stderr)
             ok = False
             continue
         if "stall" in errtxt.lower():
-            print(f"scale-smoke: w16 {algo} logged a stall warning",
+            print(f"scale-smoke: w16 {label} logged a stall warning",
                   file=sys.stderr)
             ok = False
             continue
-        print(f"scale-smoke: w16 {algo} OK", file=sys.stderr)
+        print(f"scale-smoke: w16 {label} OK", file=sys.stderr)
     print(f"scale-smoke: {'PASS' if ok else 'FAIL'}", file=sys.stderr)
     return 0 if ok else 1
 
@@ -393,11 +492,16 @@ def main(argv=None) -> int:
                    help=argparse.SUPPRESS)
     p.add_argument("--fusion", type=int, default=64 * 1024 * 1024,
                    help=argparse.SUPPRESS)
+    p.add_argument("--op", default="allreduce", choices=OPS,
+                   help=argparse.SUPPRESS)
     p.add_argument("--lib", default=os.environ.get("HVDTPU_NATIVE_LIB",
                                                    DEFAULT_LIB))
     p.add_argument("--world-sizes", default="16,32",
                    help="oversubscribed worlds to sweep (16-64)")
     p.add_argument("--algos", default=",".join(SCALE_ALGOS))
+    p.add_argument("--ops", default="allreduce",
+                   help=f"comma list of {OPS}; non-allreduce ops pin "
+                        "algo=ring (single-schedule collectives)")
     p.add_argument("--size-list", default="4096,65536,1048576",
                    help="comma-separated message sizes in bytes")
     p.add_argument("--sa-group", type=int, default=-1,
@@ -428,37 +532,52 @@ def main(argv=None) -> int:
     sizes = [int(s) for s in args.size_list.split(",")]
     worlds = [int(w) for w in args.world_sizes.split(",")]
     algos = args.algos.split(",")
+    ops = args.ops.split(",")
     for a in algos:
         if a not in ALGOS:
             print(f"unknown algo {a!r}; choices: {sorted(ALGOS)}",
                   file=sys.stderr)
             return 2
+    for o in ops:
+        if o not in OPS:
+            print(f"unknown op {o!r}; choices: {OPS}", file=sys.stderr)
+            return 2
 
     results, failed_configs = [], []
     for world in worlds:
-        for algo in algos:
-            t0 = time.time()
-            rows, _, _, failed = run_config(args, world, algo, sizes, 5, 2)
-            results.extend(rows)
-            if failed:
-                failed_configs.append(f"world={world} algo={algo}")
-            print(f"[w{world} {algo}] {len(rows)} sizes in "
-                  f"{time.time() - t0:.1f}s"
-                  f"{' (FAILED)' if failed else ''}", file=sys.stderr)
+        for op in ops:
+            # RS/AG/zero1 run one fixed schedule; the algo dimension is
+            # allreduce-only.
+            for algo in (algos if op == "allreduce" else ["ring"]):
+                t0 = time.time()
+                rows, _, _, failed = run_config(args, world, algo, sizes,
+                                                5, 2, op=op)
+                results.extend(rows)
+                if failed:
+                    failed_configs.append(
+                        f"world={world} op={op} algo={algo}")
+                print(f"[w{world} {op} {algo}] {len(rows)} sizes in "
+                      f"{time.time() - t0:.1f}s"
+                      f"{' (FAILED)' if failed else ''}", file=sys.stderr)
 
+    ar_rows = [r for r in results if r.get("op", "allreduce") == "allreduce"]
     ctrl = measure_ctrl_plane(args, worlds[0])
     report = {
-        "lib": args.lib, "worlds": worlds, "sizes": sizes,
+        "lib": args.lib, "worlds": worlds, "sizes": sizes, "ops": ops,
         "results": results, "failed_configs": failed_configs,
-        "crossover": crossover_tables(results),
+        "crossover": crossover_tables(ar_rows),
         "ctrl_plane": ctrl,
     }
+    if len(ops) > 1:
+        report["op_sweep"] = op_tables(results)
     text = json.dumps(report, indent=2)
     if args.output:
         with open(args.output, "w") as f:
             f.write(text + "\n")
     print(text)
-    print(markdown_table(results, algos), file=sys.stderr)
+    print(markdown_table(ar_rows, algos), file=sys.stderr)
+    if len(ops) > 1:
+        print(op_markdown_table(results, ops), file=sys.stderr)
     if "send_reduction_x" in ctrl:
         on = ctrl["batch_on"]["worker"]
         off = ctrl["batch_off"]["worker"]

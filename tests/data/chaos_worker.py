@@ -20,6 +20,10 @@ BATCH_SLEEP = float(os.environ.get("CHAOS_BATCH_SLEEP", "0"))
 # Elements per allreduce: default clears the compression min-bytes gate
 # (1024 B) so int8/int4 wire modes actually engage on the faulted op.
 ELEMS = int(os.environ.get("CHAOS_ELEMS", "4096"))
+# Which collective carries the fault (docs/collectives.md "Reduce-scatter
+# & allgather"): the kill matrix must hold for every first-class op, not
+# just allreduce.
+OP = os.environ.get("CHAOS_OP", "allreduce")
 
 hvd.init()
 
@@ -42,19 +46,33 @@ def _append(line):
 def train(state):
     while state.batches < TARGET:
         grad = float(state.w) - 3.0  # d/dw (w - 3)^2 / 2, same on all ranks
-        x = np.full(ELEMS, grad, np.float32)
         try:
-            out = hvd.allreduce(x, name=f"step{state.batches}", op=hvd.Sum)
+            # Correctness THROUGH the failure: all-equal payloads quantize
+            # exactly, so the expectation below holds for every
+            # wire-compression mode too.
+            if OP == "reducescatter":
+                # First dim must divide by the (possibly shrunk) world.
+                x = np.full(hvd.size() * 1024, grad, np.float32)
+                out = hvd.reducescatter(x, name=f"step{state.batches}",
+                                        op=hvd.Sum)
+                expect = grad * hvd.size()
+            elif OP == "allgather":
+                x = np.full(ELEMS, grad, np.float32)
+                out = hvd.allgather(x, name=f"step{state.batches}")
+                expect = grad
+            else:
+                x = np.full(ELEMS, grad, np.float32)
+                out = hvd.allreduce(x, name=f"step{state.batches}",
+                                    op=hvd.Sum)
+                expect = grad * hvd.size()
             arr = np.asarray(out)
-            # Correctness THROUGH the failure: every surviving rank must see
-            # exactly size * grad (all-equal payloads quantize exactly, so
-            # this holds for every wire-compression mode too).
-            expect = grad * hvd.size()
             if not np.allclose(arr, expect, rtol=1e-3, atol=1e-3):
                 _append(f"WRONG worker={os.environ.get('HVDTPU_WORKER_ID')} "
                         f"batch={state.batches} got={arr[:4]} want={expect}")
                 os._exit(5)
-            state.w = float(state.w) - 0.5 * float(arr.mean()) / hvd.size()
+            reduced_mean = float(arr.mean()) * \
+                (hvd.size() if OP == "allgather" else 1)
+            state.w = float(state.w) - 0.5 * reduced_mean / hvd.size()
             loss = (float(state.w) - 3.0) ** 2
             if not np.isfinite(loss):
                 _append(f"NAN worker={os.environ.get('HVDTPU_WORKER_ID')} "

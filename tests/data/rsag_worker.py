@@ -1,0 +1,98 @@
+"""Worker: first-class reduce-scatter & allgather (docs/collectives.md
+"Reduce-scatter & allgather").
+
+Runs TEST_RSAG_ITERS rounds of:
+
+* reducescatter SUM + AVERAGE of a fused fp32 vector (first dim divisible
+  by the world) — every rank reconstructs every rank's input from the
+  shared seed and checks its own dim-0 chunk against the local reduction;
+* allgather with per-rank varying dim-0 (small tensor -> direct pairwise
+  exchange; large tensor -> ring store-and-forward; compressed always ring)
+  checked against the locally reconstructed concatenation.
+
+Under HVDTPU_COMPRESSION the checks go tolerance-based (the wire is
+lossy) and the divergence probe (HVDTPU_GRADCHECK_SAMPLE=1) asserts the
+bitwise cross-rank invariant on the gathered outputs: quantize-once owner
+codes mean every rank decodes identical bytes, so a healthy world shows
+hvdtpu_gradcheck_probes_total > 0 and hvdtpu_divergence_total == 0.
+"""
+import os
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.observability import sample_value  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert hvd.mode() == "process", hvd.mode()
+
+comp = os.environ.get("HVDTPU_COMPRESSION", "none") or "none"
+compressed = comp not in ("", "none")
+iters = int(os.environ.get("TEST_RSAG_ITERS", "2"))
+
+# Per-mode whole-vector RMS tolerance (matches the native unit-test
+# envelopes: fp16 half-precision rounding, int8/int4 bucket quantization).
+TOL = {"fp16": 2e-3, "int8": 0.05, "int4": 0.5}
+
+
+def rank_data(rank, it, count, scale=1.0):
+    rng = np.random.RandomState(5000 + 131 * it + rank)
+    return (scale * rng.randn(count)).astype(np.float32)
+
+
+def check(out, want, what):
+    out = np.asarray(out, np.float32).reshape(-1)
+    want = np.asarray(want, np.float32).reshape(-1)
+    assert out.shape == want.shape, (what, out.shape, want.shape)
+    if not compressed:
+        # Deterministic ring accumulation differs from np.sum's order only
+        # by fp32 associativity.
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5,
+                                   err_msg=what)
+        return
+    denom = max(float(np.linalg.norm(want)), 1e-6)
+    rel = float(np.linalg.norm(out - want)) / denom
+    assert rel < TOL.get(comp, 0.5), (what, comp, rel)
+
+
+count = n * 4096  # 16 KB/chunk: over the compression min-bytes floor
+for it in range(iters):
+    xs = [rank_data(q, it, count) for q in range(n)]
+    shard = count // n
+
+    # -- reducescatter: SUM then AVERAGE ------------------------------
+    total = np.sum(np.stack(xs), axis=0)
+    out = hvd.reducescatter(xs[r], op=hvd.Sum, name=f"rs{it}/sum")
+    check(out, total[r * shard:(r + 1) * shard], f"rs-sum it{it}")
+    out = hvd.reducescatter(xs[r], op=hvd.Average, name=f"rs{it}/avg")
+    check(out, total[r * shard:(r + 1) * shard] / n, f"rs-avg it{it}")
+
+    # -- allgather: varying dim-0, small (direct) and large (ring) ----
+    rows = [60 + 17 * q for q in range(n)]
+    small = [rank_data(q, it, rows[q] * 8).reshape(rows[q], 8)
+             for q in range(n)]  # ~2-2.5 KB/rank: under the ring crossover
+    out = hvd.allgather(small[r], name=f"ag{it}/small")
+    check(out, np.concatenate(small), f"ag-small it{it}")
+
+    big_rows = [2048 + 256 * q for q in range(n)]
+    big = [rank_data(q, it, big_rows[q] * 8, scale=3.0)
+           .reshape(big_rows[q], 8) for q in range(n)]  # >32 KB total: ring
+    out = hvd.allgather(big[r], name=f"ag{it}/big")
+    check(out, np.concatenate(big), f"ag-big it{it}")
+
+probe_every = int(os.environ.get("HVDTPU_GRADCHECK_SAMPLE", "64"))
+if probe_every == 1 and n > 1:
+    parsed = hvd.metrics()
+    probes = sample_value(parsed, "hvdtpu_gradcheck_probes_total")
+    assert probes and probes > 0, f"no divergence probes ran: {probes}"
+    if r == 0:
+        div = hvd.grad_report()["divergence_total"]
+        assert div == 0, f"healthy world convicted: divergence_total={div}"
+
+print(f"rsag_worker rank {r}/{n} comp={comp}: ALL OK", flush=True)
+hvd.shutdown()

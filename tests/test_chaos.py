@@ -39,7 +39,8 @@ def _harness():
 
 
 def _run(scenario, algo="ring", transport="tcp", hier="0",
-         compression="none", seed=None, batches=8, attempts=2):
+         compression="none", op="allreduce", seed=None, batches=8,
+         attempts=2):
     """Run one chaos scenario; retry once (fresh seed) on failure. Chaos
     scenarios assert wall-clock recovery budgets, so a loaded CI box can
     flake a single run — a SECOND independent failure is a real defect,
@@ -50,7 +51,7 @@ def _run(scenario, algo="ring", transport="tcp", hier="0",
     for attempt in range(attempts):
         rng = random.Random(base + attempt * 7919)
         last = h.run_scenario(scenario, algo, transport, hier, compression,
-                              np_=4, batches=batches, rng=rng)
+                              np_=4, batches=batches, rng=rng, op=op)
         if last["ok"]:  # per-scenario budgets are enforced inside
             return last
     return last
@@ -105,6 +106,22 @@ def test_chaos_kill_matrix(algo, transport, hier, compression):
     res = _run("kill", algo=algo, transport=transport, hier=hier,
                compression=compression,
                seed=hash((algo, transport, hier, compression)) & 0xFFFF)
+    assert res["ok"], res
+    assert res["worst_recovery_s"] < 2.0, res
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("op", ["reducescatter", "allgather"])
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+@pytest.mark.parametrize("compression", ["none", "int4"])
+def test_chaos_kill_new_ops(op, transport, compression):
+    """The kill matrix extends to the first-class reduce-scatter and
+    allgather schedules (PR 18): a SIGKILL mid-op recovers with the same
+    sub-2 s budget and the worker's per-op correctness oracle (exact
+    chunk / gathered values through the failure). RS/AG run one fixed
+    schedule so algo/hier stay pinned at ring/flat."""
+    res = _run("kill", transport=transport, compression=compression, op=op,
+               seed=hash((op, transport, compression)) & 0xFFFF)
     assert res["ok"], res
     assert res["worst_recovery_s"] < 2.0, res
 

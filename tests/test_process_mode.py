@@ -107,6 +107,50 @@ def test_npo2_world_bitwise_large(algo, n):
     _npo2_world(n, algo, "0")
 
 
+# First-class reduce-scatter & allgather (docs/collectives.md): every
+# transport x wire-compression cell, with w3 covering the non-power-of-two
+# chunking (ragged RS chunks, uneven AG blocks). The divergence probe
+# (HVDTPU_GRADCHECK_SAMPLE=1) asserts the bitwise cross-rank invariant on
+# the gathered outputs — under compression that is the quantize-once
+# owner-code guarantee, the op-level claim this PR ships.
+def _rsag_world(n, shm, comp, timeout=240):
+    results = _launch_world(
+        n, os.path.join(REPO, "tests", "data", "rsag_worker.py"),
+        extra_env={
+            "TEST_RSAG_ITERS": "2",
+            "HVDTPU_SHM": shm,
+            "HVDTPU_COMPRESSION": comp,
+            "HVDTPU_COMPRESSION_MIN_BYTES": "0",
+            "HVDTPU_COMPRESSION_SKIP_REGEX": "",
+            "HVDTPU_GRADCHECK_SAMPLE": "1",
+        },
+        timeout=timeout)
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
+@pytest.mark.parametrize("comp", ["none", "fp16", "int8", "int4"])
+@pytest.mark.parametrize("shm", ["0", "1"])
+def test_reducescatter_allgather_matrix(shm, comp):
+    """w2: the full {tcp,shm} x {none,fp16,int8,int4} cell matrix."""
+    _rsag_world(2, shm, comp)
+
+
+@pytest.mark.parametrize("comp", ["none", "int4"])
+def test_reducescatter_allgather_npo2(comp):
+    """w3 (non-power-of-two): ragged chunk starts on the RS rotation and
+    uneven negotiated blocks on the AG, dense and heaviest-quantized."""
+    _rsag_world(3, "0", comp)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("comp", ["none", "fp16", "int8", "int4"])
+def test_reducescatter_allgather_npo2_large(comp):
+    """w5 over TCP: prime-world chunking across every wire mode."""
+    _rsag_world(5, "0", comp, timeout=360)
+
+
 @pytest.mark.parametrize("shm", ["1", "0"])
 def test_shm_transport_toggle(shm):
     """The whole collective menu stays correct over the shared-memory lanes

@@ -216,3 +216,40 @@ class TestShardedOptimizer:
     def test_invalid_op_rejected(self):
         with pytest.raises(ValueError, match="Average or Sum"):
             hvd.ShardedDistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum)
+
+
+class TestZero1ProcessMode:
+    """4-rank ZeRO-1 acceptance over the native data plane: the eager
+    sharded update drives the first-class reduce-scatter + allgather, the
+    hvdtpu_optimizer_state_bytes gauge proves the 1/world footprint, and
+    one step's wire bytes match one ring allreduce of the fused vector
+    (docs/optimizer.md "Sharded optimizer state")."""
+
+    @pytest.mark.parametrize("n", [4])
+    def test_zero1_acceptance(self, n):
+        import os
+
+        from conftest import launch_world
+
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "data", "zero1_worker.py")
+        # One retry (the test_chaos pattern): 4 ranks share one CI core, so
+        # a starved rank can trip the peer-liveness deadline and read as a
+        # false peer death. The widened read deadline absorbs most of it;
+        # the retry covers the rest. Assertion failures never retry.
+        for attempt in range(2):
+            results = launch_world(n, worker,
+                                   extra_env={
+                                       "HVDTPU_ALLREDUCE_ALGO": "ring",
+                                       "HVDTPU_READ_DEADLINE_SECONDS": "60",
+                                       "TEST_ZERO1_STEPS": "5",
+                                   },
+                                   timeout=240)
+            load_flaked = any(rc != 0 and "liveness deadline" in (err + out)
+                              for rc, out, err in results)
+            if load_flaked and attempt == 0:
+                continue
+            break
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+            assert "ALL OK" in out
