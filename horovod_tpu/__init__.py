@@ -25,7 +25,7 @@ from .runtime import (init, shutdown, is_initialized, rank, size, local_rank,
 # Collectives (reference: horovod/torch/mpi_ops.py).
 from .ops.collectives import (
     ReduceOp, Average, Sum, Adasum, Min, Max, Product,
-    allreduce, allreduce_async, grouped_allreduce,
+    allreduce, allreduce_async, grouped_allreduce, grouped_enqueue,
     allgather, allgather_async, broadcast, broadcast_async,
     alltoall, alltoall_async, reducescatter, join, poll, synchronize,
     release_handle, hierarchical_allreduce_p, hierarchical_allgather_p,

@@ -103,6 +103,28 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
             ctypes.c_char_p, ctypes.c_int]
+    if hasattr(lib, "hvdtpu_enqueue_broadcast"):  # older libs lack it
+        lib.hvdtpu_enqueue_broadcast.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    if hasattr(lib, "hvdtpu_enqueue_alltoall"):  # older libs lack it
+        lib.hvdtpu_enqueue_alltoall.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_alltoall.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int]
+    if hasattr(lib, "hvdtpu_group_begin"):  # older libs lack it
+        lib.hvdtpu_group_begin.restype = None
+        lib.hvdtpu_group_begin.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_group_end.restype = None
+        lib.hvdtpu_group_end.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "hvdtpu_set_bcast_tuning"):  # older libs lack it
+        lib.hvdtpu_set_bcast_tuning.restype = ctypes.c_int
+        lib.hvdtpu_set_bcast_tuning.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_longlong]
     if hasattr(lib, "hvdtpu_set_optimizer_state_bytes"):
         lib.hvdtpu_set_optimizer_state_bytes.restype = ctypes.c_int
         lib.hvdtpu_set_optimizer_state_bytes.argtypes = [
@@ -468,6 +490,12 @@ class NativeCore:
         if hasattr(self._lib, "hvdtpu_set_scale_tuning"):
             self._lib.hvdtpu_set_scale_tuning(self._core, sa_group,
                                               ctrl_batch)
+        # Broadcast schedule floor (native/data_plane.h): payloads at or
+        # below this ride the flat root-fanout, larger ones the binomial
+        # tree. < 0 keeps the native default.
+        if hasattr(self._lib, "hvdtpu_set_bcast_tuning"):
+            self._lib.hvdtpu_set_bcast_tuning(
+                self._core, ev.get_int(ev.HVDTPU_BCAST_FLAT_MAX, -1))
         # Transport subsystem (native/transport.h): same-host rank pairs ride
         # POSIX shared-memory ring lanes unless HVDTPU_SHM=0; the two-level
         # allreduce (HVDTPU_ALLREDUCE_HIER) defaults to autotuner-owned auto.
@@ -631,6 +659,22 @@ class NativeCore:
 
     # -- collectives -------------------------------------------------------
 
+    def group_begin(self) -> None:
+        """Open a grouped-collective window (docs/collectives.md "Grouped
+        enqueue"): until :meth:`group_end`, enqueued ops park in the
+        pending queue without being drained by the background cycle, so
+        the whole group negotiates in ONE READY/RESPONSES round (and
+        same-op/dtype lists fuse into one execution). No-op on an older
+        library without the symbol."""
+        if self._core and hasattr(self._lib, "hvdtpu_group_begin"):
+            self._lib.hvdtpu_group_begin(self._core)
+
+    def group_end(self) -> None:
+        """Close the grouped window and wake the background loop; the
+        parked group drains into the next cycle together."""
+        if self._core and hasattr(self._lib, "hvdtpu_group_end"):
+            self._lib.hvdtpu_group_end(self._core)
+
     def enqueue(self, kind: str, name: str, arr: np.ndarray, op: int = 1,
                 prescale: float = 1.0, postscale: float = 1.0,
                 root_rank: int = 0, splits=None) -> int:
@@ -663,6 +707,22 @@ class NativeCore:
             handle = self._lib.hvdtpu_enqueue_allgather(
                 self._core, name.encode(), dtype_code, shape, arr.ndim,
                 arr.ctypes.data_as(ctypes.c_void_p), err, len(err))
+        elif (kind == "broadcast"
+                and hasattr(self._lib, "hvdtpu_enqueue_broadcast")
+                and splits is None
+                and prescale == 1.0 and postscale == 1.0):
+            handle = self._lib.hvdtpu_enqueue_broadcast(
+                self._core, name.encode(), dtype_code, shape, arr.ndim,
+                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                err, len(err))
+        elif (kind == "alltoall"
+                and hasattr(self._lib, "hvdtpu_enqueue_alltoall")
+                and root_rank == 0
+                and prescale == 1.0 and postscale == 1.0):
+            handle = self._lib.hvdtpu_enqueue_alltoall(
+                self._core, name.encode(), dtype_code, shape, arr.ndim,
+                arr.ctypes.data_as(ctypes.c_void_p), splits_ptr, nsplits,
+                err, len(err))
         else:
             handle = self._lib.hvdtpu_enqueue(
                 self._core, name.encode(), _OP_TYPES[kind], op, dtype_code,

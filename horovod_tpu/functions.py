@@ -34,6 +34,9 @@ def broadcast_object(obj: Any = None, root_rank: int = 0,
     (reference: ``horovod/torch/functions.py:186``)."""
     name = name or "broadcast_object"
     if runtime.mode() == "process" and runtime.size() > 1:
+        # Rides the native broadcast (PR 19 binomial tree / flat fanout).
+        # The two rounds stay sequential by necessity: non-roots cannot
+        # size the payload buffer until the size broadcast lands.
         payload = _serialize(obj) if runtime.rank() == root_rank else \
             np.zeros(0, dtype=np.uint8)
         sz = np.array([payload.size], dtype=np.int64)
@@ -51,10 +54,16 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> list:
     (reference: ``horovod/torch/functions.py:229``)."""
     name = name or "allgather_object"
     if runtime.mode() == "process" and runtime.size() > 1:
+        # Payload and size gathers are independent — enqueue both in one
+        # grouped window (PR 19) so they share a single READY/RESPONSES
+        # negotiation round instead of two blocking round-trips.
         payload = _serialize(obj)
-        gathered = np.asarray(C.allgather(payload, name=name))
-        sizes = np.asarray(C.allgather(
-            np.array([payload.size], dtype=np.int64), name=f"{name}.sz"))
+        with C.grouped_enqueue():
+            h_pay = C.allgather_async(payload, name=name)
+            h_sz = C.allgather_async(
+                np.array([payload.size], dtype=np.int64), name=f"{name}.sz")
+        gathered = np.asarray(C.synchronize(h_pay))
+        sizes = np.asarray(C.synchronize(h_sz))
         out, off = [], 0
         for s in sizes.tolist():
             out.append(_deserialize(gathered[off:off + int(s)]))

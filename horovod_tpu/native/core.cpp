@@ -410,6 +410,11 @@ struct CoreConfig {
   // control-plane frames into one vectored send per peer.
   int64_t allreduce_sa_group = -1;
   int32_t ctrl_batch = 1;
+  // Broadcast flat/tree crossover (HVDTPU_BCAST_FLAT_MAX; data_plane.h):
+  // payloads at or below this many bytes take the flat root-fanout, larger
+  // ones the binomial tree. < 0 keeps the data-plane default, 0 forces the
+  // tree for every size.
+  int64_t bcast_flat_max = -1;
   // Transport subsystem (HVDTPU_SHM / HVDTPU_SHM_RING_BYTES /
   // HVDTPU_ALLREDUCE_HIER; data_plane.h). shm defaults on — same-host pairs
   // negotiate shared-memory lanes at Connect and fall back to TCP when
@@ -467,6 +472,16 @@ class Core {
 
   // Returns handle >= 0, or Status error via *status.
   int64_t Enqueue(TensorEntry entry, Status* status) EXCLUDES(mu_);
+  // Grouped-collective enqueue window (hvd.grouped_* batched negotiation):
+  // between GroupBegin and GroupEnd, enqueued entries are withheld from the
+  // control-plane announcement drain, so the whole group lands in ONE
+  // background cycle once released — one READY frame up, one RESPONSES
+  // frame down for N tensors (the READY/RESPONSES frames already
+  // name-coalesce per cycle), and the coordinator's fusion lookahead sees
+  // every member at once. The caller MUST pair Begin with End (the Python
+  // context manager guarantees it); waiting on a held handle would hang.
+  void GroupBegin() EXCLUDES(mu_);
+  void GroupEnd() EXCLUDES(mu_);
   Status WaitHandle(int64_t handle) EXCLUDES(mu_);
   int PollHandle(int64_t handle) EXCLUDES(mu_);
   int64_t ResultBytes(int64_t handle) EXCLUDES(mu_);
@@ -722,6 +737,9 @@ class Core {
   CondVar cv_;                                 // completion + enqueue signal
   // enqueued, not yet announced
   std::deque<TensorEntry*> pending_ GUARDED_BY(mu_);
+  // Grouped-enqueue hold (GroupBegin/GroupEnd): while true, pending_ stays
+  // queued so the whole group announces in one cycle.
+  bool group_hold_ GUARDED_BY(mu_) = false;
   // by name
   std::unordered_map<std::string, TensorEntry*> outstanding_ GUARDED_BY(mu_);
   std::unordered_map<int64_t, TensorEntry*> handles_ GUARDED_BY(mu_);
@@ -1435,6 +1453,7 @@ Status Core::Start() {
   data_plane_.set_crossover_bytes(cfg_.allreduce_crossover);
   data_plane_.set_segment_bytes(cfg_.allreduce_segment);
   data_plane_.set_sa_min_group(cfg_.allreduce_sa_group);
+  data_plane_.set_bcast_flat_max(cfg_.bcast_flat_max);
   data_plane_.set_shm_enabled(cfg_.shm_enabled != 0);
   data_plane_.set_shm_ring_bytes(cfg_.shm_ring_bytes);
   data_plane_.set_hier_mode(static_cast<HierMode>(cfg_.allreduce_hier));
@@ -1880,6 +1899,19 @@ int64_t Core::Enqueue(TensorEntry entry, Status* status) {
   return h;
 }
 
+void Core::GroupBegin() {
+  MutexLock lk(mu_);
+  group_hold_ = true;
+}
+
+void Core::GroupEnd() {
+  {
+    MutexLock lk(mu_);
+    group_hold_ = false;
+  }
+  Wake();  // release the whole group into the next announcement cycle
+}
+
 Status Core::WaitHandle(int64_t handle) {
   MutexLock lk(mu_);
   while (done_.count(handle) == 0 && !shutdown_.load()) cv_.Wait(lk);
@@ -2068,7 +2100,9 @@ void Core::PumpControlPlane() {
   bool announce_join = false;
   {
     MutexLock lk(mu_);
-    while (!pending_.empty()) {
+    // A grouped-enqueue window is open: leave the queue intact so the whole
+    // group announces together in the cycle after GroupEnd releases it.
+    while (!group_hold_ && !pending_.empty()) {
       TensorEntry* e = pending_.front();
       pending_.pop_front();
       Request q;
@@ -2700,17 +2734,26 @@ void Core::CoordinatorEmitResponses() {
       cache_.Erase(name);
     }
     if (resp.type == ResponseType::OK &&
-        resp.op_type == OpType::ALLREDUCE) {
+        (resp.op_type == OpType::ALLREDUCE ||
+         resp.op_type == OpType::BROADCAST)) {
       int64_t fused_bytes =
           NumElements(resp.shapes[0]) *
           static_cast<int64_t>(DataTypeSize(resp.dtype));
       // Look ahead over the remaining ready names for fusable partners.
+      // Broadcasts fuse too (PR 19, the grouped-enqueue payoff): same dtype
+      // AND same root — the fused batch packs into one buffer and ships as
+      // ONE tree broadcast (shapes may differ; they're independent
+      // tensors). Alltoalls stay per-tensor: each carries its own split
+      // matrix and packing them would serialize nothing the pairwise
+      // schedule doesn't already overlap.
       for (auto it = ready_names_.begin(); it != ready_names_.end();) {
         Response peek = BuildResponse(*it);
         bool fusable =
             peek.type == ResponseType::OK &&
-            peek.op_type == OpType::ALLREDUCE &&
-            peek.dtype == resp.dtype && peek.reduce_op == resp.reduce_op;
+            peek.op_type == resp.op_type &&
+            peek.dtype == resp.dtype && peek.reduce_op == resp.reduce_op &&
+            (resp.op_type != OpType::BROADCAST ||
+             peek.root_rank == resp.root_rank);
         if (fusable) {
           int64_t extra = NumElements(peek.shapes[0]) *
                           static_cast<int64_t>(DataTypeSize(peek.dtype));
@@ -2910,11 +2953,11 @@ void Core::ExecuteResponse(const Response& resp) {
   if (resp.op_type == OpType::ALLREDUCE && data_plane_.hier_active()) {
     lane += "+hier";
   }
-  // Allreduce, reduce-scatter and allgather all carry the wire-compression
-  // dimension (EffectiveCompression returns NONE for the rest).
-  const bool comp_capable = resp.op_type == OpType::ALLREDUCE ||
-                            resp.op_type == OpType::REDUCESCATTER ||
-                            resp.op_type == OpType::ALLGATHER;
+  // Every data-moving op carries the wire-compression dimension now that
+  // broadcast ships quantize-once root codes and alltoall quantizes each
+  // block for its single receiver (PR 19); EffectiveCompression still
+  // returns NONE for JOIN and for non-fp32 payloads.
+  const bool comp_capable = resp.op_type != OpType::JOIN;
   if (comp_capable) comp = EffectiveCompression(resp, batch_bytes);
   const char* opname = resp.op_type == OpType::ALLREDUCE ? "ALLREDUCE"
                        : resp.op_type == OpType::ALLGATHER ? "ALLGATHER"
@@ -2999,14 +3042,70 @@ void Core::ExecuteResponse(const Response& resp) {
       break;
     }
     case OpType::BROADCAST: {
-      TensorEntry* e = entries[0];
-      e->output.resize(static_cast<size_t>(e->byte_size()));
-      if (cfg_.rank == resp.root_rank && e->input != nullptr) {
-        memcpy(e->output.data(), e->input, e->output.size());
+      // May carry multiple fused entries (grouped broadcast, PR 19): pack
+      // the batch into one buffer at the root, run ONE tree broadcast, and
+      // slice the result back out — the grouped-enqueue counterpart of
+      // ExecuteFusedAllreduce. The single-entry path broadcasts in place.
+      const bool grad_on =
+          gradstats_.enabled() && resp.dtype == DataType::FLOAT32;
+      // Compressed broadcast (PR 19): quantize-once at the root with
+      // self-decode — fp32 only (EffectiveCompression), no error-feedback
+      // residual (a broadcast payload is a value, not a gradient stream).
+      if (comp != WireCompression::NONE) {
+        data_plane_.BeginCompressedOp(comp, nullptr,
+                                      grad_on ? &grad_quality_ : nullptr);
       }
-      st = data_plane_.Broadcast(e->output.data(),
-                                 static_cast<int64_t>(e->output.size()),
-                                 resp.root_rank);
+      if (entries.size() == 1) {
+        TensorEntry* e = entries[0];
+        e->output.resize(static_cast<size_t>(e->byte_size()));
+        if (cfg_.rank == resp.root_rank && e->input != nullptr) {
+          memcpy(e->output.data(), e->input, e->output.size());
+        }
+        st = data_plane_.Broadcast(e->output.data(),
+                                   static_cast<int64_t>(e->output.size()),
+                                   resp.root_rank);
+        data_plane_.EndCompressedOp();
+        if (st.ok()) {
+          // Every rank holds bitwise-identical broadcast bytes (raw moves
+          // exact bytes; compressed decodes the root's codes verbatim) —
+          // the same PR-12 fingerprint invariant allgather rides.
+          MaybeGradcheck(e->name, e->output.data(),
+                         static_cast<int64_t>(e->output.size()));
+        }
+      } else {
+        ByteBuf packed(static_cast<size_t>(batch_bytes));
+        if (cfg_.rank == resp.root_rank) {
+          size_t off = 0;
+          for (auto* e : entries) {
+            const size_t n = static_cast<size_t>(e->byte_size());
+            if (e->input != nullptr) {
+              memcpy(packed.data() + off, e->input, n);
+            } else {
+              memset(packed.data() + off, 0, n);
+            }
+            off += n;
+          }
+        }
+        st = data_plane_.Broadcast(packed.data(), batch_bytes,
+                                   resp.root_rank);
+        data_plane_.EndCompressedOp();
+        if (st.ok()) {
+          MaybeGradcheck(entries[0]->name, packed.data(), batch_bytes);
+          size_t off = 0;
+          for (auto* e : entries) {
+            const size_t n = static_cast<size_t>(e->byte_size());
+            e->output.assign(packed.data() + off, packed.data() + off + n);
+            off += n;
+          }
+        }
+      }
+      if (st.ok() && grad_on && comp != WireCompression::NONE &&
+          cfg_.rank == resp.root_rank) {
+        // Only the root ran the quantizer; other ranks' accumulators are
+        // empty and would dilute the per-key quality baselines.
+        gradstats_.RecordQuality(gradstats_.KeySlot(entries[0]->name), comp,
+                                 grad_quality_);
+      }
       break;
     }
     case OpType::ALLTOALL: {
@@ -3025,9 +3124,37 @@ void Core::ExecuteResponse(const Response& resp) {
             resp.all_splits[static_cast<size_t>(r) * cfg_.size + cfg_.rank] *
             row_bytes;
       }
+      // Joined rank: no input buffer, but the negotiated split matrix says
+      // this rank sends nothing (its Request never existed), so a null
+      // input only backs zero-byte sends. Guard anyway: a zombie with
+      // nonzero sends must contribute zeros, not garbage.
+      std::vector<uint8_t> zero_input;
+      const void* src = e->input;
+      if (src == nullptr) {
+        zero_input.assign(static_cast<size_t>(e->byte_size()), 0);
+        src = zero_input.data();
+      }
+      // Compressed alltoall (PR 19): every block is quantized once at its
+      // sender and decoded at its single receiver — fp32 only, no residual
+      // (routed activations are values, not gradient streams).
+      const bool grad_on =
+          gradstats_.enabled() && resp.dtype == DataType::FLOAT32;
+      if (comp != WireCompression::NONE) {
+        data_plane_.BeginCompressedOp(comp, nullptr,
+                                      grad_on ? &grad_quality_ : nullptr);
+      }
       ByteBuf out;
-      st = data_plane_.Alltoallv(e->input, send_bytes, recv_bytes, &out);
-      if (st.ok()) e->output = std::move(out);
+      st = data_plane_.Alltoallv(src, send_bytes, recv_bytes, &out);
+      data_plane_.EndCompressedOp();
+      if (st.ok()) {
+        if (grad_on && comp != WireCompression::NONE) {
+          gradstats_.RecordQuality(gradstats_.KeySlot(e->name), comp,
+                                   grad_quality_);
+        }
+        // NO MaybeGradcheck here: alltoall outputs legitimately differ per
+        // rank — fingerprint-comparing them would convict healthy ranks.
+        e->output = std::move(out);
+      }
       break;
     }
     case OpType::REDUCESCATTER: {
@@ -3083,9 +3210,10 @@ void Core::ExecuteResponse(const Response& resp) {
       break;
   }
 
-  // Reduce-scatter/allgather carry real algorithm + compression labels
-  // (PR 18) — same dimensions the allreduce baselines key on; broadcast/
-  // alltoall stay neutral so the op/transport/dtype breakdown aggregates.
+  // Every op carries real algorithm + compression labels now (PR 18 for
+  // reduce-scatter/allgather, PR 19 for broadcast's bcast_tree/bcast_flat
+  // and alltoall's pairwise) — the same dimensions the per-op perf
+  // baselines key on.
   if (!entries.empty()) {
     ObserveOp(opname, NowSeconds() - op_t0, entries[0]->byte_size(),
               comp_capable ? data_plane_.last_algo_label() : "none",
@@ -3097,15 +3225,17 @@ void Core::ExecuteResponse(const Response& resp) {
                     fr_t0, Timeline::SteadyAbsUs(), st.ok() ? 0 : 1, 0);
   if (!st.ok() && data_plane_.aborted()) HandleDataPlaneFailure(st);
 
-  // Reduce-scatter/allgather feed the cumulative raw/wire byte counters
-  // (their data-plane entry points reset + publish the per-op
-  // accumulators), so their timeline op-done events must carry the same
-  // figures — /metrics and the timeline tell one story
-  // (tests/data/metrics_worker.py pins sum(timeline) == counter).
-  // Broadcast/alltoall never reset the accumulators; passing them here
-  // would attribute the PREVIOUS op's bytes, so they stay omitted.
+  // Reduce-scatter/allgather (PR 18) and broadcast/alltoall (PR 19) all
+  // feed the cumulative raw/wire byte counters (their data-plane entry
+  // points reset + publish the per-op accumulators), so their timeline
+  // op-done events must carry the same figures — /metrics and the timeline
+  // tell one story (tests/data/metrics_worker.py pins sum(timeline) ==
+  // counter). Only JOIN (no data-plane entry) stays omitted; ALLREDUCE
+  // completes inside ExecuteFusedAllreduce, which meters its own.
   const bool byte_metered = resp.op_type == OpType::REDUCESCATTER ||
-                            resp.op_type == OpType::ALLGATHER;
+                            resp.op_type == OpType::ALLGATHER ||
+                            resp.op_type == OpType::BROADCAST ||
+                            resp.op_type == OpType::ALLTOALL;
   const int64_t done_raw = byte_metered ? data_plane_.op_raw_bytes() : -1;
   const int64_t done_wire = byte_metered ? data_plane_.op_wire_bytes() : -1;
   for (auto* e : entries) {
@@ -3190,17 +3320,16 @@ WireCompression Core::EffectiveCompression(const Response& resp,
     return WireCompression::NONE;
   }
   if (resp.dtype != DataType::FLOAT32) return WireCompression::NONE;
-  // The reducing ops (allreduce, reduce-scatter) and allgather all have
-  // compressed schedules (PR 18); broadcast/alltoall stay raw.
-  if (resp.op_type != OpType::ALLREDUCE &&
-      resp.op_type != OpType::REDUCESCATTER &&
-      resp.op_type != OpType::ALLGATHER) {
-    return WireCompression::NONE;
-  }
+  // Every data-moving op has a compressed schedule now: the reducing ops
+  // and allgather since PR 18, broadcast (quantize-once root codes) and
+  // alltoall (per-block sender codes) since PR 19. JOIN moves no data.
+  if (resp.op_type == OpType::JOIN) return WireCompression::NONE;
   // Adasum's adaptive combine needs the exact partials; MIN/MAX/PRODUCT
   // have no meaningful quantized-sum form. reduce_op is per-response (all
-  // fused entries share it); allgather carries no reduction to gate on.
-  if (resp.op_type != OpType::ALLGATHER &&
+  // fused entries share it); allgather/broadcast/alltoall carry no
+  // reduction to gate on.
+  if ((resp.op_type == OpType::ALLREDUCE ||
+       resp.op_type == OpType::REDUCESCATTER) &&
       resp.reduce_op != ReduceOp::SUM &&
       resp.reduce_op != ReduceOp::AVERAGE) {
     return WireCompression::NONE;
@@ -3776,6 +3905,43 @@ long long hvdtpu_enqueue_allgather(void* core, const char* name, int dtype,
                         ndim, data, 1.0, 1.0, 0, nullptr, 0, err, errlen);
 }
 
+// Broadcast / alltoall entry points (docs/collectives.md "Broadcast &
+// alltoall") — same thin-delegate pattern. Broadcast: data is the input on
+// the root and ignored elsewhere (shape must still agree; the result buffer
+// is what every rank reads back). Alltoall: splits is the caller's dim-0
+// send-split row, one entry per rank; nullptr means even 1/n splits.
+long long hvdtpu_enqueue_broadcast(void* core, const char* name, int dtype,
+                                   const long long* shape, int ndim,
+                                   const void* data, int root_rank, char* err,
+                                   int errlen) {
+  return hvdtpu_enqueue(core, name,
+                        static_cast<int>(hvdtpu::OpType::BROADCAST),
+                        static_cast<int>(hvdtpu::ReduceOp::SUM), dtype, shape,
+                        ndim, data, 1.0, 1.0, root_rank, nullptr, 0, err,
+                        errlen);
+}
+
+long long hvdtpu_enqueue_alltoall(void* core, const char* name, int dtype,
+                                  const long long* shape, int ndim,
+                                  const void* data, const int* splits,
+                                  int nsplits, char* err, int errlen) {
+  return hvdtpu_enqueue(core, name,
+                        static_cast<int>(hvdtpu::OpType::ALLTOALL),
+                        static_cast<int>(hvdtpu::ReduceOp::SUM), dtype, shape,
+                        ndim, data, 1.0, 1.0, 0, splits, nsplits, err,
+                        errlen);
+}
+
+// Grouped-collective window (docs/collectives.md "Grouped enqueue"):
+// between begin/end, Enqueue() parks requests without letting the
+// background cycle drain them, so the whole group rides one READY /
+// RESPONSES round (and, for same-op/dtype lists, one fused execution).
+void hvdtpu_group_begin(void* core) {
+  static_cast<Core*>(core)->GroupBegin();
+}
+
+void hvdtpu_group_end(void* core) { static_cast<Core*>(core)->GroupEnd(); }
+
 int hvdtpu_wait(void* core, long long handle, char* err, int errlen) {
   Status st = static_cast<Core*>(core)->WaitHandle(handle);
   FillErr(st, err, errlen);
@@ -3848,6 +4014,17 @@ int hvdtpu_set_scale_tuning(void* core, long long sa_group, int ctrl_batch) {
   hvdtpu::CoreConfig* cfg = static_cast<Core*>(core)->mutable_config();
   cfg->allreduce_sa_group = sa_group;
   cfg->ctrl_batch = ctrl_batch;
+  return 0;
+}
+
+// Broadcast schedule floor (docs/collectives.md "Broadcast & alltoall"):
+// payloads at or under flat_max_bytes use the flat root-sends-to-all
+// schedule (one hop of latency); larger ones take the binomial tree
+// (ceil(log2 n) depth, n-1 total sends either way). < 0 keeps the
+// default (HVDTPU_BCAST_FLAT_MAX). Pre-Start() only.
+int hvdtpu_set_bcast_tuning(void* core, long long flat_max_bytes) {
+  static_cast<Core*>(core)->mutable_config()->bcast_flat_max =
+      flat_max_bytes;
   return 0;
 }
 

@@ -2030,22 +2030,189 @@ Status DataPlane::CompressedRingAllgatherv(
   return Status::OK();
 }
 
+Status DataPlane::BinomialBroadcastSchedule(void* buf, int64_t wire_bytes,
+                                            int64_t raw_per_send, int root) {
+  // MPICH binomial schedule on virtual ranks (vr 0 = root): receive from
+  // vr minus its lowest set bit, then forward down the descending masks —
+  // every rank is live after ⌈log2 n⌉ rounds and forwards at most that many
+  // copies, vs the flat root shipping n-1 serialized full payloads.
+  const int vr = (rank_ - root + size_) % size_;
+  int mask = 1;
+  while (mask < size_) {
+    if (vr & mask) {
+      const int src = (rank_ - mask + size_) % size_;
+      Status st = RecvFrom(src, buf, wire_bytes, "broadcast recv");
+      if (!st.ok()) return st;
+      break;
+    }
+    mask <<= 1;
+  }
+  mask >>= 1;
+  while (mask > 0) {
+    if (vr + mask < size_) {
+      const int dst = (rank_ + mask) % size_;
+      AddOpBytes(raw_per_send, wire_bytes);
+      Status st = SendTo(dst, buf, wire_bytes, "broadcast send");
+      if (!st.ok()) return st;
+    }
+    mask >>= 1;
+  }
+  return Status::OK();
+}
+
+Status DataPlane::FlatBroadcastSchedule(void* buf, int64_t wire_bytes,
+                                        int64_t raw_per_send, int root) {
+  if (rank_ == root) {
+    for (int r = 0; r < size_; ++r) {
+      if (r == rank_) continue;
+      AddOpBytes(raw_per_send, wire_bytes);
+      Status st = SendTo(r, buf, wire_bytes, "broadcast send");
+      if (!st.ok()) return st;
+    }
+    return Status::OK();
+  }
+  return RecvFrom(root, buf, wire_bytes, "broadcast recv");
+}
+
+Status DataPlane::CompressedBroadcast(float* data, int64_t count, int root,
+                                      bool flat) {
+  // Quantize ONCE at the root with self-decode (the PR-18 owner-codes
+  // pattern; no error-feedback residual — a broadcast payload is a value,
+  // not a gradient stream), forward the codes verbatim, decode everywhere:
+  // every rank ends up decoding the identical byte stream, so the broadcast
+  // is bitwise identical world-wide even under int4.
+  const WireCompression c = op_comp_;
+  const int64_t raw = count * static_cast<int64_t>(sizeof(float));
+  std::vector<uint8_t> codes(static_cast<size_t>(WireBytes(c, count)));
+  if (rank_ == root) {
+    const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireCompress(c, data, count, codes.data(), nullptr, data, op_quality_);
+    }
+    TraceHop("QUANTIZE", -1, -1, raw, qt0, io_ctl_.WaitUs());
+  }
+  Status st =
+      flat ? FlatBroadcastSchedule(codes.data(), WireBytes(c, count), raw,
+                                   root)
+           : BinomialBroadcastSchedule(codes.data(), WireBytes(c, count), raw,
+                                       root);
+  if (!st.ok()) return st;
+  if (rank_ != root) {
+    // Decode AFTER the forwards: children must see the owner's codes
+    // verbatim, never a re-quantization of this rank's decoded copy.
+    const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireDecompress(c, codes.data(), count, data);
+    }
+    TraceHop("DEQUANTIZE", -1, -1, raw, dt0, io_ctl_.WaitUs());
+  }
+  return Status::OK();
+}
+
 Status DataPlane::Broadcast(void* data, int64_t bytes, int root) {
+  op_raw_bytes_ = 0;
+  op_wire_bytes_ = 0;
+  last_algo_label_ = "none";
   trace_op_ = false;
   if (size_ == 1 || bytes == 0) {
     ResetOpPhaseAccum();  // ObserveOp reads the accumulators regardless
     return Status::OK();
   }
   BeginOpTrace();
-  if (rank_ == root) {
-    for (int r = 0; r < size_; ++r) {
-      if (r == rank_) continue;
-      Status st = SendTo(r, data, bytes, "broadcast send");
-      if (!st.ok()) return st;
-    }
+  MaybeChaosOp();
+  // Latency floor: at or below bcast_flat_max_ the root's n-1 direct sends
+  // beat the tree's serialized store-and-forward rounds (one hop of depth
+  // per peer vs ⌈log2 n⌉ handoffs of a payload too small to pipeline).
+  const bool flat = bytes <= bcast_flat_max_;
+  Status st;
+  if (op_comp_ != WireCompression::NONE) {
+    // The core arms compression for fp32 payloads only (EffectiveCompression),
+    // so the element count is exact.
+    last_algo_label_ = flat ? "bcast_flat" : "bcast_tree";
+    st = CompressedBroadcast(static_cast<float*>(data),
+                             bytes / static_cast<int64_t>(sizeof(float)),
+                             root, flat);
+  } else if (flat) {
+    last_algo_label_ = "bcast_flat";
+    st = FlatBroadcastSchedule(data, bytes, bytes, root);
   } else {
-    Status st = RecvFrom(root, data, bytes, "broadcast recv");
+    last_algo_label_ = "bcast_tree";
+    st = BinomialBroadcastSchedule(data, bytes, bytes, root);
+  }
+  raw_bytes_total_->Add(op_raw_bytes_);
+  wire_bytes_total_->Add(op_wire_bytes_);
+  PublishZeroCopyCounters();
+  if (corrupt_pending_ && st.ok()) {
+    // Seeded SDC (HVDTPU_CHAOS corrupt@op=N): flip one byte of this rank's
+    // broadcast output — the divergence probe fingerprints broadcast
+    // results too (every rank holds bitwise-identical bytes).
+    corrupt_pending_ = false;
+    static_cast<uint8_t*>(data)[0] ^= 0x01;
+  }
+  return st;
+}
+
+Status DataPlane::CompressedAlltoallv(const float* in,
+                                      const std::vector<int64_t>& send_off,
+                                      const std::vector<int64_t>& recv_off,
+                                      uint8_t* out) {
+  // Every block travels exactly one hop, so the sender quantizes it once
+  // for its single receiver — no forwarding discipline needed for
+  // determinism. The self block rides the same quantize/self-decode
+  // roundtrip (straight into `out`), so every block a rank holds is
+  // uniformly lossy: symmetric inputs still produce world-bitwise outputs.
+  const WireCompression c = op_comp_;
+  const int64_t felem = static_cast<int64_t>(sizeof(float));
+  auto scount = [&](int r) { return (send_off[r + 1] - send_off[r]) / felem; };
+  auto rcount = [&](int r) { return (recv_off[r + 1] - recv_off[r]) / felem; };
+  int64_t max_count = 0;
+  for (int r = 0; r < size_; ++r) {
+    max_count = std::max(max_count, std::max(scount(r), rcount(r)));
+  }
+  std::vector<uint8_t> scodes(static_cast<size_t>(WireBytes(c, max_count)));
+  std::vector<uint8_t> rcodes(scodes.size());
+  if (scount(rank_) > 0) {
+    const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+    {
+      ProfPhaseScope prof_codec(PerfPhase::CODEC);
+      WireCompress(c, in + send_off[rank_] / felem, scount(rank_),
+                   scodes.data(), nullptr,
+                   reinterpret_cast<float*>(out + recv_off[rank_]),
+                   op_quality_);
+    }
+    TraceHop("QUANTIZE", -1, -1, scount(rank_) * felem, qt0,
+             io_ctl_.WaitUs());
+  }
+  for (int k = 1; k < size_; ++k) {
+    const int to = (rank_ + k) % size_;
+    const int from = (rank_ - k + size_) % size_;
+    const int64_t sw = WireBytes(c, scount(to));
+    const int64_t rw = WireBytes(c, rcount(from));
+    if (scount(to) > 0) {
+      const int64_t qt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+      {
+        ProfPhaseScope prof_codec(PerfPhase::CODEC);
+        WireCompress(c, in + send_off[to] / felem, scount(to), scodes.data(),
+                     nullptr, nullptr, op_quality_);
+      }
+      TraceHop("QUANTIZE", -1, -1, scount(to) * felem, qt0, io_ctl_.WaitUs());
+    }
+    AddOpBytes(scount(to) * felem, scount(to) > 0 ? sw : 0);
+    Status st = Exchange(to, scodes.data(), scount(to) > 0 ? sw : 0, from,
+                         rcodes.data(), rcount(from) > 0 ? rw : 0);
     if (!st.ok()) return st;
+    if (rcount(from) > 0) {
+      const int64_t dt0 = rec_hops_ ? Timeline::SteadyAbsUs() : 0;
+      {
+        ProfPhaseScope prof_codec(PerfPhase::CODEC);
+        WireDecompress(c, rcodes.data(), rcount(from),
+                       reinterpret_cast<float*>(out + recv_off[from]));
+      }
+      TraceHop("DEQUANTIZE", -1, -1, rcount(from) * felem, dt0,
+               io_ctl_.WaitUs());
+    }
   }
   return Status::OK();
 }
@@ -2054,7 +2221,10 @@ Status DataPlane::Alltoallv(const void* in,
                             const std::vector<int64_t>& send_bytes,
                             const std::vector<int64_t>& recv_bytes,
                             ByteBuf* out) {
-  BeginOpTrace();
+  op_raw_bytes_ = 0;
+  op_wire_bytes_ = 0;
+  last_algo_label_ = "none";
+  trace_op_ = false;
   std::vector<int64_t> send_off(size_ + 1, 0), recv_off(size_ + 1, 0);
   for (int r = 0; r < size_; ++r) {
     send_off[r + 1] = send_off[r] + send_bytes[r];
@@ -2062,16 +2232,41 @@ Status DataPlane::Alltoallv(const void* in,
   }
   out->resize(static_cast<size_t>(recv_off[size_]));
   const uint8_t* src = static_cast<const uint8_t*>(in);
-  memcpy(out->data() + recv_off[rank_], src + send_off[rank_],
-         static_cast<size_t>(send_bytes[rank_]));
-  for (int k = 1; k < size_; ++k) {
-    int to = (rank_ + k) % size_;
-    int from = (rank_ - k + size_) % size_;
-    Status st = Exchange(to, src + send_off[to], send_bytes[to], from,
-                         out->data() + recv_off[from], recv_bytes[from]);
-    if (!st.ok()) return st;
+  if (size_ == 1) {
+    memcpy(out->data(), src + send_off[rank_],
+           static_cast<size_t>(send_bytes[rank_]));
+    ResetOpPhaseAccum();  // ObserveOp reads the accumulators regardless
+    return Status::OK();
   }
-  return Status::OK();
+  BeginOpTrace();
+  MaybeChaosOp();
+  last_algo_label_ = "pairwise";
+  Status st;
+  if (op_comp_ != WireCompression::NONE) {
+    st = CompressedAlltoallv(reinterpret_cast<const float*>(in), send_off,
+                             recv_off, out->data());
+  } else {
+    memcpy(out->data() + recv_off[rank_], src + send_off[rank_],
+           static_cast<size_t>(send_bytes[rank_]));
+    st = Status::OK();
+    for (int k = 1; k < size_; ++k) {
+      int to = (rank_ + k) % size_;
+      int from = (rank_ - k + size_) % size_;
+      AddOpBytes(send_bytes[to], send_bytes[to]);
+      st = Exchange(to, src + send_off[to], send_bytes[to], from,
+                    out->data() + recv_off[from], recv_bytes[from]);
+      if (!st.ok()) break;
+    }
+  }
+  raw_bytes_total_->Add(op_raw_bytes_);
+  wire_bytes_total_->Add(op_wire_bytes_);
+  PublishZeroCopyCounters();
+  if (corrupt_pending_ && st.ok() && !out->empty()) {
+    // Seeded SDC in this rank's routed output (docs/numerics.md).
+    corrupt_pending_ = false;
+    out->data()[0] ^= 0x01;
+  }
+  return st;
 }
 
 namespace {

@@ -69,6 +69,12 @@ constexpr int64_t kDefaultSegmentBytes = 1 << 20;
 // oversubscribed small worlds do better on the ring's two-lane schedule.
 // Override with HVDTPU_ALLREDUCE_SA_GROUP (0 = never auto-pick SA).
 constexpr int kDefaultSaMinGroup = 16;
+// Broadcast latency floor: payloads at or below this ride the flat schedule
+// (root sends to every peer directly — one hop of depth per peer, no
+// store-and-forward handoff), larger ones the binomial tree (⌈log2 n⌉
+// serialized rounds, but each rank forwards at most ⌈log2 n⌉ copies instead
+// of the root shipping n-1). Override with HVDTPU_BCAST_FLAT_MAX.
+constexpr int64_t kDefaultBcastFlatMaxBytes = 4096;
 
 // Hierarchical two-level allreduce (HVDTPU_ALLREDUCE_HIER / hvdrun --hier):
 // intra-host ring reduce-scatter/allgather over the (shm) local lanes, one
@@ -180,6 +186,9 @@ class DataPlane {
   // per-cycle choice on top of the static gate, mirroring set_hier_auto.
   void set_sa_min_group(int64_t n) { if (n >= 0) sa_min_group_ = static_cast<int>(n); }
   void set_sa_auto(bool on) { sa_auto_ = on; }
+  // Broadcast flat/tree crossover (HVDTPU_BCAST_FLAT_MAX; 0 = always tree).
+  void set_bcast_flat_max(int64_t b) { if (b >= 0) bcast_flat_max_ = b; }
+  int64_t bcast_flat_max() const { return bcast_flat_max_; }
   AllreduceAlgo allreduce_algo() const { return algo_; }
   int64_t crossover_bytes() const { return crossover_bytes_; }
   int64_t segment_bytes() const { return segment_bytes_; }
@@ -329,10 +338,28 @@ class DataPlane {
                     const std::vector<int64_t>& block_bytes,
                     ByteBuf* out);
 
+  // First-class broadcast (PR 19): binomial tree from the root (MPICH
+  // schedule — depth ⌈log2 n⌉ vs the flat root-fanout's n-1 serialized
+  // sends) with a flat fallback at or below bcast_flat_max_ bytes. When the
+  // core armed wire compression (BeginCompressedOp; fp32 payloads only), the
+  // ROOT quantizes once with self-decode and every hop forwards the codes
+  // verbatim — all ranks decode identical codes, so the broadcast values are
+  // bitwise identical world-wide even under int4 (the PR-18 quantize-once
+  // pattern; no error-feedback residual — a broadcast payload is a value,
+  // not a gradient stream). Full op lifecycle like Allreduce: chaos trigger,
+  // cumulative byte counters, perf phases, algo label ("bcast_tree" /
+  // "bcast_flat").
   Status Broadcast(void* data, int64_t bytes, int root);
 
-  // Pairwise alltoallv: send_bytes[r] from my buffer to rank r (contiguous,
-  // in rank order); recv_bytes[r] received from rank r into out (rank order).
+  // First-class pairwise alltoallv (PR 19): send_bytes[r] from my buffer to
+  // rank r (contiguous, in rank order); recv_bytes[r] received from rank r
+  // into out (rank order). Step k exchanges with ranks (rank±k) — n-1
+  // full-duplex hops, every block travels exactly one hop, so uneven (MoE
+  // capacity-skew) splits cost only the bytes actually routed. Under wire
+  // compression each fp32 block is quantized once at its sender (the self
+  // block self-decodes through the same codec) and decoded at its one
+  // receiver — single-hop determinism needs no forwarding discipline. Full
+  // op lifecycle like Allreduce; algo label "pairwise".
   Status Alltoallv(const void* in, const std::vector<int64_t>& send_bytes,
                    const std::vector<int64_t>& recv_bytes,
                    ByteBuf* out);
@@ -511,6 +538,30 @@ class DataPlane {
                                   const std::vector<int64_t>& block_bytes,
                                   uint8_t* out);
 
+  // Broadcast internals (PR 19). Both schedules ship `wire_bytes` of `buf`
+  // from `root` to every rank; raw_per_send is the uncompressed-equivalent
+  // byte count each send accounts (== wire_bytes for raw payloads, the fp32
+  // size for forwarded codes). Binomial: MPICH vrank schedule — receive from
+  // parent (vrank minus its lowest set bit), forward to children on
+  // descending masks. Flat: root sends to each peer directly.
+  Status BinomialBroadcastSchedule(void* buf, int64_t wire_bytes,
+                                   int64_t raw_per_send, int root);
+  Status FlatBroadcastSchedule(void* buf, int64_t wire_bytes,
+                               int64_t raw_per_send, int root);
+  // Quantize-once-at-root broadcast: the root compresses `count` fp32
+  // elements ONCE (self-decoding its own copy), the chosen schedule forwards
+  // the codes verbatim, and every non-root rank decodes after its forwards
+  // complete — bitwise identity world-wide by construction.
+  Status CompressedBroadcast(float* data, int64_t count, int root, bool flat);
+  // Compressed pairwise alltoallv: each outgoing fp32 block is quantized
+  // once for its single receiver (the self block through the same
+  // quantize/self-decode roundtrip so every block in `out` is uniformly
+  // lossy), shipped as codes, and decoded on arrival.
+  Status CompressedAlltoallv(const float* in,
+                             const std::vector<int64_t>& send_off,
+                             const std::vector<int64_t>& recv_off,
+                             uint8_t* out);
+
   // Two-level path: intra-host ring reduce-scatter -> chunks gathered to the
   // host leader -> leaders run the flat algorithm over TCP -> chunks
   // scattered back -> intra-host ring allgather.
@@ -534,6 +585,7 @@ class DataPlane {
   int64_t segment_bytes_ = kDefaultSegmentBytes;
   int sa_min_group_ = kDefaultSaMinGroup;
   bool sa_auto_ = true;  // autotuner's SA-vs-ring pick under AUTO
+  int64_t bcast_flat_max_ = kDefaultBcastFlatMaxBytes;
   bool shm_enabled_ = true;
   int64_t shm_ring_bytes_ = 0;  // 0 = shm_transport.h kDefaultShmRingBytes
   std::string transport_label_ = "local";

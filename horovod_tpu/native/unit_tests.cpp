@@ -1893,6 +1893,218 @@ void TestDataPlaneAllgatherv() {
   }
 }
 
+// First-class broadcast (PR 19): worlds {2,3,5} (npo2 exercises the
+// binomial vrank rotation) x TCP/shm x {dense-flat,dense-tree,fp16,int8,
+// int4 tree + int4 flat}. Nonzero root. Dense results must be exact;
+// compressed results ride the root's quantize-once codes, so every rank
+// (root included, via self-decode) must hold BITWISE identical bytes even
+// though they are lossy vs the originals.
+void TestDataPlaneBroadcast() {
+  struct Arm {
+    WireCompression comp;
+    bool flat;
+  };
+  const Arm arms[] = {
+      {WireCompression::NONE, true}, {WireCompression::NONE, false},
+      {WireCompression::FP16, false}, {WireCompression::INT8, false},
+      {WireCompression::INT4, false}, {WireCompression::INT4, true},
+  };
+  for (bool shm : {false, true}) {
+    for (const Arm& arm : arms) {
+      for (int world : {2, 3, 5}) {
+        const int64_t n = 3001;
+        const int root = 1 % world;
+        TestWorld w =
+            MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+        for (int r = 0; r < world; ++r) {
+          w.planes[r]->set_segment_bytes(512);
+          w.planes[r]->set_shm_enabled(shm);
+          w.planes[r]->set_shm_ring_bytes(8192);
+          w.planes[r]->set_hier_mode(HierMode::OFF);
+          // Force the schedule: floor above the payload -> flat, 0 -> tree.
+          w.planes[r]->set_bcast_flat_max(arm.flat ? (int64_t{1} << 30) : 0);
+        }
+        std::vector<float> orig(n);
+        for (int64_t i = 0; i < n; ++i) {
+          orig[i] = 0.25f * static_cast<float>((i * 7 + 3) % 23 - 11);
+        }
+        double max_abs = 0.0;
+        for (float v : orig) {
+          max_abs = std::max(max_abs, static_cast<double>(std::fabs(v)));
+        }
+        const double tol =
+            (arm.comp == WireCompression::NONE   ? 0.0
+             : arm.comp == WireCompression::FP16 ? 2e-3
+             : arm.comp == WireCompression::INT8 ? 0.03
+                                                 : 0.4) *
+            std::max(max_abs, 1.0);
+        // Root starts from the payload; everyone else from poison.
+        std::vector<std::vector<float>> bufs(
+            world, std::vector<float>(n, -777.0f));
+        bufs[root] = orig;
+        std::atomic<int> bad{0};
+        std::vector<std::thread> threads;
+        for (int r = 0; r < world; ++r) {
+          threads.emplace_back([&, r] {
+            if (!w.planes[r]->Connect(w.peers).ok()) {
+              ++bad;
+              return;
+            }
+            if (arm.comp != WireCompression::NONE) {
+              w.planes[r]->BeginCompressedOp(arm.comp, nullptr);
+            }
+            Status st = w.planes[r]->Broadcast(bufs[r].data(), n * 4, root);
+            w.planes[r]->EndCompressedOp();
+            if (!st.ok()) ++bad;
+            if (std::strcmp(w.planes[r]->last_algo_label(),
+                            arm.flat ? "bcast_flat" : "bcast_tree") != 0) {
+              ++bad;
+            }
+            // Dense wire == raw; int4/int8 must actually shrink the wire.
+            if (arm.comp == WireCompression::NONE &&
+                w.planes[r]->op_wire_bytes() != w.planes[r]->op_raw_bytes()) {
+              ++bad;
+            }
+            if (r == root && arm.comp == WireCompression::INT4 &&
+                w.planes[r]->op_wire_bytes() * 2 >
+                    w.planes[r]->op_raw_bytes()) {
+              ++bad;
+            }
+          });
+        }
+        for (auto& t : threads) t.join();
+        for (int r = 0; r < world && bad == 0; ++r) {
+          // Bitwise vs the root's post-op buffer on EVERY rank.
+          if (memcmp(bufs[r].data(), bufs[root].data(), n * 4) != 0) {
+            ++bad;
+            break;
+          }
+          for (int64_t i = 0; i < n; ++i) {
+            const double err = std::fabs(bufs[r][i] - orig[i]);
+            if (arm.comp == WireCompression::NONE ? err != 0.0 : err > tol) {
+              ++bad;
+              break;
+            }
+          }
+        }
+        if (bad != 0) {
+          std::fprintf(stderr,
+                       "FAIL broadcast world=%d comp=%s flat=%d shm=%d\n",
+                       world, WireCompressionName(arm.comp),
+                       arm.flat ? 1 : 0, shm ? 1 : 0);
+          ++failures;
+        }
+        for (auto& p : w.planes) p->Shutdown();
+      }
+    }
+  }
+}
+
+// First-class pairwise alltoallv (PR 19): worlds {2,3} x TCP/shm x
+// {dense,fp16,int8,int4} with genuinely uneven splits including an empty
+// block (the MoE capacity-overflow shape). Rank r's block for rank q must
+// land exactly at q's recv offset for r; dense is exact, compressed within
+// the wire mode's budget (each block quantized once at its sender).
+void TestDataPlaneAlltoallv() {
+  for (bool shm : {false, true}) {
+    for (WireCompression comp :
+         {WireCompression::NONE, WireCompression::FP16,
+          WireCompression::INT8, WireCompression::INT4}) {
+      for (int world : {2, 3}) {
+        TestWorld w =
+            MakeWorld(std::vector<std::string>(world, "127.0.0.1"));
+        for (int r = 0; r < world; ++r) {
+          w.planes[r]->set_segment_bytes(512);
+          w.planes[r]->set_shm_enabled(shm);
+          w.planes[r]->set_shm_ring_bytes(8192);
+          w.planes[r]->set_hier_mode(HierMode::OFF);
+        }
+        // Uneven split matrix; (0 -> world-1) is an empty block.
+        auto count = [&](int from, int to) -> int64_t {
+          if (from == 0 && to == world - 1) return 0;
+          return 501 + 217 * from + 131 * to;
+        };
+        auto val = [](int from, int to, int64_t i) {
+          return 0.25f *
+                 static_cast<float>((i * 3 + from * 7 + to * 11) % 21 - 10);
+        };
+        std::vector<std::vector<float>> ins(world);
+        std::vector<std::vector<int64_t>> send_bytes(
+            world, std::vector<int64_t>(world));
+        std::vector<std::vector<int64_t>> recv_bytes(
+            world, std::vector<int64_t>(world));
+        for (int r = 0; r < world; ++r) {
+          for (int q = 0; q < world; ++q) {
+            send_bytes[r][q] = count(r, q) * 4;
+            recv_bytes[r][q] = count(q, r) * 4;
+            for (int64_t i = 0; i < count(r, q); ++i) {
+              ins[r].push_back(val(r, q, i));
+            }
+          }
+        }
+        const double tol = (comp == WireCompression::NONE   ? 0.0
+                            : comp == WireCompression::FP16 ? 2e-3
+                            : comp == WireCompression::INT8 ? 0.03
+                                                            : 0.4) *
+                           3.0;
+        std::vector<ByteBuf> outs(world);
+        std::atomic<int> bad{0};
+        std::vector<std::thread> threads;
+        for (int r = 0; r < world; ++r) {
+          threads.emplace_back([&, r] {
+            if (!w.planes[r]->Connect(w.peers).ok()) {
+              ++bad;
+              return;
+            }
+            if (comp != WireCompression::NONE) {
+              w.planes[r]->BeginCompressedOp(comp, nullptr);
+            }
+            Status st = w.planes[r]->Alltoallv(ins[r].data(), send_bytes[r],
+                                               recv_bytes[r], &outs[r]);
+            w.planes[r]->EndCompressedOp();
+            if (!st.ok()) ++bad;
+            if (std::strcmp(w.planes[r]->last_algo_label(), "pairwise") !=
+                0) {
+              ++bad;
+            }
+            if (comp == WireCompression::NONE &&
+                w.planes[r]->op_wire_bytes() != w.planes[r]->op_raw_bytes()) {
+              ++bad;
+            }
+          });
+        }
+        for (auto& t : threads) t.join();
+        for (int r = 0; r < world && bad == 0; ++r) {
+          int64_t total = 0;
+          for (int q = 0; q < world; ++q) total += recv_bytes[r][q];
+          if (static_cast<int64_t>(outs[r].size()) != total) {
+            ++bad;
+            break;
+          }
+          const float* got = reinterpret_cast<const float*>(outs[r].data());
+          int64_t off = 0;
+          for (int q = 0; q < world && bad == 0; ++q) {
+            for (int64_t i = 0; i < count(q, r); ++i) {
+              const double err = std::fabs(got[off + i] - val(q, r, i));
+              if (comp == WireCompression::NONE ? err != 0.0 : err > tol) {
+                ++bad;
+                break;
+              }
+            }
+            off += count(q, r);
+          }
+        }
+        if (bad != 0) {
+          std::fprintf(stderr, "FAIL alltoallv world=%d comp=%s shm=%d\n",
+                       world, WireCompressionName(comp), shm ? 1 : 0);
+          ++failures;
+        }
+        for (auto& p : w.planes) p->Shutdown();
+      }
+    }
+  }
+}
+
 // Compressed hierarchical worlds: the leader (cross-host) phase carries the
 // quantized hops, intra-host stages stay dense; result must still agree
 // with the oracle and bitwise across every rank.
@@ -3338,6 +3550,8 @@ int main() {
   TestDataPlaneCompressedAllreduce();
   TestDataPlaneReduceScatter();
   TestDataPlaneAllgatherv();
+  TestDataPlaneBroadcast();
+  TestDataPlaneAlltoallv();
   TestDataPlaneCompressedHierarchical();
   TestReduceBufferOps();
   TestMetricsConcurrentIncrements();

@@ -31,6 +31,7 @@ Both paths accept the same Horovod argument surface: ``name``, ``op``,
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import functools
 import threading
@@ -720,14 +721,15 @@ class _NativeProcessBackend(CollectiveBackend):
 
     def grouped_allreduce(self, leaves, name, op, prescale_factor,
                           postscale_factor, axis):
-        # Enqueue the whole group async so the native controller negotiates
-        # and FUSES it in one cycle (reference: FuseResponses,
-        # controller.cc:686), then wait — instead of serializing N blocking
-        # round-trips.
-        handles = [_core_async("allreduce", t, f"{name or 'group'}.{i}",
-                               op=int(op), prescale=prescale_factor,
-                               postscale=postscale_factor)
-                   for i, t in enumerate(leaves)]
+        # Enqueue the whole group async inside a grouped window so the
+        # native controller negotiates and FUSES it in ONE READY/RESPONSES
+        # round (reference: FuseResponses, controller.cc:686), then wait —
+        # instead of serializing N blocking round-trips.
+        with grouped_enqueue():
+            handles = [_core_async("allreduce", t, f"{name or 'group'}.{i}",
+                                   op=int(op), prescale=prescale_factor,
+                                   postscale=postscale_factor)
+                       for i, t in enumerate(leaves)]
         return [synchronize(h) for h in handles]
 
     def allgather(self, x, name, axis):
@@ -939,6 +941,31 @@ def grouped_allreduce(tensors, name: Optional[str] = None,
                                     postscale_factor=postscale_factor,
                                     axis=axis)
     return jax.tree.unflatten(treedef, list(out))
+
+
+@contextlib.contextmanager
+def grouped_enqueue():
+    """Grouped-collective window (process mode): every *async* collective
+    enqueued inside the ``with`` parks on the native core and negotiates in
+    ONE control-plane round when the window closes — one READY and one
+    RESPONSES frame for the whole list instead of per-cycle trickle, and
+    same-op/dtype runs fuse into one execution (docs/collectives.md
+    "Grouped enqueue").
+
+    Only enqueue inside the window; ``synchronize`` AFTER it closes — a
+    blocking wait inside the window would deadlock on the held negotiation.
+    No-op (plain passthrough) in SPMD mode, in-step, or on an older native
+    library without the symbol.
+    """
+    core = runtime.core() if runtime.mode() == "process" else None
+    if core is None or not hasattr(core, "group_begin"):
+        yield
+        return
+    core.group_begin()
+    try:
+        yield
+    finally:
+        core.group_end()
 
 
 def allgather(x, name: Optional[str] = None, axis: Optional[str] = None,

@@ -96,6 +96,10 @@ def parse_args(argv: List[str] = None) -> argparse.Namespace:
                    help="send each control-plane frame on its own syscall "
                         "instead of one vectored send per peer per cycle "
                         "(HVDTPU_CTRL_BATCH=0)")
+    p.add_argument("--bcast-flat-max", type=int, default=None,
+                   help="broadcast schedule floor in bytes: payloads at or "
+                        "below ride the flat root-fanout, larger ones the "
+                        "binomial tree (HVDTPU_BCAST_FLAT_MAX; default 4096)")
     p.add_argument("--hier", action="store_true",
                    help="force the hierarchical two-level allreduce: "
                         "intra-host reduce-scatter/allgather over "
@@ -373,6 +377,10 @@ def _apply_tuning_env(env: dict, args) -> dict:
         env[ev.HVDTPU_ALLREDUCE_SA_GROUP] = str(args.sa_group)
     if args.no_ctrl_batch:
         env[ev.HVDTPU_CTRL_BATCH] = "0"
+    if args.bcast_flat_max is not None:
+        if args.bcast_flat_max < 0:
+            raise SystemExit("hvdrun: --bcast-flat-max must be >= 0")
+        env[ev.HVDTPU_BCAST_FLAT_MAX] = str(args.bcast_flat_max)
     # Transport subsystem: shm lanes + hierarchical allreduce (the native
     # side groups ranks by their advertised HVDTPU_HOSTNAME, so the env only
     # carries the on/off knobs — topology detection is hosts.py's slot

@@ -68,6 +68,12 @@ ALLREDUCE_ALGOS = ("auto", "ring", "recursive_doubling", "tree",
 # per cycle instead of one per message; "0" restores frame-per-send.
 HVDTPU_CTRL_BATCH = "HVDTPU_CTRL_BATCH"
 
+# Broadcast schedule floor (native/data_plane.h, docs/collectives.md
+# "Broadcast & alltoall"): payloads at or below this many bytes ride the
+# flat root-fanout schedule (one hop of latency), larger ones the binomial
+# tree (⌈log2 n⌉ depth). Default 4096; unset/-1 keeps the native default.
+HVDTPU_BCAST_FLAT_MAX = "HVDTPU_BCAST_FLAT_MAX"
+
 # Transport subsystem (native/transport.h + shm_transport.h; reference
 # analog: the fork's MPI / NCCL / CUDA-IPC SHM / P2P communicator menu).
 # SHM: "1" (default) lets same-host rank pairs negotiate POSIX

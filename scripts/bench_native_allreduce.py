@@ -170,6 +170,20 @@ def load_lib(path: str) -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_int]
     except AttributeError:
         pass  # pre-reduce-scatter/allgather build
+    try:
+        lib.hvdtpu_enqueue_broadcast.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.hvdtpu_enqueue_alltoall.restype = ctypes.c_longlong
+        lib.hvdtpu_enqueue_alltoall.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_int]
+    except AttributeError:
+        pass  # pre-broadcast/alltoall build
     return lib
 
 

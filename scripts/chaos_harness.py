@@ -46,10 +46,11 @@ HIERS = ("0", "1")
 COMPRESSIONS = ("none", "fp16", "int8", "int4")
 SCENARIOS = ("kill", "hang", "drop", "delay")
 # Which collective carries the fault: the first-class op menu
-# (docs/collectives.md "Reduce-scatter & allgather"). reducescatter and
-# allgather are single-schedule ops (the ring / the block rotation), so
-# their sweeps pin algo=ring, hier=0.
-OPS = ("allreduce", "reducescatter", "allgather")
+# (docs/collectives.md "Reduce-scatter & allgather", "Broadcast &
+# alltoall"). Every op except allreduce runs one fixed schedule (the
+# ring / block rotation / binomial tree / pairwise exchange), so those
+# sweeps pin algo=ring, hier=0.
+OPS = ("allreduce", "reducescatter", "allgather", "broadcast", "alltoall")
 
 # Detection-to-reformation budgets (seconds, per recovery observation).
 # kill/drop: survivors only re-form — the acceptance bound. hang: recovery
@@ -178,8 +179,8 @@ def main(argv=None):
     p.add_argument("--hier", default=",".join(HIERS))
     p.add_argument("--compression", default=",".join(COMPRESSIONS))
     p.add_argument("--ops", default="allreduce",
-                   help=f"comma list of {OPS}; reducescatter/allgather "
-                        "pin algo=ring, hier=0 (single-schedule ops)")
+                   help=f"comma list of {OPS}; every op but allreduce "
+                        "pins algo=ring, hier=0 (single-schedule ops)")
     p.add_argument("--out", default=None, help="write results JSON here")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
@@ -195,8 +196,9 @@ def main(argv=None):
     else:
         for scenario in args.scenarios.split(","):
             for op in args.ops.split(","):
-                # RS/AG run one fixed schedule: the algo/hier dimensions
-                # are allreduce-only, so collapse them to the ring.
+                # RS/AG/broadcast/alltoall run one fixed schedule each:
+                # the algo/hier dimensions are allreduce-only, so
+                # collapse them to the ring.
                 algos = args.algos.split(",") if op == "allreduce" \
                     else ["ring"]
                 hiers = args.hier.split(",") if op == "allreduce" else ["0"]

@@ -175,6 +175,25 @@ zero1_smoke() {
   return 0
 }
 run_check "zero1-smoke" zero1_smoke
+# Expert-parallel smoke (docs/parallelism.md "Expert parallelism"): a real
+# 2-rank MoE run over the native uneven alltoall(v) — every step asserts
+# routed-token conservation at both ends (landed rows == senders' declared
+# splits; the combine returns exactly what was dispatched), and the job
+# must finish with a finite loss on BOTH ranks.
+moe_smoke() {
+  local out
+  out=$(env JAX_PLATFORMS=cpu "PYTHONPATH=${PWD}" \
+    python3 -m horovod_tpu.runner.launch -np 2 \
+    python3 examples/moe_expert_parallel.py --steps 6 --tokens 64 \
+    2>&1) || { echo "${out}"; return 1; }
+  # grep -o: the launcher can interleave both ranks' lines onto one.
+  [ "$(echo "${out}" | grep -o "conservation held for 6 steps" | wc -l)" \
+    -eq 2 ] || return 1
+  echo "${out}" | grep -qE "step 5: loss [0-9]+\.[0-9]+ splits \[" \
+    || return 1
+  return 0
+}
+run_check "moe-smoke" moe_smoke
 # Cross-run regression-sentry smoke (docs/observability.md): a job writes
 # merged perf profiles; perf_diff must pass a profile against itself
 # (exit 0) and CONFIRM a doctored 3x slowdown (exit 1) — so the perf

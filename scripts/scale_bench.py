@@ -51,8 +51,21 @@ REDUCE_SUM = 1
 # fixed schedule (ring / block rotation), so their arms pin algo=ring;
 # zero1-step times the ZeRO-1 wire shape: reduce-scatter of the fused
 # gradient followed by allgather of the updated shard (same bytes as one
-# ring allreduce — docs/optimizer.md "Sharded optimizer state").
-OPS = ("allreduce", "reducescatter", "allgather", "zero1-step")
+# ring allreduce — docs/optimizer.md "Sharded optimizer state");
+# broadcast times the binomial tree from root 0; alltoall the pairwise
+# exchange with near-even dim-0 splits; moe-step the expert-parallel wire
+# shape — dispatch alltoall chained into the reverse combine alltoall
+# (docs/parallelism.md "Expert parallelism").
+OPS = ("allreduce", "reducescatter", "allgather", "zero1-step",
+       "broadcast", "alltoall", "moe-step")
+# Minimum native C-API symbol each non-allreduce op needs (skip, not
+# fail, on older libraries).
+OP_NEEDS = {"reducescatter": "hvdtpu_enqueue_reducescatter",
+            "allgather": "hvdtpu_enqueue_allgather",
+            "zero1-step": "hvdtpu_enqueue_reducescatter",
+            "broadcast": "hvdtpu_enqueue_broadcast",
+            "alltoall": "hvdtpu_enqueue_alltoall",
+            "moe-step": "hvdtpu_enqueue_alltoall"}
 # Counters scraped from the coordinator's metrics dump after the timed
 # loop (native/metrics.cpp text format; names in docs/metrics.md).
 CTRL_COUNTERS = ("hvdtpu_ctrl_frames_total", "hvdtpu_ctrl_batches_total",
@@ -108,9 +121,8 @@ def run_worker(args) -> int:
         # 64*63 ring segments on a box whose point is process pressure,
         # not lane bandwidth.
         lib.hvdtpu_set_transport(core, 0, 0, 0)
-    if args.op != "allreduce" and \
-            not hasattr(lib, "hvdtpu_enqueue_reducescatter"):
-        print(f"SKIP op {args.op}: library lacks reduce-scatter/allgather",
+    if args.op in OP_NEEDS and not hasattr(lib, OP_NEEDS[args.op]):
+        print(f"SKIP op {args.op}: library lacks {OP_NEEDS[args.op]}",
               file=sys.stderr)
         return 0
     if args.gradcheck and hasattr(lib, "hvdtpu_set_gradstats"):
@@ -144,6 +156,14 @@ def run_worker(args) -> int:
                                   err, len(err)) != 0:
             raise RuntimeError(f"copy: {err.value.decode()}")
 
+    def a2a_splits_for(count):
+        # Near-even dim-0 splits summing to count: the remainder makes
+        # them genuinely uneven (every block to a given receiver still
+        # has the same row count, which keeps the oracle below simple).
+        base, rem = count // n, count % n
+        return (ctypes.c_int * n)(*[base + (1 if q < rem else 0)
+                                    for q in range(n)])
+
     def enqueue_op(name, buf, count):
         shape = (ctypes.c_longlong * 1)(count)
         if args.op == "reducescatter":
@@ -153,6 +173,14 @@ def run_worker(args) -> int:
         elif args.op == "allgather":
             h = lib.hvdtpu_enqueue_allgather(core, name, DTYPE_FLOAT32,
                                              shape, 1, buf, err, len(err))
+        elif args.op == "broadcast":
+            h = lib.hvdtpu_enqueue_broadcast(core, name, DTYPE_FLOAT32,
+                                             shape, 1, buf, 0, err,
+                                             len(err))
+        elif args.op == "alltoall":
+            h = lib.hvdtpu_enqueue_alltoall(
+                core, name, DTYPE_FLOAT32, shape, 1, buf,
+                a2a_splits_for(count), n, err, len(err))
         else:
             h = lib.hvdtpu_enqueue(core, name, OP_ALLREDUCE, REDUCE_SUM,
                                    DTYPE_FLOAT32, shape, 1, buf, 1.0, 1.0,
@@ -194,12 +222,44 @@ def run_worker(args) -> int:
         for h, out in zip(handles, outs):
             wait_copy(h, out)
 
+    def step_moe(names, bufs, count, outs, mids) -> None:
+        # The expert-parallel step's wire shape (docs/parallelism.md):
+        # dispatch tokens by split vector, expert compute is local (not
+        # timed), then the reverse combine returns every row to its
+        # owner — splits of the combine are the receive counts of the
+        # dispatch (n * sp[rank] rows landed, sp[rank] back to each).
+        sp = a2a_splits_for(count)
+        handles = [lib.hvdtpu_enqueue_alltoall(
+            core, name + b".disp", DTYPE_FLOAT32,
+            (ctypes.c_longlong * 1)(count), 1, buf, sp, n, err, len(err))
+            for name, buf in zip(names, bufs)]
+        if any(h < 0 for h in handles):
+            raise RuntimeError(f"dispatch enqueue: {err.value.decode()}")
+        for h, mb in zip(handles, mids):
+            wait_copy(h, mb)
+        back = (ctypes.c_int * n)(*([sp[rank]] * n))
+        handles = [lib.hvdtpu_enqueue_alltoall(
+            core, name + b".comb", DTYPE_FLOAT32,
+            (ctypes.c_longlong * 1)(n * sp[rank]), 1, mb, back, n,
+            err, len(err)) for name, mb in zip(names, mids)]
+        if any(h < 0 for h in handles):
+            raise RuntimeError(f"combine enqueue: {err.value.decode()}")
+        for h, out in zip(handles, outs):
+            wait_copy(h, out)
+
     rc = 0
     try:
         for nbytes in [int(s) for s in args.sizes.split(",")]:
             count = max(1, nbytes // 4)
-            out_count = count * n if args.op == "allgather" else count
-            bufs, outs, names, shards = [], [], [], []
+            if args.op == "allgather":
+                out_count = count * n
+            elif args.op in ("alltoall", "moe-step"):
+                # A rank receives n * splits[rank] <= count + n rows on
+                # the dispatch; the combine restores exactly count.
+                out_count = count + n
+            else:
+                out_count = count
+            bufs, outs, names, shards, mids = [], [], [], [], []
             for t in range(args.tensors):
                 buf = (ctypes.c_char * (count * 4))()
                 fbuf = ctypes.cast(buf, ctypes.POINTER(ctypes.c_float))
@@ -207,10 +267,14 @@ def run_worker(args) -> int:
                 bufs.append(buf)
                 outs.append((ctypes.c_char * (out_count * 4))())
                 shards.append((ctypes.c_char * ((count // n + 1) * 4))())
+                mids.append((ctypes.c_char * (out_count * 4))())
                 names.append(f"scale.{nbytes}.{t}".encode())
-            run = (lambda: step_zero1(names, bufs, count, outs, shards)) \
-                if args.op == "zero1-step" \
-                else (lambda: step(names, bufs, count, outs))
+            if args.op == "zero1-step":
+                run = lambda: step_zero1(names, bufs, count, outs, shards)
+            elif args.op == "moe-step":
+                run = lambda: step_moe(names, bufs, count, outs, mids)
+            else:
+                run = lambda: step(names, bufs, count, outs)
             for _ in range(args.warmup):
                 run()
             t0 = time.perf_counter()
@@ -221,9 +285,17 @@ def run_worker(args) -> int:
             # Inputs are zero except element 0 = rank+1: the reduced
             # element 0 lands in rank 0's reduce-scatter chunk, leads
             # rank 0's block in the gathered output, and survives the
-            # zero1 round trip on every rank.
-            if args.op == "allgather":
+            # zero1 round trip on every rank. For broadcast every rank
+            # holds root 0's payload; for alltoall only rank 0's first
+            # landed block starts at a sender's element 0; for moe-step
+            # the combine returns rank r's row 0 of sender r's dispatch
+            # output — sender r's first element, r+1, on every rank.
+            if args.op in ("allgather", "broadcast"):
                 want = 1.0
+            elif args.op == "alltoall":
+                want = 1.0 if rank == 0 else 0.0
+            elif args.op == "moe-step":
+                want = float(rank + 1)
             elif args.op == "reducescatter" and rank != 0:
                 want = 0.0
             else:

@@ -1,0 +1,209 @@
+"""Worker: first-class broadcast & alltoall(v) (docs/collectives.md
+"Broadcast & alltoall", PR 19).
+
+Runs TEST_BA_ITERS rounds of:
+
+* broadcast of a large fp32 vector (binomial tree above the flat floor) and
+  a small int64 vector (flat fanout; stays dense under any wire mode), with
+  a rotating nonzero root — every rank reconstructs the root's payload from
+  the shared seed;
+* grouped broadcast of a parameter pytree (broadcast_parameters -> ONE
+  negotiation round through the grouped window);
+* alltoall without splits (even 1/n) and alltoallv with genuinely uneven
+  splits including an empty block — received_splits and routed-row
+  conservation asserted against the reconstructed split matrix;
+* a symmetric alltoall (identical inputs, uniform splits) whose outputs
+  must be BITWISE identical across ranks even under int4 — asserted via
+  allgather_object of output CRCs (the lossless channel).
+
+Under HVDTPU_COMPRESSION the value checks go tolerance-based; the
+divergence probe (HVDTPU_GRADCHECK_SAMPLE=1) fingerprints the broadcast
+outputs (quantize-once root codes -> world-bitwise), and the worker then
+asserts grouped enqueue measurably cuts hvdtpu_ctrl_frames_total vs
+per-tensor sync enqueue, and that the timeline op-done events for both new
+ops carry raw_bytes/wire_bytes args.
+"""
+import os
+import zlib
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.observability import sample_value  # noqa: E402
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+assert hvd.mode() == "process", hvd.mode()
+
+comp = os.environ.get("HVDTPU_COMPRESSION", "none") or "none"
+compressed = comp not in ("", "none")
+iters = int(os.environ.get("TEST_BA_ITERS", "2"))
+
+timeline = os.environ.get("TEST_TIMELINE_PATH")
+if timeline:
+    timeline = timeline + f".{r}.json"
+    hvd.start_timeline(timeline)
+
+TOL = {"fp16": 2e-3, "int8": 0.05, "int4": 0.5}
+
+
+def rank_data(seed, count, scale=1.0):
+    rng = np.random.RandomState(7000 + seed)
+    return (scale * rng.randn(count)).astype(np.float32)
+
+
+def check(out, want, what):
+    out = np.asarray(out, np.float32).reshape(-1)
+    want = np.asarray(want, np.float32).reshape(-1)
+    assert out.shape == want.shape, (what, out.shape, want.shape)
+    if not compressed:
+        np.testing.assert_array_equal(out, want, err_msg=what)
+        return
+    denom = max(float(np.linalg.norm(want)), 1e-6)
+    rel = float(np.linalg.norm(out - want)) / denom
+    assert rel < TOL.get(comp, 0.5), (what, comp, rel)
+
+
+def crc_all_equal(arr, tag):
+    """World-bitwise assertion over a LOSSLESS channel: allgather_object
+    pickles the CRC (uint8 payload — never quantized)."""
+    crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+    crcs = hvd.allgather_object(crc, name=f"crc.{tag}")
+    assert len(set(crcs)) == 1, (tag, crcs)
+
+
+for it in range(iters):
+    root = (it + 1) % n
+
+    # -- broadcast: big fp32 (tree: 16 KB > 4 KB flat floor) --------------
+    want = rank_data(100 + it, 4096)
+    x = want.copy() if r == root else np.zeros(4096, np.float32)
+    out = np.asarray(hvd.broadcast(x, root_rank=root, name=f"bc{it}/big"))
+    check(out, want, f"bc-big it{it}")
+    crc_all_equal(out, f"bc{it}")
+
+    # -- broadcast: small int64 (flat fanout; dense under any wire mode) --
+    ints = (np.arange(17, dtype=np.int64) * (it + 3)) if r == root \
+        else np.zeros(17, np.int64)
+    out = np.asarray(hvd.broadcast(ints, root_rank=root, name=f"bc{it}/sm"))
+    np.testing.assert_array_equal(
+        out, np.arange(17, dtype=np.int64) * (it + 3), err_msg=f"bc-sm {it}")
+
+    # -- grouped broadcast of a pytree (broadcast_parameters) -------------
+    p_want = {"w": rank_data(200 + it, 2048).reshape(256, 8),
+              "b": rank_data(300 + it, 64)}
+    params = p_want if r == root else \
+        {"w": np.zeros((256, 8), np.float32), "b": np.zeros(64, np.float32)}
+    got = hvd.broadcast_parameters(params, root_rank=root)
+    check(got["w"], p_want["w"], f"bcp-w it{it}")
+    check(got["b"], p_want["b"], f"bcp-b it{it}")
+
+    # -- alltoall, even splits (no splits arg) ----------------------------
+    cols = 8
+    blocks = [rank_data(1000 + 37 * it + 11 * r + q, 16 * cols)
+              .reshape(16, cols) for q in range(n)]
+    out = np.asarray(hvd.alltoall(np.concatenate(blocks),
+                                  name=f"a2a{it}/even"))
+    want = np.concatenate(
+        [rank_data(1000 + 37 * it + 11 * q + r, 16 * cols).reshape(16, cols)
+         for q in range(n)])
+    check(out, want, f"a2a-even it{it}")
+
+    # -- alltoallv, uneven splits (empty block: rank 0 -> last rank) ------
+    def srows(f, t):
+        if f == 0 and t == n - 1 and n > 1:
+            return 0
+        return 5 + 3 * f + 2 * t
+
+    ublocks = [rank_data(2000 + 53 * it + 13 * r + q, srows(r, q) * cols)
+               .reshape(srows(r, q), cols) for q in range(n)]
+    splits = np.array([srows(r, q) for q in range(n)], np.int32)
+    out, rsp = hvd.alltoall(np.concatenate(ublocks), splits=splits,
+                            name=f"a2a{it}/uneven")
+    out, rsp = np.asarray(out), np.asarray(rsp)
+    np.testing.assert_array_equal(
+        rsp, np.array([srows(q, r) for q in range(n)], np.int32),
+        err_msg=f"received_splits it{it}")
+    # Routed-row conservation: what landed == what the senders declared.
+    assert out.shape[0] == int(rsp.sum()), (out.shape, rsp)
+    want = np.concatenate(
+        [rank_data(2000 + 53 * it + 13 * q + r, srows(q, r) * cols)
+         .reshape(srows(q, r), cols) for q in range(n)])
+    check(out, want, f"a2a-uneven it{it}")
+
+    # -- symmetric alltoall: world-bitwise even under int4 ----------------
+    # Every block of every rank is the SAME 8-row tile, so each rank
+    # receives n identical blocks — and since every sender quantizes the
+    # identical block through the identical codec, the outputs must be
+    # BITWISE equal across ranks even on the lossy wire.
+    tile = rank_data(4000 + it, 8 * cols).reshape(8, cols)
+    out = np.asarray(hvd.alltoall(np.tile(tile, (n, 1)),
+                                  name=f"a2a{it}/sym"))
+    crc_all_equal(out, f"a2a{it}")
+
+# -- grouped enqueue cuts control-plane frames ----------------------------
+vec = np.ones(256, np.float32)
+K = 8
+parsed = hvd.metrics()
+f0 = sample_value(parsed, "hvdtpu_ctrl_frames_total") or 0.0
+for i in range(K):  # per-tensor sync: one negotiation round each
+    hvd.broadcast(vec, root_rank=0, name=f"pt.{i}")
+f1 = sample_value(hvd.metrics(), "hvdtpu_ctrl_frames_total") or 0.0
+with hvd.grouped_enqueue():  # one round for the whole list
+    handles = [hvd.broadcast_async(vec, root_rank=0, name=f"gr.{i}")
+               for i in range(K)]
+for h in handles:
+    hvd.synchronize(h)
+f2 = sample_value(hvd.metrics(), "hvdtpu_ctrl_frames_total") or 0.0
+assert f2 - f1 < f1 - f0, \
+    f"grouped enqueue did not cut ctrl frames: per-tensor {f1 - f0}, " \
+    f"grouped {f2 - f1}"
+
+# -- divergence probe: broadcast outputs are fingerprinted ----------------
+probe_every = int(os.environ.get("HVDTPU_GRADCHECK_SAMPLE", "64"))
+if probe_every == 1 and n > 1:
+    parsed = hvd.metrics()
+    probes = sample_value(parsed, "hvdtpu_gradcheck_probes_total")
+    assert probes and probes > 0, f"no divergence probes ran: {probes}"
+    if r == 0:
+        div = hvd.grad_report()["divergence_total"]
+        assert div == 0, f"healthy world convicted: divergence_total={div}"
+
+# -- timeline: op-done events carry raw/wire byte args --------------------
+if timeline:
+    hvd.stop_timeline()
+    import json
+    import time
+
+    deadline = time.time() + 30
+    while True:
+        try:
+            events = json.load(open(timeline))
+            break
+        except Exception:
+            assert time.time() < deadline, "timeline never closed"
+            time.sleep(0.05)
+    # Byte metering is send-side (the /metrics convention): a broadcast
+    # leaf forwards nothing, so only the root is guaranteed nonzero; every
+    # rank sends on the pairwise alltoall.
+    bc0_root = 1 % n
+    for pid, nonzero in (("bc0/big", r == bc0_root), ("a2a0/even", True)):
+        done = [e for e in events
+                if e.get("pid") == pid and e.get("ph") == "E"
+                and "raw_bytes" in e.get("args", {})]
+        assert done, f"no raw_bytes/wire_bytes op-done event for {pid!r}"
+        args = done[0]["args"]
+        if nonzero:
+            assert args["raw_bytes"] > 0 and args["wire_bytes"] > 0, \
+                (pid, args)
+            if comp == "int4":
+                ratio = args["raw_bytes"] / args["wire_bytes"]
+                assert ratio >= 2.0, \
+                    f"{pid}: int4 wire reduction {ratio:.2f}x"
+
+print(f"bcast_a2a_worker rank {r}/{n} comp={comp}: ALL OK", flush=True)
+hvd.shutdown()

@@ -21,8 +21,8 @@ BATCH_SLEEP = float(os.environ.get("CHAOS_BATCH_SLEEP", "0"))
 # (1024 B) so int8/int4 wire modes actually engage on the faulted op.
 ELEMS = int(os.environ.get("CHAOS_ELEMS", "4096"))
 # Which collective carries the fault (docs/collectives.md "Reduce-scatter
-# & allgather"): the kill matrix must hold for every first-class op, not
-# just allreduce.
+# & allgather", "Broadcast & alltoall"): the kill matrix must hold for
+# every first-class op, not just allreduce.
 OP = os.environ.get("CHAOS_OP", "allreduce")
 
 hvd.init()
@@ -60,6 +60,21 @@ def train(state):
                 x = np.full(ELEMS, grad, np.float32)
                 out = hvd.allgather(x, name=f"step{state.batches}")
                 expect = grad
+            elif OP == "broadcast":
+                # Root 0 is never the chaos target (the harness picks
+                # rank >= 1), so the payload source survives the fault.
+                x = np.full(ELEMS, grad, np.float32) if hvd.rank() == 0 \
+                    else np.zeros(ELEMS, np.float32)
+                out = hvd.broadcast(x, root_rank=0,
+                                    name=f"step{state.batches}")
+                expect = grad
+            elif OP == "alltoall":
+                # Even 1/n splits: each rank routes one all-equal block
+                # to every peer, so the exchange stays exact under any
+                # wire mode and reshapes cleanly after a shrink.
+                x = np.full(hvd.size() * 1024, grad, np.float32)
+                out = hvd.alltoall(x, name=f"step{state.batches}")
+                expect = grad
             else:
                 x = np.full(ELEMS, grad, np.float32)
                 out = hvd.allreduce(x, name=f"step{state.batches}",
@@ -71,7 +86,8 @@ def train(state):
                         f"batch={state.batches} got={arr[:4]} want={expect}")
                 os._exit(5)
             reduced_mean = float(arr.mean()) * \
-                (hvd.size() if OP == "allgather" else 1)
+                (hvd.size() if OP in ("allgather", "broadcast", "alltoall")
+                 else 1)
             state.w = float(state.w) - 0.5 * reduced_mean / hvd.size()
             loss = (float(state.w) - 3.0) ** 2
             if not np.isfinite(loss):

@@ -111,15 +111,17 @@ def test_chaos_kill_matrix(algo, transport, hier, compression):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("op", ["reducescatter", "allgather"])
+@pytest.mark.parametrize("op", ["reducescatter", "allgather",
+                                "broadcast", "alltoall"])
 @pytest.mark.parametrize("transport", ["tcp", "shm"])
 @pytest.mark.parametrize("compression", ["none", "int4"])
 def test_chaos_kill_new_ops(op, transport, compression):
-    """The kill matrix extends to the first-class reduce-scatter and
-    allgather schedules (PR 18): a SIGKILL mid-op recovers with the same
+    """The kill matrix extends to the first-class reduce-scatter /
+    allgather schedules (PR 18) and the broadcast tree / alltoall
+    pairwise exchange (PR 19): a SIGKILL mid-op recovers with the same
     sub-2 s budget and the worker's per-op correctness oracle (exact
-    chunk / gathered values through the failure). RS/AG run one fixed
-    schedule so algo/hier stay pinned at ring/flat."""
+    chunk / gathered / routed values through the failure). These ops run
+    one fixed schedule each so algo/hier stay pinned at ring/flat."""
     res = _run("kill", transport=transport, compression=compression, op=op,
                seed=hash((op, transport, compression)) & 0xFFFF)
     assert res["ok"], res

@@ -151,6 +151,56 @@ def test_reducescatter_allgather_npo2_large(comp):
     _rsag_world(5, "0", comp, timeout=360)
 
 
+# First-class broadcast & alltoall(v) (docs/collectives.md "Broadcast &
+# alltoall", PR 19): every transport x wire-compression cell, with the npo2
+# worlds (w3/w5) covering the binomial tree's non-power-of-two vrank
+# rotation and uneven pairwise splits. The worker asserts dense exactness,
+# compressed tolerance, world-bitwise outputs over a lossless CRC channel
+# AND via the divergence probe (broadcast outputs are fingerprinted), the
+# grouped-enqueue ctrl-frame reduction, and raw/wire timeline args.
+def _ba_world(n, shm, comp, timeout=240, tmp_path=None):
+    extra = {
+        "TEST_BA_ITERS": "2",
+        "HVDTPU_SHM": shm,
+        "HVDTPU_COMPRESSION": comp,
+        "HVDTPU_COMPRESSION_MIN_BYTES": "0",
+        "HVDTPU_COMPRESSION_SKIP_REGEX": "",
+        "HVDTPU_GRADCHECK_SAMPLE": "1",
+    }
+    if tmp_path is not None:
+        extra["TEST_TIMELINE_PATH"] = str(tmp_path / "ba_tl")
+    results = _launch_world(
+        n, os.path.join(REPO, "tests", "data", "bcast_a2a_worker.py"),
+        extra_env=extra, timeout=timeout)
+    for r, (rc, out, err) in enumerate(results):
+        assert rc == 0, f"rank {r} failed:\n{err}\n{out}"
+        assert "ALL OK" in out
+
+
+@pytest.mark.parametrize("comp", ["none", "fp16", "int8", "int4"])
+@pytest.mark.parametrize("shm", ["0", "1"])
+def test_broadcast_alltoall_matrix(shm, comp, tmp_path):
+    """w2: the full {tcp,shm} x {none,fp16,int8,int4} cell matrix, with
+    timeline op-done byte args asserted."""
+    _ba_world(2, shm, comp, tmp_path=tmp_path)
+
+
+@pytest.mark.parametrize("comp", ["none", "int4"])
+@pytest.mark.parametrize("shm", ["0", "1"])
+def test_broadcast_alltoall_npo2(shm, comp):
+    """w3 (non-power-of-two): binomial tree with a remainder subtree and
+    uneven pairwise rotation, dense and heaviest-quantized, both lanes."""
+    _ba_world(3, shm, comp)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("comp", ["none", "fp16", "int8", "int4"])
+def test_broadcast_alltoall_npo2_large(comp):
+    """w5 (prime) over TCP: deeper tree + 4-peer pairwise schedule across
+    every wire mode."""
+    _ba_world(5, "0", comp, timeout=360)
+
+
 @pytest.mark.parametrize("shm", ["1", "0"])
 def test_shm_transport_toggle(shm):
     """The whole collective menu stays correct over the shared-memory lanes
