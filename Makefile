@@ -5,20 +5,24 @@
 
 NATIVE := horovod_tpu/native
 
-# The full static gate: cross-language invariant linter, ruff (if
-# installed), clang-tidy and clang thread-safety analysis (both skip with a
-# notice when clang is absent — CI-only there; the linter and tests always
-# run).
-lint: invariants ruff tidy analyze
+# The full static gate: cross-language invariant linter (env vars, docs,
+# enum mirrors, atomics-ordering discipline, C-API<->ctypes parity), the
+# thread-role contract checker, ruff (if installed), clang-tidy and clang
+# thread-safety analysis (both skip with a notice when clang is absent —
+# CI-only there; the two python checkers and tests always run).
+lint: invariants threadroles ruff tidy analyze
 
 invariants:
 	python3 scripts/check_invariants.py
+
+threadroles:
+	python3 scripts/check_threadroles.py
 
 # Python lint ([tool.ruff] in pyproject.toml). Graceful skip keeps `make
 # lint` usable on boxes without ruff; CI installs it.
 ruff:
 	@if command -v ruff >/dev/null 2>&1; then \
-	  ruff check horovod_tpu/ scripts/check_invariants.py tests/test_static_analysis.py; \
+	  ruff check horovod_tpu/ scripts/check_invariants.py scripts/check_threadroles.py tests/test_static_analysis.py; \
 	else \
 	  echo "ruff: not installed; SKIPPED (python lint is CI-only on ruff-less boxes)"; \
 	fi
@@ -34,5 +38,5 @@ native check check-tsan check-asan check-ubsan tsan asan ubsan clean:
 test:
 	JAX_PLATFORMS=cpu python3 -m pytest tests/ -q -m 'not slow'
 
-.PHONY: lint invariants ruff tidy analyze native check check-tsan \
-        check-asan check-ubsan tsan asan ubsan clean test
+.PHONY: lint invariants threadroles ruff tidy analyze native check \
+        check-tsan check-asan check-ubsan tsan asan ubsan clean test

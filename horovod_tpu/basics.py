@@ -71,176 +71,176 @@ def _ensure_built() -> str:
     return _LIB_PATH
 
 
-def _load_lib() -> ctypes.CDLL:
-    lib = ctypes.CDLL(_ensure_built())
-    lib.hvdtpu_create.restype = ctypes.c_void_p
-    lib.hvdtpu_create.argtypes = [
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
-        ctypes.c_double, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int,
-        ctypes.c_double]
-    lib.hvdtpu_start.restype = ctypes.c_int
-    lib.hvdtpu_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                 ctypes.c_int]
-    lib.hvdtpu_shutdown.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_destroy.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_enqueue.restype = ctypes.c_longlong
-    lib.hvdtpu_enqueue.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
-        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
-        ctypes.c_int]
-    if hasattr(lib, "hvdtpu_enqueue_reducescatter"):  # older libs lack it
-        lib.hvdtpu_enqueue_reducescatter.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_reducescatter.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.c_double, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
-    if hasattr(lib, "hvdtpu_enqueue_allgather"):  # older libs lack it
-        lib.hvdtpu_enqueue_allgather.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_allgather.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.c_char_p, ctypes.c_int]
-    if hasattr(lib, "hvdtpu_enqueue_broadcast"):  # older libs lack it
-        lib.hvdtpu_enqueue_broadcast.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_broadcast.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
-    if hasattr(lib, "hvdtpu_enqueue_alltoall"):  # older libs lack it
-        lib.hvdtpu_enqueue_alltoall.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_alltoall.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
-            ctypes.c_int]
-    if hasattr(lib, "hvdtpu_group_begin"):  # older libs lack it
-        lib.hvdtpu_group_begin.restype = None
-        lib.hvdtpu_group_begin.argtypes = [ctypes.c_void_p]
-        lib.hvdtpu_group_end.restype = None
-        lib.hvdtpu_group_end.argtypes = [ctypes.c_void_p]
-    if hasattr(lib, "hvdtpu_set_bcast_tuning"):  # older libs lack it
-        lib.hvdtpu_set_bcast_tuning.restype = ctypes.c_int
-        lib.hvdtpu_set_bcast_tuning.argtypes = [ctypes.c_void_p,
-                                                ctypes.c_longlong]
-    if hasattr(lib, "hvdtpu_set_optimizer_state_bytes"):
-        lib.hvdtpu_set_optimizer_state_bytes.restype = ctypes.c_int
-        lib.hvdtpu_set_optimizer_state_bytes.argtypes = [
-            ctypes.c_void_p, ctypes.c_longlong]
-    lib.hvdtpu_wait.restype = ctypes.c_int
-    lib.hvdtpu_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
-                                ctypes.c_char_p, ctypes.c_int]
-    lib.hvdtpu_poll.restype = ctypes.c_int
-    lib.hvdtpu_poll.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
-    lib.hvdtpu_result_bytes.restype = ctypes.c_longlong
-    lib.hvdtpu_result_bytes.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
-    lib.hvdtpu_copy_result.restype = ctypes.c_int
-    lib.hvdtpu_copy_result.argtypes = [
-        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
-        ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
-    lib.hvdtpu_join.restype = ctypes.c_longlong
-    lib.hvdtpu_join.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_set_cache_capacity.restype = ctypes.c_int
-    lib.hvdtpu_set_cache_capacity.argtypes = [ctypes.c_void_p,
-                                              ctypes.c_longlong]
-    lib.hvdtpu_set_secret.restype = ctypes.c_int
-    lib.hvdtpu_set_secret.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-    lib.hvdtpu_hmac_hex.restype = ctypes.c_int
-    lib.hvdtpu_hmac_hex.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                                    ctypes.c_char_p, ctypes.c_int]
-    lib.hvdtpu_set_stall_shutdown.restype = ctypes.c_int
-    lib.hvdtpu_set_stall_shutdown.argtypes = [ctypes.c_void_p,
-                                              ctypes.c_double]
-    lib.hvdtpu_set_failure_detection.restype = ctypes.c_int
-    lib.hvdtpu_set_failure_detection.argtypes = [
-        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double, ctypes.c_double]
-    lib.hvdtpu_set_chaos.restype = ctypes.c_int
-    lib.hvdtpu_set_chaos.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
-        ctypes.c_longlong, ctypes.c_int]
-    lib.hvdtpu_observe_recovery.restype = ctypes.c_int
-    lib.hvdtpu_observe_recovery.argtypes = [ctypes.c_void_p, ctypes.c_double]
-    lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
-    lib.hvdtpu_set_allreduce_tuning.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong]
-    if hasattr(lib, "hvdtpu_set_scale_tuning"):  # older libs lack it
-        lib.hvdtpu_set_scale_tuning.restype = ctypes.c_int
-        lib.hvdtpu_set_scale_tuning.argtypes = [
-            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int]
-    lib.hvdtpu_set_transport.restype = ctypes.c_int
-    lib.hvdtpu_set_transport.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
-    lib.hvdtpu_set_transport_ext.restype = ctypes.c_int
-    lib.hvdtpu_set_transport_ext.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong]
-    lib.hvdtpu_set_autotune.restype = ctypes.c_int
-    lib.hvdtpu_set_autotune.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_double]
-    lib.hvdtpu_set_compression.restype = ctypes.c_int
-    lib.hvdtpu_set_compression.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_char_p]
-    lib.hvdtpu_wire_stats.restype = None
-    lib.hvdtpu_wire_stats.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
-        ctypes.POINTER(ctypes.c_longlong)]
-    lib.hvdtpu_metrics_dump.restype = ctypes.c_longlong
-    lib.hvdtpu_metrics_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.c_longlong]
-    lib.hvdtpu_start_timeline.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                          ctypes.c_int]
-    lib.hvdtpu_stop_timeline.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_set_trace.restype = ctypes.c_int
-    lib.hvdtpu_set_trace.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
-                                     ctypes.c_double]
-    lib.hvdtpu_start_trace.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                       ctypes.c_int, ctypes.c_longlong]
-    lib.hvdtpu_clock_offset.restype = None
-    lib.hvdtpu_clock_offset.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
-        ctypes.POINTER(ctypes.c_longlong)]
-    lib.hvdtpu_set_flightrec.restype = ctypes.c_int
-    lib.hvdtpu_set_flightrec.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
-                                         ctypes.c_char_p]
-    lib.hvdtpu_set_perfstats.restype = ctypes.c_int
-    lib.hvdtpu_set_perfstats.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
-        ctypes.c_char_p]
-    lib.hvdtpu_set_gradstats.restype = ctypes.c_int
-    lib.hvdtpu_set_gradstats.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
-        ctypes.c_char_p]
-    lib.hvdtpu_gradstats_snapshot.restype = ctypes.c_longlong
-    lib.hvdtpu_gradstats_snapshot.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
-    lib.hvdtpu_perfstats_snapshot.restype = ctypes.c_longlong
-    lib.hvdtpu_perfstats_snapshot.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
-    lib.hvdtpu_set_profiler.restype = ctypes.c_int
-    lib.hvdtpu_set_profiler.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
-        ctypes.c_int, ctypes.c_char_p]
-    lib.hvdtpu_profiler_start.restype = ctypes.c_int
-    lib.hvdtpu_profiler_start.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_profiler_stop.restype = ctypes.c_int
-    lib.hvdtpu_profiler_stop.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_profiler_running.restype = ctypes.c_int
-    lib.hvdtpu_profiler_running.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_profiler_snapshot.restype = ctypes.c_longlong
-    lib.hvdtpu_profiler_snapshot.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
-    lib.hvdtpu_flightrec_dump.restype = ctypes.c_int
-    lib.hvdtpu_flightrec_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-    lib.hvdtpu_flightrec_snapshot.restype = ctypes.c_longlong
-    lib.hvdtpu_flightrec_snapshot.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
-    lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
-    lib.hvdtpu_cycle_time_ms.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_fusion_threshold.restype = ctypes.c_longlong
-    lib.hvdtpu_fusion_threshold.argtypes = [ctypes.c_void_p]
+# --------------------------------------------------------------------------
+# C-API registration table
+# --------------------------------------------------------------------------
+# Declarative mirror of the ``extern "C"`` block in native/core.cpp — the
+# ONE place ctypes signatures are written down. Everything that loads the
+# native library (this module, scripts/bench_native_allreduce.py,
+# scripts/scale_bench.py, tests) registers through register_c_api() below,
+# and scripts/check_invariants.py ABI-MIRROR parses this table against the
+# C declarations: an arity/type drift, an unregistered export, or a
+# registration missing its version gate is a lint failure, not a runtime
+# surprise on somebody's older .so.
+#
+# Entry format: (symbol, restype, argtypes, required).
+#   required=True  — baseline export every supported .so has; absence is an
+#                    AttributeError at load (the pre-PR-13 surface).
+#   required=False — version-gated export ("older libs lack it"): absent
+#                    symbols are skipped and callers hasattr-gate their use.
+
+_I64P = ctypes.POINTER(ctypes.c_longlong)
+_I32P = ctypes.POINTER(ctypes.c_int)
+
+_C_API = (
+    ("hvdtpu_create", ctypes.c_void_p,
+     [ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+      ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+      ctypes.c_double, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int,
+      ctypes.c_double], True),
+    ("hvdtpu_start", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int], True),
+    ("hvdtpu_shutdown", None, [ctypes.c_void_p], True),
+    ("hvdtpu_destroy", None, [ctypes.c_void_p], True),
+    ("hvdtpu_enqueue", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+      ctypes.c_int, _I64P, ctypes.c_int, ctypes.c_void_p, ctypes.c_double,
+      ctypes.c_double, ctypes.c_int, _I32P, ctypes.c_int, ctypes.c_char_p,
+      ctypes.c_int], True),
+    ("hvdtpu_enqueue_reducescatter", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int, _I64P,
+      ctypes.c_int, ctypes.c_void_p, ctypes.c_double, ctypes.c_double,
+      ctypes.c_char_p, ctypes.c_int], False),
+    ("hvdtpu_enqueue_allgather", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, _I64P, ctypes.c_int,
+      ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int], False),
+    ("hvdtpu_enqueue_broadcast", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, _I64P, ctypes.c_int,
+      ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int], False),
+    ("hvdtpu_enqueue_alltoall", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, _I64P, ctypes.c_int,
+      ctypes.c_void_p, _I32P, ctypes.c_int, ctypes.c_char_p, ctypes.c_int],
+     False),
+    ("hvdtpu_group_begin", None, [ctypes.c_void_p], False),
+    ("hvdtpu_group_end", None, [ctypes.c_void_p], False),
+    ("hvdtpu_wait", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int],
+     True),
+    ("hvdtpu_poll", ctypes.c_int, [ctypes.c_void_p, ctypes.c_longlong],
+     True),
+    ("hvdtpu_result_bytes", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_longlong], True),
+    ("hvdtpu_copy_result", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+      ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int], True),
+    ("hvdtpu_join", ctypes.c_longlong, [ctypes.c_void_p], True),
+    ("hvdtpu_set_cache_capacity", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong], True),
+    ("hvdtpu_hmac_hex", ctypes.c_int,
+     [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int],
+     True),
+    ("hvdtpu_set_secret", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p], True),
+    ("hvdtpu_set_allreduce_tuning", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong],
+     True),
+    ("hvdtpu_set_scale_tuning", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int], False),
+    ("hvdtpu_set_bcast_tuning", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong], False),
+    ("hvdtpu_set_optimizer_state_bytes", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong], False),
+    ("hvdtpu_set_transport", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int],
+     True),
+    ("hvdtpu_set_transport_ext", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong],
+     True),
+    ("hvdtpu_set_stall_shutdown", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_double], True),
+    ("hvdtpu_set_failure_detection", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double, ctypes.c_double],
+     True),
+    ("hvdtpu_set_chaos", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_longlong,
+      ctypes.c_longlong, ctypes.c_int], True),
+    ("hvdtpu_observe_recovery", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_double], True),
+    ("hvdtpu_set_compression", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_char_p],
+     True),
+    ("hvdtpu_wire_stats", None, [ctypes.c_void_p, _I64P, _I64P], True),
+    ("hvdtpu_metrics_dump", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong], True),
+    ("hvdtpu_set_flightrec", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p], True),
+    ("hvdtpu_flightrec_dump", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_char_p], True),
+    ("hvdtpu_set_perfstats", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_double, ctypes.c_longlong,
+      ctypes.c_char_p], True),
+    ("hvdtpu_set_profiler", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+      ctypes.c_int, ctypes.c_char_p], True),
+    ("hvdtpu_profiler_start", ctypes.c_int, [ctypes.c_void_p], True),
+    ("hvdtpu_profiler_stop", ctypes.c_int, [ctypes.c_void_p], True),
+    ("hvdtpu_profiler_running", ctypes.c_int, [ctypes.c_void_p], True),
+    ("hvdtpu_profiler_snapshot", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong], True),
+    ("hvdtpu_set_gradstats", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+      ctypes.c_char_p], True),
+    ("hvdtpu_gradstats_snapshot", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong], True),
+    ("hvdtpu_perfstats_snapshot", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong], True),
+    ("hvdtpu_flightrec_snapshot", ctypes.c_longlong,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong], True),
+    ("hvdtpu_wire_compressed_bytes", ctypes.c_longlong,
+     [ctypes.c_int, ctypes.c_longlong], False),
+    ("hvdtpu_wire_compress", ctypes.c_int,
+     [ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+      ctypes.c_void_p], False),
+    ("hvdtpu_wire_decompress", ctypes.c_int,
+     [ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p],
+     False),
+    ("hvdtpu_set_autotune", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+      ctypes.c_int, ctypes.c_int, ctypes.c_double], True),
+    ("hvdtpu_start_timeline", None,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int], True),
+    ("hvdtpu_stop_timeline", None, [ctypes.c_void_p], True),
+    ("hvdtpu_set_trace", ctypes.c_int,
+     [ctypes.c_void_p, ctypes.c_longlong, ctypes.c_double], True),
+    ("hvdtpu_start_trace", None,
+     [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong],
+     True),
+    ("hvdtpu_clock_offset", None, [ctypes.c_void_p, _I64P, _I64P], True),
+    ("hvdtpu_cycle_time_ms", ctypes.c_double, [ctypes.c_void_p], True),
+    ("hvdtpu_fusion_threshold", ctypes.c_longlong, [ctypes.c_void_p], True),
+)
+
+
+def register_c_api(lib: ctypes.CDLL, strict: bool = True) -> ctypes.CDLL:
+    """Apply the _C_API table to a freshly dlopen'd core library.
+
+    strict=True (the runtime path): a missing required symbol raises
+    AttributeError — the .so predates the supported baseline. strict=False
+    (bench harnesses A/B-ing against historical builds): every symbol is
+    treated as gated, absent exports just stay unregistered and callers
+    skip them behind hasattr.
+    """
+    for symbol, restype, argtypes, required in _C_API:
+        if not (required and strict) and not hasattr(lib, symbol):
+            continue  # version gate: older .so lacks this export
+        fn = getattr(lib, symbol)
+        fn.restype = restype
+        fn.argtypes = argtypes
     return lib
+
+
+def _load_lib() -> ctypes.CDLL:
+    return register_c_api(ctypes.CDLL(_ensure_built()))
 
 
 _lib: Optional[ctypes.CDLL] = None

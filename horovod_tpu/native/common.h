@@ -50,6 +50,10 @@
 #define RETURN_CAPABILITY(x) HVDTPU_TSA(lock_returned(x))
 #define NO_THREAD_SAFETY_ANALYSIS HVDTPU_TSA(no_thread_safety_analysis)
 
+// Thread-role annotations (HVDTPU_CALLED_ON / HVDTPU_ROLE): the lock-free
+// complement to the TSA layer above, in their own dependency-light header.
+#include "thread_roles.h"
+
 namespace hvdtpu {
 
 // std::mutex carries no capability attribute under libstdc++, so the analysis

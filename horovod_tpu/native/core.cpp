@@ -768,15 +768,15 @@ class Core {
   std::set<int32_t> joined_ranks_;
   std::set<int32_t> dead_ranks_;  // disconnected workers (never come back)
   bool join_pending_local_ GUARDED_BY(mu_) = false;
-  std::atomic<int32_t> last_joined_rank_{-1};
-  std::atomic<bool> join_done_{false};
+  std::atomic<int32_t> last_joined_rank_{-1};  // atomic: seqcst(join handshake with mutex-guarded state)
+  std::atomic<bool> join_done_{false};  // atomic: seqcst(join handshake with mutex-guarded state)
 
   std::thread background_;
-  std::atomic<bool> shutdown_{false};
-  std::atomic<bool> world_broken_{false};
+  std::atomic<bool> shutdown_{false};  // atomic: seqcst(shutdown latch, read via implicit loads)
+  std::atomic<bool> world_broken_{false};  // atomic: seqcst(failure latch)
   // Worker-side failover latch (set by HandleDataPlaneFailure, consumed at
   // the top of the next background cycle — see the deferral note there).
-  std::atomic<bool> worker_failover_pending_{false};
+  std::atomic<bool> worker_failover_pending_{false};  // atomic: seqcst(failover doorbell)
   bool started_ = false;
 
   // Response cache (see RequestCache above). Worker role uses req/enabled;
@@ -806,8 +806,8 @@ class Core {
   // form-up and refreshed through the control plane while tracing. The
   // atomics are readable from any thread (hvdtpu_clock_offset); everything
   // else is background-thread-owned (Start writes before the spawn).
-  std::atomic<int64_t> clock_offset_us_{0};
-  std::atomic<int64_t> clock_err_us_{-1};
+  std::atomic<int64_t> clock_offset_us_{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> clock_err_us_{-1};  // atomic: relaxed-counter
   double clock_synced_at_ = 0;
   double clock_adopted_at_ = 0;
   double clock_ping_sent_at_ = 0;
@@ -2007,6 +2007,7 @@ void Core::WaitForWork() {
   }
 }
 
+HVDTPU_ROLE(background)
 void Core::BackgroundLoop() {
   // Sampling profiler: this is the collective-driving thread — the one the
   // flamegraphs are about. Registration creates its (disarmed) per-thread

@@ -129,12 +129,16 @@ class DataPlane {
   ~DataPlane();
 
   // Start listening; returns the bound (ephemeral) port to advertise.
+  HVDTPU_CALLED_ON(background)
   Status Listen();
+  HVDTPU_CALLED_ON(any)
   int port() const { return port_; }
 
   // Establish the mesh: connect to lower ranks, accept from higher ranks.
+  HVDTPU_CALLED_ON(background)
   Status Connect(const std::vector<PeerAddr>& peers);
 
+  HVDTPU_CALLED_ON(background)
   void Shutdown();
 
   // Break every lane NOW: flips the shared IoControl abort flag (sliced
@@ -144,10 +148,13 @@ class DataPlane {
   // the world within ~one detect slice per hop. Idempotent. Must run on the
   // collective-driving thread (same single-driver rule as the collectives;
   // cross-thread callers have the IoControl flags).
+  HVDTPU_CALLED_ON(background)
   void Abort();
+  HVDTPU_CALLED_ON(any)
   bool aborted() const { return io_ctl_.is_aborted(); }
   // First peer a lane failure was pinned on (-1 when none): names the
   // suspect in logs and the coordinator's dead-ranks accounting.
+  HVDTPU_CALLED_ON(background)
   int failed_peer() const { return failed_peer_; }
 
   // Fault-detection knobs (docs/fault-tolerance.md), set before Start's
@@ -155,17 +162,21 @@ class DataPlane {
   // detect_ms/5, clamped to [5, 100] ms); read_deadline_secs > 0 declares a
   // silent-but-open lane dead after that long with zero progress (0 = off);
   // formup_timeout_ms bounds Connect's accept phase.
+  HVDTPU_CALLED_ON(background)
   void set_failure_detect_ms(int64_t ms) {
     if (ms <= 0) return;
     int64_t slice = ms / 5;
     io_ctl_.detect_slice_ms = slice < 5 ? 5 : (slice > 100 ? 100 : slice);
   }
+  HVDTPU_CALLED_ON(background)
   void set_read_deadline_secs(double s) {
     io_ctl_.read_deadline_secs = s > 0 ? s : 0;
   }
+  HVDTPU_CALLED_ON(background)
   void set_formup_timeout_ms(int64_t ms) {
     if (ms > 0) formup_timeout_ms_ = ms;
   }
+  HVDTPU_CALLED_ON(background)
   void set_chaos(const ChaosSpec& spec) { chaos_ = spec; }
 
   // In-place allreduce over `count` elements (SUM/MIN/MAX/PRODUCT; AVERAGE
@@ -173,49 +184,72 @@ class DataPlane {
   // by the configured algorithm: pipelined ring (reduce-scatter + allgather
   // with segment-level reduce/transfer overlap), recursive doubling, or
   // binomial tree; AUTO selects by message size vs the crossover.
+  HVDTPU_CALLED_ON(background)
   Status Allreduce(void* data, int64_t count, DataType dtype, ReduceOp op);
 
   // Algorithm-selection knobs (hvdtpu_allreduce_algo surface + autotuned
   // crossover). Call from the thread that runs the collectives (the core's
   // background loop) or before it starts; values <= 0 are ignored.
+  HVDTPU_CALLED_ON(background)
   void set_allreduce_algo(AllreduceAlgo algo) { algo_ = algo; }
+  HVDTPU_CALLED_ON(background)
   void set_crossover_bytes(int64_t b) { if (b > 0) crossover_bytes_ = b; }
+  HVDTPU_CALLED_ON(background)
   void set_segment_bytes(int64_t b) { if (b > 0) segment_bytes_ = b; }
   // AUTO's scatter-allgather gate: groups of at least this many ranks take
   // SA above the crossover (0 = never). set_sa_auto is the autotuner's
   // per-cycle choice on top of the static gate, mirroring set_hier_auto.
+  HVDTPU_CALLED_ON(background)
   void set_sa_min_group(int64_t n) { if (n >= 0) sa_min_group_ = static_cast<int>(n); }
+  HVDTPU_CALLED_ON(background)
   void set_sa_auto(bool on) { sa_auto_ = on; }
   // Broadcast flat/tree crossover (HVDTPU_BCAST_FLAT_MAX; 0 = always tree).
+  HVDTPU_CALLED_ON(background)
   void set_bcast_flat_max(int64_t b) { if (b >= 0) bcast_flat_max_ = b; }
+  HVDTPU_CALLED_ON(background)
   int64_t bcast_flat_max() const { return bcast_flat_max_; }
+  HVDTPU_CALLED_ON(background)
   AllreduceAlgo allreduce_algo() const { return algo_; }
+  HVDTPU_CALLED_ON(background)
   int64_t crossover_bytes() const { return crossover_bytes_; }
+  HVDTPU_CALLED_ON(background)
   int64_t segment_bytes() const { return segment_bytes_; }
+  HVDTPU_CALLED_ON(background)
   int sa_min_group() const { return sa_min_group_; }
+  HVDTPU_CALLED_ON(background)
   bool sa_auto() const { return sa_auto_; }
 
   // Transport / topology knobs. set_shm_enabled and set_shm_ring_bytes must
   // be called before Connect (the lanes are negotiated there); hier mode may
   // change any time from the collective-driving thread, and set_hier_auto is
   // the autotuner's choice under HierMode::AUTO.
+  HVDTPU_CALLED_ON(background)
   void set_shm_enabled(bool on) { shm_enabled_ = on; }
+  HVDTPU_CALLED_ON(background)
   void set_shm_ring_bytes(int64_t b) { if (b > 0) shm_ring_bytes_ = b; }
+  HVDTPU_CALLED_ON(background)
   void set_hier_mode(HierMode m) { hier_mode_ = m; }
+  HVDTPU_CALLED_ON(background)
   void set_hier_auto(bool on) { hier_auto_ = on; }
   // Zero-copy lane knobs (PR 9; docs/collectives.md "Zero-copy TCP lane").
   // Must be set before Connect: the TCP lanes probe at construction, the
   // shm lanes take their doorbell/NUMA policy at negotiation.
+  HVDTPU_CALLED_ON(background)
   void set_tcp_zerocopy(ZeroCopyMode m) { tcp_zerocopy_ = m; }
+  HVDTPU_CALLED_ON(background)
   void set_shm_numa(ShmNumaMode m) { shm_numa_ = m; }
+  HVDTPU_CALLED_ON(background)
   void set_doorbell_batch(int64_t b) { if (b > 0) doorbell_batch_ = b; }
+  HVDTPU_CALLED_ON(background)
   ZeroCopyMode tcp_zerocopy() const { return tcp_zerocopy_; }
+  HVDTPU_CALLED_ON(background)
   HierMode hier_mode() const { return hier_mode_; }
   // True when Allreduce will take the two-level path: hier requested (or
   // autotuned on) and at least one host holds 2+ ranks. The predicate must
   // be identical on EVERY rank (it's a world-level property — leaders_ and
   // size_ agree everywhere), or ranks would split between the flat and
   // hierarchical schedules and deadlock.
+  HVDTPU_CALLED_ON(background)
   bool hier_active() const {
     if (size_ <= 1 || leaders_.size() >= static_cast<size_t>(size_)) {
       return false;  // every host single-rank: hier degenerates to flat
@@ -226,17 +260,22 @@ class DataPlane {
   // Per-peer shm-ring occupancy (peer rank, buffered bytes) for the
   // memory-occupancy telemetry gauges (docs/profiling.md). Background
   // thread only, like the other lane walks.
+  HVDTPU_CALLED_ON(background)
   void ShmOccupancy(std::vector<std::pair<int, int64_t>>* out) const;
   // Lane summary for the timeline / introspection: "tcp", "tcp-zc", "shm",
   // "shm+tcp", "shm+tcp-zc" ("local" before Connect / at size 1). Rebuilt
   // per call because the zero-copy tag is LIVE: an AUTO lane that detects
   // kernel-copied completions downgrades itself mid-run and the per-op
   // metric/timeline labels must follow. Collective-driving thread only.
+  HVDTPU_CALLED_ON(background)
   const std::string& transport_label();
+  HVDTPU_CALLED_ON(background)
   int shm_lane_count() const;  // peers reached over shared memory
   // Any TCP lane currently riding the zero-copy engine? (introspection +
   // tests; background thread only, like the label.)
+  HVDTPU_CALLED_ON(background)
   bool zerocopy_active() const;
+  HVDTPU_CALLED_ON(background)
   int num_hosts() const { return static_cast<int>(leaders_.size()); }
 
   // Per-op wire compression (compressed.h). The core calls
@@ -252,6 +291,7 @@ class DataPlane {
   // (gradstats.h) threaded into every WireCompress call this op makes —
   // the core reads MSE/SNR/residual-norm out of it at op completion
   // (docs/numerics.md).
+  HVDTPU_CALLED_ON(background)
   void BeginCompressedOp(WireCompression c, float* residual,
                          GradQuality* quality = nullptr) {
     op_comp_ = c == WireCompression::AUTO ? WireCompression::NONE : c;
@@ -259,6 +299,7 @@ class DataPlane {
     op_quality_ = quality;
     if (quality != nullptr) quality->Reset();
   }
+  HVDTPU_CALLED_ON(background)
   void EndCompressedOp() {
     op_comp_ = WireCompression::NONE;
     op_residual_ = nullptr;
@@ -273,15 +314,20 @@ class DataPlane {
   // source of truth behind both hvdtpu_wire_stats and /metrics — whose
   // lock-free counters user threads may read while the background thread
   // runs ops.
+  HVDTPU_CALLED_ON(background)
   int64_t op_raw_bytes() const { return op_raw_bytes_; }
+  HVDTPU_CALLED_ON(background)
   int64_t op_wire_bytes() const { return op_wire_bytes_; }
+  HVDTPU_CALLED_ON(any)
   int64_t total_raw_bytes() const { return raw_bytes_total_->Get(); }
+  HVDTPU_CALLED_ON(any)
   int64_t total_wire_bytes() const { return wire_bytes_total_->Get(); }
 
   // Metrics registry to account into. The DataPlane constructor wires up a
   // private registry so standalone instances (unit tests, bench harness)
   // always have live counters; the core injects its own registry before
   // Listen() so data-plane series land in the worker's /metrics dump.
+  HVDTPU_CALLED_ON(background)
   void set_metrics(Metrics* m);
 
   // Distributed tracing (docs/tracing.md): per-hop SEND/RECV/SENDRECV/
@@ -290,36 +336,48 @@ class DataPlane {
   // pays one branch per hop. The tracer outlives the plane (core owns
   // both); both setters are collective-driving-thread-only like the other
   // knobs (the core's ApplyTimelineRequest runs there).
+  HVDTPU_CALLED_ON(background)
   void set_tracer(Timeline* t) { tracer_ = t; }
+  HVDTPU_CALLED_ON(background)
   void set_trace_sample(int64_t n) { trace_sampler_.set_every_n(n); }
+  HVDTPU_CALLED_ON(background)
   int64_t trace_sample() const { return trace_sampler_.every_n(); }
   // Always-on flight recorder (flightrec.h): every hop/reduce/quantize and
   // failure-detect event lands in the ring UNSAMPLED — five relaxed atomic
   // stores per event, no JSON — alongside whatever the sampled tracer
   // emits. Set before Connect (core owns the recorder; nullptr disables).
+  HVDTPU_CALLED_ON(background)
   void set_flightrec(FlightRecorder* fr) {
     flight_ = fr != nullptr && fr->enabled() ? fr : nullptr;
   }
   // True while the CURRENT op is being sampled (core gates its own
   // tensor-level FUSION-WAIT spans on the same decision).
+  HVDTPU_CALLED_ON(background)
   bool trace_sampling_op() const { return trace_op_; }
   // Always-on perf attribution (perfstats.h): when enabled, TraceHop also
   // accumulates this op's wait/wire/reduce/codec phase buckets (and the
   // slowest hop peer) unsampled — the core feeds them into PerfStats at op
   // completion. Same timestamping gate the flight recorder already pays.
+  HVDTPU_CALLED_ON(background)
   void set_perf_enabled(bool on) { perf_on_ = on; }
+  HVDTPU_CALLED_ON(background)
   int64_t op_wait_us() const { return op_wait_us_; }
+  HVDTPU_CALLED_ON(background)
   int64_t op_wire_us() const { return op_wire_us_; }
+  HVDTPU_CALLED_ON(background)
   int64_t op_reduce_us() const { return op_reduce_us_; }
+  HVDTPU_CALLED_ON(background)
   int64_t op_codec_us() const { return op_codec_us_; }
   // Hop peer this op spent the most wait time on (-1 none): the wire-slow
   // anomaly's named suspect. Background thread only, like the accumulators.
+  HVDTPU_CALLED_ON(background)
   int op_slow_peer() const { return op_slow_peer_; }
   // Label of the algorithm the LAST Allreduce actually ran ("ring",
   // "recursive_doubling", "tree", "scatter_allgather", "parameter_server",
   // with AUTO resolved by size; "hier" phases report the top-level
   // "hierarchical"). Background thread only — set by Allreduce, read by the
   // core's per-op metric labels.
+  HVDTPU_CALLED_ON(background)
   const char* last_algo_label() const { return last_algo_label_; }
 
   // First-class allgather (PR 18): gather variable-length byte blocks from
@@ -334,6 +392,7 @@ class DataPlane {
   // the owner included, via self-decode — decodes identical codes, so the
   // gathered vectors are bitwise identical world-wide. Full op lifecycle
   // (chaos trigger, cumulative byte counters, perf phases) like Allreduce.
+  HVDTPU_CALLED_ON(background)
   Status Allgatherv(const void* in, int64_t in_bytes,
                     const std::vector<int64_t>& block_bytes,
                     ByteBuf* out);
@@ -349,6 +408,7 @@ class DataPlane {
   // not a gradient stream). Full op lifecycle like Allreduce: chaos trigger,
   // cumulative byte counters, perf phases, algo label ("bcast_tree" /
   // "bcast_flat").
+  HVDTPU_CALLED_ON(background)
   Status Broadcast(void* data, int64_t bytes, int root);
 
   // First-class pairwise alltoallv (PR 19): send_bytes[r] from my buffer to
@@ -360,6 +420,7 @@ class DataPlane {
   // block self-decodes through the same codec) and decoded at its one
   // receiver — single-hop determinism needs no forwarding discipline. Full
   // op lifecycle like Allreduce; algo label "pairwise".
+  HVDTPU_CALLED_ON(background)
   Status Alltoallv(const void* in, const std::vector<int64_t>& send_bytes,
                    const std::vector<int64_t>& recv_bytes,
                    ByteBuf* out);
@@ -376,6 +437,7 @@ class DataPlane {
   // quantized hops as the compressed ring allreduce's first half. The
   // public op requires count % size == 0 (validated by the coordinator);
   // standalone callers may pass ragged counts and get the ragged chunk.
+  HVDTPU_CALLED_ON(background)
   Status ReduceScatter(const void* in, int64_t count, DataType dtype,
                        ReduceOp op, ByteBuf* out);
 
@@ -383,6 +445,7 @@ class DataPlane {
   // with the adaptive combine a*(1 - dot/2|a|^2) + b*(1 - dot/2|b|^2)
   // (reference: horovod/common/ops/adasum/adasum.h:38). Non-power-of-two
   // worlds fold extra ranks in by addition first, like the Python/XLA path.
+  HVDTPU_CALLED_ON(background)
   Status AdasumAllreduce(void* data, int64_t count, DataType dtype);
 
  private:

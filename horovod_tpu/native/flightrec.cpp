@@ -77,7 +77,7 @@ int FlightRecorder::InternName(const std::string& name) {
   if (!enabled()) return 0;
   auto it = name_ids_.find(name);
   if (it != name_ids_.end()) return it->second;
-  uint32_t n = name_count_.load(std::memory_order_relaxed);
+  uint32_t n = name_count_.load(std::memory_order_relaxed);  // atomic-ok: single-writer reads its own count
   if (n >= kFlightMaxNames) {
     name_ids_.emplace(name, 0);  // memoize the overflow verdict too
     return 0;
@@ -250,19 +250,20 @@ void FlightRecorder::SignalDump(int signo) {
 
 namespace {
 
-std::atomic<FlightRecorder*> g_signal_recorder{nullptr};
+std::atomic<FlightRecorder*> g_signal_recorder{nullptr};  // atomic: seqcst(publish/drain pairs with g_handler_active)
 // Handshake with ClearSignalFlightRecorder: a handler enters (increments)
 // BEFORE loading the recorder pointer, so the clearing thread can null the
 // pointer and then drain the count, guaranteeing no handler still holds a
 // recorder whose buffers its destructor is about to free. Both sides use
 // seq_cst: a handler that observed a non-null pointer ordered its increment
 // before the clearer's null store, so the drain loop must see it.
-std::atomic<int> g_handler_active{0};
+std::atomic<int> g_handler_active{0};  // atomic: seqcst(handler-drain handshake, see comment above)
 constexpr int kFlightSignals[] = {SIGSEGV, SIGBUS, SIGABRT, SIGTERM};
 struct sigaction g_prev_actions[sizeof(kFlightSignals) /
                                 sizeof(kFlightSignals[0])];
-std::atomic<bool> g_handlers_installed{false};
+std::atomic<bool> g_handlers_installed{false};  // atomic: seqcst(install-once exchange)
 
+HVDTPU_ROLE(signal)
 void FlightSignalHandler(int signo) {
   g_handler_active.fetch_add(1);
   FlightRecorder* rec = g_signal_recorder.load();
@@ -283,7 +284,7 @@ void FlightSignalHandler(int signo) {
 }  // namespace
 
 void SetSignalFlightRecorder(FlightRecorder* rec) {
-  g_signal_recorder.store(rec, std::memory_order_release);
+  g_signal_recorder.store(rec);  // seq_cst: pairs with the handler's seq_cst load
 }
 
 void ClearSignalFlightRecorder(FlightRecorder* rec) {

@@ -33,6 +33,8 @@
 #include <string>
 #include <unordered_map>
 
+#include "thread_roles.h"
+
 namespace hvdtpu {
 
 // Record type tags. Mirrored in horovod_tpu/flightrec.py FLIGHT_EVENTS
@@ -113,30 +115,38 @@ class FlightRecorder {
   // capacity <= 0 disables (every Record() is one branch). dump_dir may be
   // empty: recording and Snapshot() still work, automatic file dumps are
   // skipped. Call before the background loop starts.
+  HVDTPU_CALLED_ON(background)
   void Configure(int64_t capacity, const std::string& dump_dir, int rank,
                  int world_size);
+  HVDTPU_CALLED_ON(any)
   bool enabled() const { return cap_ > 0; }
+  HVDTPU_CALLED_ON(any)
   int rank() const { return rank_; }
   // "<dump_dir>/flightrec.<rank>.bin" ("" when no dir configured).
+  HVDTPU_CALLED_ON(any)
   const std::string& dump_path() const { return dump_path_; }
 
   // Intern `name` -> id (>= 1; 0 = the shared overflow slot once the table
   // fills; pass -1 to Record for nameless events). Background thread only.
+  HVDTPU_CALLED_ON(background)
   int InternName(const std::string& name);
 
   // One ring write: five relaxed atomic word stores after a fetch_add slot
   // claim. name_id -1 = nameless; arg carries the event-specific scalar
   // (hop wait_us, OP_END status, signal number, ...).
+  HVDTPU_CALLED_ON(any)
   void Record(FlightEvent type, int name_id, int64_t bytes, int send_peer,
               int recv_peer, int64_t t0_us, int64_t t1_us, int64_t arg,
               uint16_t lane);
 
   // Clock offset vs rank 0 (PR-8 sync), recorded into every dump header.
+  HVDTPU_CALLED_ON(any)
   void SetClock(int64_t offset_us, int64_t err_us) {
     clock_offset_us_.store(offset_us, std::memory_order_relaxed);
     clock_err_us_.store(err_us, std::memory_order_relaxed);
   }
 
+  HVDTPU_CALLED_ON(any)
   int64_t record_count() const {
     return next_.load(std::memory_order_relaxed);
   }
@@ -144,17 +154,20 @@ class FlightRecorder {
   // Serialized dump image: header + name table + records oldest-first.
   // Callable from any thread (concurrent writers may overwrite the oldest
   // slots mid-copy; forensics tolerates a torn tail, never a torn word).
+  HVDTPU_CALLED_ON(any)
   std::string Snapshot(DumpReason reason, int32_t detail) const;
 
   // Write Snapshot() to `path` (empty = the configured dump_path). Returns
   // true on success. `fatal_once` dumps are latched: only the FIRST fatal
   // trigger (abort/stall/signal) writes, so a cascade of failures cannot
   // overwrite the record of the original one; on-demand dumps always write.
+  HVDTPU_CALLED_ON(any)
   bool DumpToFile(DumpReason reason, int32_t detail,
                   const std::string& path = "", bool fatal_once = false);
 
   // Async-signal-safe dump to the precomposed path (open/write/close +
   // atomic loads only). No-op without a configured dump dir.
+  HVDTPU_CALLED_ON(signal)
   void SignalDump(int signo);
 
  private:
@@ -165,17 +178,17 @@ class FlightRecorder {
   int rank_ = 0;
   int world_size_ = 1;
   std::string dump_path_;
-  std::unique_ptr<std::atomic<uint64_t>[]> words_;  // cap_ * kRecordWords
-  std::atomic<int64_t> next_{0};  // total records ever written
+  std::unique_ptr<std::atomic<uint64_t>[]> words_;  // cap_ * kRecordWords  // atomic: relaxed-counter
+  std::atomic<int64_t> next_{0};  // total records ever written  // atomic: relaxed-counter
   // Interned names: entries [0, name_count_) are immutable once published
   // (fill slot, then release-store the count). Slot 0 is reserved for
   // "<names-overflowed>" so ids stay >= 1 for real names.
   std::unique_ptr<char[]> names_;  // kFlightMaxNames * kFlightNameBytes
-  std::atomic<uint32_t> name_count_{0};
+  std::atomic<uint32_t> name_count_{0};  // atomic: release-publish
   std::unordered_map<std::string, int> name_ids_;  // background thread only
-  std::atomic<int64_t> clock_offset_us_{0};
-  std::atomic<int64_t> clock_err_us_{-1};
-  std::atomic<bool> fatal_dumped_{false};
+  std::atomic<int64_t> clock_offset_us_{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> clock_err_us_{-1};  // atomic: relaxed-counter
+  std::atomic<bool> fatal_dumped_{false};  // atomic: seqcst(one-shot fatal-dump latch)
 };
 
 // Process-wide recorder the fatal-signal handlers dump (the most recently

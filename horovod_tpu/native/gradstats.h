@@ -43,6 +43,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include "thread_roles.h"
+
 namespace hvdtpu {
 
 enum class WireCompression : int32_t;  // compressed.h
@@ -80,6 +82,7 @@ struct GradMoments {
   int64_t nonfinite = 0;  // NaN + Inf elements
   int64_t count = 0;
 
+  HVDTPU_CALLED_ON(background)
   void Merge(const GradMoments& o) {
     sumsq += o.sumsq;
     if (o.absmax > absmax) absmax = o.absmax;
@@ -111,6 +114,7 @@ struct GradQuality {
   double sig2 = 0;
   int64_t count = 0;
 
+  HVDTPU_CALLED_ON(background)
   void Reset() {
     err2 = 0;
     sig2 = 0;
@@ -133,25 +137,25 @@ struct GradSlot {
   std::atomic_flag lock = ATOMIC_FLAG_INIT;
 
   // Published, lock-free readable.
-  std::atomic<int64_t> count{0};
-  std::atomic<double> pub_norm{0};       // last L2 norm
-  std::atomic<double> pub_ewma_norm{0};  // EWMA of the norm
-  std::atomic<double> pub_absmax{0};     // last absmax
-  std::atomic<int64_t> nonfinite{0};     // cumulative NaN/Inf elements
+  std::atomic<int64_t> count{0};  // atomic: relaxed-counter
+  std::atomic<double> pub_norm{0};       // last L2 norm  // atomic: relaxed-counter
+  std::atomic<double> pub_ewma_norm{0};  // EWMA of the norm  // atomic: relaxed-counter
+  std::atomic<double> pub_absmax{0};     // last absmax  // atomic: relaxed-counter
+  std::atomic<int64_t> nonfinite{0};     // cumulative NaN/Inf elements  // atomic: relaxed-counter
   // Quantization quality (zero q_count = never compressed: dense layer or
   // skip-regex match — the /gradz report omits SNR for these).
-  std::atomic<int64_t> q_count{0};
-  std::atomic<double> pub_mse{0};
-  std::atomic<double> pub_snr_db{0};
-  std::atomic<double> pub_ewma_snr_db{0};
-  std::atomic<double> pub_res_norm{0};  // post-op EF residual norm
-  std::atomic<int32_t> comp{0};         // last WireCompression code
+  std::atomic<int64_t> q_count{0};  // atomic: relaxed-counter
+  std::atomic<double> pub_mse{0};  // atomic: relaxed-counter
+  std::atomic<double> pub_snr_db{0};  // atomic: relaxed-counter
+  std::atomic<double> pub_ewma_snr_db{0};  // atomic: relaxed-counter
+  std::atomic<double> pub_res_norm{0};  // post-op EF residual norm  // atomic: relaxed-counter
+  std::atomic<int32_t> comp{0};         // last WireCompression code  // atomic: relaxed-counter
   // NONFINITE WARN/flight-event throttle stamp (steady us; 0 = never).
   // Same per-key CAS window as PerfSlot::last_warn_us: a tensor that went
   // NaN floods hundreds of ops per second, and an unthrottled event per
   // op would evict the op/hop records a post-mortem needs from the
   // flight ring. The counters stay exact; only the log + ring ride this.
-  std::atomic<int64_t> last_warn_us{0};
+  std::atomic<int64_t> last_warn_us{0};  // atomic: relaxed-counter
 
   std::string key;  // immutable once the slot is published
 };
@@ -161,51 +165,67 @@ class GradStats {
   // enabled=false turns every Record* into one branch. sample_n is the
   // divergence probe's every-Nth-op rate (0 disables the probe; moments
   // and quality still stream). Call before the background loop starts.
+  HVDTPU_CALLED_ON(background)
   void Configure(bool enabled, NanPolicy policy, int64_t sample_n);
+  HVDTPU_CALLED_ON(any)
   bool enabled() const { return enabled_; }
+  HVDTPU_CALLED_ON(any)
   NanPolicy nan_policy() const { return policy_; }
+  HVDTPU_CALLED_ON(any)
   int64_t gradcheck_sample() const { return sample_n_; }
 
   // Intern `key` -> slot id (>= 1; 0 = the shared overflow slot once the
   // table fills). Background (collective-driving) thread only, like
   // PerfStats::KeySlot.
+  HVDTPU_CALLED_ON(background)
   int KeySlot(const std::string& key);
 
   // Record one tensor's copy-in moments against `slot`. Thread-safe
   // (per-slot spinlock); no allocation.
+  HVDTPU_CALLED_ON(background)
   void RecordMoments(int slot, const GradMoments& m);
 
   // Record one compressed op's quantization quality against `slot`.
+  HVDTPU_CALLED_ON(background)
   void RecordQuality(int slot, WireCompression c, const GradQuality& q);
 
   // Per-key throttle for the NONFINITE WARN + flight record: true at most
   // once per min_gap_us per slot (the first event of a key always
   // passes). CAS on the slot's stamp — thread-safe, one winner.
+  HVDTPU_CALLED_ON(background)
   bool ShouldWarnNonfinite(int slot, int64_t now_us,
                            int64_t min_gap_us = 1000000);
 
   // Cumulative event counters (the snapshot's totals; the matching
   // Prometheus counters live in the core's registry).
+  HVDTPU_CALLED_ON(background)
   void NoteNonfinite(int64_t elements) {
     nonfinite_total_.fetch_add(elements, std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(background)
   void NoteProbe() { probes_total_.fetch_add(1, std::memory_order_relaxed); }
+  HVDTPU_CALLED_ON(background)
   void NoteDivergence() {
     divergence_total_.fetch_add(1, std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(background)
   void NoteResidualReset() {
     residual_resets_total_.fetch_add(1, std::memory_order_relaxed);
   }
 
+  HVDTPU_CALLED_ON(any)
   int64_t nonfinite_total() const {
     return nonfinite_total_.load(std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(any)
   int64_t probes_total() const {
     return probes_total_.load(std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(any)
   int64_t divergence_total() const {
     return divergence_total_.load(std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(any)
   int64_t residual_resets_total() const {
     return residual_resets_total_.load(std::memory_order_relaxed);
   }
@@ -213,9 +233,12 @@ class GradStats {
   // Keyed-health snapshot as JSON (the /gradz payload and the body of
   // grad_profile.<rank>.json). Readers touch atomics + immutable keys only
   // — callable from any thread while writers run.
+  HVDTPU_CALLED_ON(any)
   std::string SnapshotJson() const;
 
+  HVDTPU_CALLED_ON(any)
   int slot_count() const { return nslots_.load(std::memory_order_acquire); }
+  HVDTPU_CALLED_ON(any)
   const GradSlot* slot(int i) const {  // tests/introspection
     return i >= 0 && i < slot_count() ? &slots_[i] : nullptr;
   }
@@ -225,12 +248,12 @@ class GradStats {
   NanPolicy policy_ = NanPolicy::WARN;
   int64_t sample_n_ = 0;
   std::unique_ptr<GradSlot[]> slots_;
-  std::atomic<int> nslots_{0};
+  std::atomic<int> nslots_{0};  // atomic: release-publish
   std::unordered_map<std::string, int> key_ids_;  // background thread only
-  std::atomic<int64_t> nonfinite_total_{0};
-  std::atomic<int64_t> probes_total_{0};
-  std::atomic<int64_t> divergence_total_{0};
-  std::atomic<int64_t> residual_resets_total_{0};
+  std::atomic<int64_t> nonfinite_total_{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> probes_total_{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> divergence_total_{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> residual_resets_total_{0};  // atomic: relaxed-counter
 };
 
 }  // namespace hvdtpu

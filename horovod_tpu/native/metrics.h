@@ -42,7 +42,7 @@ class Counter {
   int64_t Get() const { return v_.load(std::memory_order_relaxed); }
 
  private:
-  std::atomic<int64_t> v_{0};
+  std::atomic<int64_t> v_{0};  // atomic: relaxed-counter
 };
 
 class Gauge {
@@ -51,7 +51,7 @@ class Gauge {
   double Get() const { return v_.load(std::memory_order_relaxed); }
 
  private:
-  std::atomic<double> v_{0.0};
+  std::atomic<double> v_{0.0};  // atomic: relaxed-counter
 };
 
 // Fixed-bucket histogram. Bounds are the upper edges of the non-infinite
@@ -63,7 +63,8 @@ class Histogram {
   explicit Histogram(std::vector<double> bounds)
       : bounds_(std::move(bounds)),
         buckets_(new std::atomic<int64_t>[bounds_.size() + 1]) {
-    for (size_t i = 0; i <= bounds_.size(); ++i) buckets_[i].store(0);
+    for (size_t i = 0; i <= bounds_.size(); ++i)
+      buckets_[i].store(0, std::memory_order_relaxed);
   }
 
   void Observe(double v) {
@@ -91,8 +92,8 @@ class Histogram {
 
  private:
   std::vector<double> bounds_;
-  std::unique_ptr<std::atomic<int64_t>[]> buckets_;
-  std::atomic<double> sum_{0.0};
+  std::unique_ptr<std::atomic<int64_t>[]> buckets_;  // atomic: relaxed-counter
+  std::atomic<double> sum_{0.0};  // atomic: relaxed-counter
 };
 
 // Canonical bucket menus for the instrumented subsystems (exponential;

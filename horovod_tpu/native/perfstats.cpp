@@ -221,7 +221,7 @@ int PerfStats::KeySlot(const std::string& key) {
   if (!enabled_) return 0;
   auto it = key_ids_.find(key);
   if (it != key_ids_.end()) return it->second;
-  const int n = nslots_.load(std::memory_order_relaxed);
+  const int n = nslots_.load(std::memory_order_relaxed);  // atomic-ok: single-writer reads its own count
   if (n >= kPerfMaxKeys) return 0;  // table full: share the overflow slot
   InitSlot(&slots_[n], key);
   nslots_.store(n + 1, std::memory_order_release);  // publish complete slot

@@ -33,6 +33,8 @@
 #include <string>
 #include <unordered_map>
 
+#include "thread_roles.h"
+
 namespace hvdtpu {
 
 // Phase buckets the streaming statistics track per key. Mirrored in
@@ -70,14 +72,18 @@ constexpr int kPerfSampleRing = 64;
 // readers see the published value through PerfStats' atomics, never this.
 class P2Quantile {
  public:
+  HVDTPU_CALLED_ON(background)
   void Init(double q) {
     q_ = q;
     n_ = 0;
   }
+  HVDTPU_CALLED_ON(background)
   void Observe(double x);
   // Current estimate: exact while n < 5 (sorted initial buffer), the P²
   // middle marker after.
+  HVDTPU_CALLED_ON(any)
   double Value() const;
+  HVDTPU_CALLED_ON(any)
   int64_t count() const { return n_; }
 
  private:
@@ -102,19 +108,19 @@ struct PerfSlot {
   std::atomic_flag lock = ATOMIC_FLAG_INIT;
 
   // Published, lock-free readable.
-  std::atomic<int64_t> count{0};
-  std::atomic<double> pub_ewma[kPerfPhases] = {};
-  std::atomic<double> pub_p50[kPerfPhases] = {};
-  std::atomic<double> pub_p99[kPerfPhases] = {};
-  std::atomic<int64_t> anomalies{0};
-  std::atomic<int64_t> last_wall_us{0};
-  std::atomic<int64_t> samples[kPerfSampleRing] = {};
+  std::atomic<int64_t> count{0};  // atomic: relaxed-counter
+  std::atomic<double> pub_ewma[kPerfPhases] = {};  // atomic: relaxed-counter
+  std::atomic<double> pub_p50[kPerfPhases] = {};  // atomic: relaxed-counter
+  std::atomic<double> pub_p99[kPerfPhases] = {};  // atomic: relaxed-counter
+  std::atomic<int64_t> anomalies{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> last_wall_us{0};  // atomic: relaxed-counter
+  std::atomic<int64_t> samples[kPerfSampleRing] = {};  // atomic: relaxed-counter
   // Sentry WARN throttle stamp, PER KEY (steady-clock us; 0 = never
   // warned). A global 1/s throttle let one chatty slow key starve the
   // first warning for a second, different key — the operator's "rank N
   // just went codec-bound" signal. CAS-claimed so concurrent writers
   // (the TSan fixture) warn at most once per window per key.
-  std::atomic<int64_t> last_warn_us{0};
+  std::atomic<int64_t> last_warn_us{0};  // atomic: relaxed-counter
 
   std::string key;  // immutable once the slot is published
 };
@@ -125,15 +131,20 @@ class PerfStats {
   // disables the sentry (baselines still stream); min_samples is the
   // per-key warmup before the sentry may fire. Call before the background
   // loop starts.
+  HVDTPU_CALLED_ON(background)
   void Configure(bool enabled, double slowdown_pct, int64_t min_samples);
+  HVDTPU_CALLED_ON(any)
   bool enabled() const { return enabled_; }
+  HVDTPU_CALLED_ON(any)
   double slowdown_pct() const { return slowdown_pct_; }
+  HVDTPU_CALLED_ON(any)
   int64_t min_samples() const { return min_samples_; }
 
   // Intern `key` -> slot id (>= 1; 0 = the shared overflow slot once the
   // table fills). Background (collective-driving) thread only — it owns
   // the lookup map, like FlightRecorder::InternName. The slot itself is
   // release-published so snapshot readers only see complete entries.
+  HVDTPU_CALLED_ON(background)
   int KeySlot(const std::string& key);
 
   struct OpSample {
@@ -157,6 +168,7 @@ class PerfStats {
   // slowdown_pct. The overflow slot 0 streams stats but never sentries
   // (its baseline mixes unrelated keys). Thread-safe (per-slot spinlock);
   // no allocation.
+  HVDTPU_CALLED_ON(background)
   Anomaly RecordOp(int slot, const OpSample& s);
 
   // Per-key WARN throttle for the sentry's log line: true at most once per
@@ -164,20 +176,25 @@ class PerfStats {
   // cannot starve a different key's first warning). The counter and flight
   // ring record every anomaly regardless; only the LOG rides this. CAS on
   // the slot's stamp, so it is thread-safe and claims exactly one winner.
+  HVDTPU_CALLED_ON(background)
   bool ShouldWarn(int slot, int64_t now_us,
                   int64_t min_gap_us = 1000000);
 
   // Keyed-baseline snapshot as JSON (the /perfz payload and the body of
   // perf_profile.<rank>.json). Readers touch atomics + immutable keys only
   // — callable from any thread while writers run.
+  HVDTPU_CALLED_ON(any)
   std::string SnapshotJson() const;
 
+  HVDTPU_CALLED_ON(any)
   int slot_count() const {
     return nslots_.load(std::memory_order_acquire);
   }
+  HVDTPU_CALLED_ON(any)
   int64_t anomalies_total() const {
     return anomalies_total_.load(std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(any)
   const PerfSlot* slot(int i) const {  // tests/introspection
     return i >= 0 && i < slot_count() ? &slots_[i] : nullptr;
   }
@@ -187,9 +204,9 @@ class PerfStats {
   double slowdown_pct_ = 50.0;
   int64_t min_samples_ = 20;
   std::unique_ptr<PerfSlot[]> slots_;
-  std::atomic<int> nslots_{0};
+  std::atomic<int> nslots_{0};  // atomic: release-publish
   std::unordered_map<std::string, int> key_ids_;  // background thread only
-  std::atomic<int64_t> anomalies_total_{0};
+  std::atomic<int64_t> anomalies_total_{0};  // atomic: relaxed-counter
 };
 
 }  // namespace hvdtpu

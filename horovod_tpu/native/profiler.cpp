@@ -41,9 +41,10 @@ inline void UnpackHeader(uint64_t h, int* nframes, int32_t* phase,
 // Handler-drain handshake (same protocol as the flight recorder's): a
 // handler increments BEFORE loading its thread's profiler pointer; a
 // destructor on another thread drains the count before freeing the ring.
-std::atomic<int> g_prof_handler_active{0};
-std::atomic<bool> g_prof_handler_installed{false};
+std::atomic<int> g_prof_handler_active{0};  // atomic: seqcst(handler-drain handshake)
+std::atomic<bool> g_prof_handler_installed{false};  // atomic: seqcst(install-once exchange)
 
+HVDTPU_ROLE(signal)
 void ProfSignalHandler(int /*signo*/, siginfo_t* /*info*/, void* uc) {
   const int saved_errno = errno;
   g_prof_handler_active.fetch_add(1);
@@ -153,7 +154,7 @@ int SamplingProfiler::InternOp(const std::string& name) {
   if (!enabled_) return 0;
   auto it = op_ids_.find(name);
   if (it != op_ids_.end()) return it->second;
-  uint32_t n = op_count_.load(std::memory_order_relaxed);
+  uint32_t n = op_count_.load(std::memory_order_relaxed);  // atomic-ok: single-writer reads its own count
   if (n >= kProfMaxOps) {
     op_ids_.emplace(name, 0);
     return 0;
@@ -264,7 +265,7 @@ void SamplingProfiler::Stop() {
 }
 
 void SamplingProfiler::Sample(void* ucontext) {
-  if (!running_.load(std::memory_order_relaxed) || cap_ <= 0) return;
+  if (!running_.load(std::memory_order_relaxed) || cap_ <= 0) return;  // atomic-ok: async-signal gate; stale read only costs one sample
   ProfThreadState* t = ProfThread();
   uintptr_t pcs[kProfMaxFrames];
   int n = 0;

@@ -81,8 +81,8 @@ class SamplingProfiler;
 // pointer walk — every dereference is range-checked against the thread's
 // own mapped stack, so a broken chain terminates instead of faulting.
 struct ProfThreadState {
-  std::atomic<int32_t> phase{-1};  // PerfPhase code; -1 = outside any op
-  std::atomic<int32_t> op_id{0};   // interned op slot (0 = none)
+  std::atomic<int32_t> phase{-1};  // PerfPhase code; -1 = outside any op  // atomic: relaxed-counter
+  std::atomic<int32_t> op_id{0};   // interned op slot (0 = none)  // atomic: relaxed-counter
   uintptr_t stack_lo = 0;
   uintptr_t stack_hi = 0;
   timer_t timer{};
@@ -163,54 +163,72 @@ class SamplingProfiler {
   // enabled=false turns every other entry point into one branch. hz <= 0
   // keeps the default; capacity <= 0 keeps the default ring size. Call
   // before threads register (the core does this pre-Start).
+  HVDTPU_CALLED_ON(background)
   void Configure(bool enabled, int hz, int64_t capacity, ProfClock clock,
                  int rank);
+  HVDTPU_CALLED_ON(any)
   bool enabled() const { return enabled_; }
+  HVDTPU_CALLED_ON(any)
   int hz() const { return hz_; }
+  HVDTPU_CALLED_ON(any)
   ProfClock clock() const { return clock_; }
+  HVDTPU_CALLED_ON(any)
   int rank() const { return rank_; }
 
   // Create (disarmed) this thread's sampling timer and record its stack
   // bounds; arms immediately when a window is running. No-op when disabled
   // or already registered. UnregisterThread must run on the same thread
   // before it exits (the background loop pairs them RAII-style).
+  HVDTPU_CALLED_ON(any)
   void RegisterThread();
+  HVDTPU_CALLED_ON(any)
   void UnregisterThread();
+  HVDTPU_CALLED_ON(any)
   int registered_threads() const EXCLUDES(mu_);
 
   // Sampling window control. Start clears the ring and arms every
   // registered thread's timer; Stop disarms them. Both idempotent, any
   // thread (/profz, hvd.profile(), the runner's whole-job window).
+  HVDTPU_CALLED_ON(background)
   void Start() EXCLUDES(mu_);
+  HVDTPU_CALLED_ON(background)
   void Stop() EXCLUDES(mu_);
+  HVDTPU_CALLED_ON(any)
   bool running() const {
     return running_.load(std::memory_order_acquire);
   }
 
   // Total samples ever written this window (ring keeps the newest
   // min(count, capacity)).
+  HVDTPU_CALLED_ON(any)
   int64_t sample_count() const {
     return next_.load(std::memory_order_relaxed);
   }
+  HVDTPU_CALLED_ON(any)
   int64_t capacity() const { return cap_; }
 
   // Intern `name` -> op slot (>= 1; 0 = shared overflow). Background
   // (collective-driving) thread only.
+  HVDTPU_CALLED_ON(background)
   int InternOp(const std::string& name);
 
   // One sample: unwind the interrupted thread's frame-pointer chain and
   // write a record. Called from the SIGPROF handler with the handler's
   // ucontext (leaf pc + frame pointer); async-signal-safe.
+  HVDTPU_CALLED_ON(signal)
   void Sample(void* ucontext);
 
   // Folded-stacks JSON (the /profz payload and hvd.profile()'s return):
   // aggregated {phase, op, frames} -> count, symbolized via dladdr at this
   // point only. Any thread, live (tolerates concurrent samplers).
+  HVDTPU_CALLED_ON(any)
   std::string FoldedJson() const;
   // flamegraph.pl-compatible folded lines: "PHASE;op;root;...;leaf N".
+  HVDTPU_CALLED_ON(any)
   std::string FoldedText() const;
   // Write FoldedText to `path` (prof.<rank>.folded). False on I/O failure
   // or when disabled.
+  HVDTPU_CALLED_ON(any)
   bool WriteFolded(const std::string& path) const;
 
  private:
@@ -223,13 +241,13 @@ class SamplingProfiler {
   ProfClock clock_ = ProfClock::CPU;
   int rank_ = 0;
   int64_t cap_ = 0;  // samples in the ring (0 until configured)
-  std::unique_ptr<std::atomic<uint64_t>[]> words_;  // cap_ * kProfRecordWords
-  std::atomic<int64_t> next_{0};
-  std::atomic<bool> running_{false};
+  std::unique_ptr<std::atomic<uint64_t>[]> words_;  // cap_ * kProfRecordWords  // atomic: relaxed-counter
+  std::atomic<int64_t> next_{0};  // atomic: relaxed-counter
+  std::atomic<bool> running_{false};  // atomic: release-publish
   // Interned op names (flight-recorder style publication: fill slot, then
   // release-store the count; readers acquire the count).
   std::unique_ptr<char[]> ops_;  // kProfMaxOps * kProfOpNameBytes
-  std::atomic<uint32_t> op_count_{0};
+  std::atomic<uint32_t> op_count_{0};  // atomic: release-publish
   std::unordered_map<std::string, int> op_ids_;  // background thread only
   mutable Mutex mu_;
   std::vector<ProfThreadState*> threads_ GUARDED_BY(mu_);

@@ -93,22 +93,22 @@ int NumaNodeCount(const std::string& sysfs_dir) {
 // carries the happens-before for the bytes it covers; symmetrically tail
 // hands regions back to the producer for reuse.
 struct alignas(64) ShmRing {
-  std::atomic<uint64_t> head;       // producer cursor
+  std::atomic<uint64_t> head;       // producer cursor  // atomic: release-publish
   char pad0[64 - sizeof(std::atomic<uint64_t>)];
-  std::atomic<uint64_t> tail;       // consumer cursor
+  std::atomic<uint64_t> tail;       // consumer cursor  // atomic: release-publish
   char pad1[64 - sizeof(std::atomic<uint64_t>)];
-  std::atomic<uint32_t> head_seq;   // futex word: bumped on head advance
-  std::atomic<uint32_t> head_waiters;
+  std::atomic<uint32_t> head_seq;   // futex word: bumped on head advance  // atomic: seqcst(futex doorbell protocol, see fences below)
+  std::atomic<uint32_t> head_waiters;  // atomic: seqcst(futex doorbell protocol)
   char pad2[64 - 2 * sizeof(std::atomic<uint32_t>)];
-  std::atomic<uint32_t> tail_seq;   // futex word: bumped on tail advance
-  std::atomic<uint32_t> tail_waiters;
+  std::atomic<uint32_t> tail_seq;   // futex word: bumped on tail advance  // atomic: seqcst(futex doorbell protocol, see fences below)
+  std::atomic<uint32_t> tail_waiters;  // atomic: seqcst(futex doorbell protocol)
   char pad3[64 - 2 * sizeof(std::atomic<uint32_t>)];
 };
 
 struct ShmTransport::Segment {
   uint32_t magic;
-  std::atomic<uint32_t> ready;    // creator sets once initialized
-  std::atomic<uint32_t> aborted;  // either side sets on shutdown/error
+  std::atomic<uint32_t> ready;    // creator sets once initialized  // atomic: release-publish
+  std::atomic<uint32_t> aborted;  // either side sets on shutdown/error  // atomic: acquire-read
   uint32_t reserved;
   uint64_t ring_bytes;
   ShmRing rings[2];  // [0]: creator -> opener, [1]: opener -> creator
@@ -160,14 +160,14 @@ std::unique_ptr<ShmTransport> ShmTransport::Create(const std::string& name,
   }
   auto* seg = new (mem) Segment();
   for (ShmRing& r : seg->rings) {
-    r.head.store(0, std::memory_order_relaxed);
-    r.tail.store(0, std::memory_order_relaxed);
-    r.head_seq.store(0, std::memory_order_relaxed);
-    r.head_waiters.store(0, std::memory_order_relaxed);
-    r.tail_seq.store(0, std::memory_order_relaxed);
-    r.tail_waiters.store(0, std::memory_order_relaxed);
+    r.head.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init; ready.store(release) below publishes
+    r.tail.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init
+    r.head_seq.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init
+    r.head_waiters.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init
+    r.tail_seq.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init
+    r.tail_waiters.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init
   }
-  seg->aborted.store(0, std::memory_order_relaxed);
+  seg->aborted.store(0, std::memory_order_relaxed);  // atomic-ok: pre-publication init
   seg->ring_bytes = ring_bytes;
   seg->magic = kMagic;
   seg->ready.store(1, std::memory_order_release);
@@ -226,8 +226,8 @@ void ShmTransport::Abort() {
   if (seg_ == nullptr) return;
   seg_->aborted.store(1, std::memory_order_release);
   for (ShmRing& r : seg_->rings) {
-    r.head_seq.fetch_add(1, std::memory_order_release);
-    r.tail_seq.fetch_add(1, std::memory_order_release);
+    r.head_seq.fetch_add(1);  // seq_cst: futex doorbell, pairs with waiters' seq_cst re-check
+    r.tail_seq.fetch_add(1);
     FutexWake(&r.head_seq);
     FutexWake(&r.tail_seq);
   }
@@ -277,8 +277,8 @@ int64_t ShmTransport::OccupancyBytes() const {
   int64_t total = 0;
   for (int i = 0; i < 2; ++i) {
     const ShmRing& r = seg_->rings[i];
-    const uint64_t head = r.head.load(std::memory_order_relaxed);
-    const uint64_t tail = r.tail.load(std::memory_order_relaxed);
+    const uint64_t head = r.head.load(std::memory_order_relaxed);  // atomic-ok: monitoring snapshot, torn pair tolerated
+    const uint64_t tail = r.tail.load(std::memory_order_relaxed);  // atomic-ok: monitoring snapshot, torn pair tolerated
     // Free-running cursors: head >= tail modulo concurrent advance; a
     // transiently inverted read (tail racing past a stale head) clamps to 0
     // rather than wrapping to a huge unsigned spread.
@@ -366,7 +366,7 @@ void ShmTransport::FlushDoorbells() {
 
 size_t ShmTransport::TrySend(const uint8_t* buf, size_t len) {
   ShmRing& r = seg_->rings[out_ring_];
-  uint64_t head = r.head.load(std::memory_order_relaxed);  // sole producer
+  uint64_t head = r.head.load(std::memory_order_relaxed);  // atomic-ok: sole producer reads its own cursor
   uint64_t tail = r.tail.load(std::memory_order_acquire);
   size_t free_space = ring_bytes_ - static_cast<size_t>(head - tail);
   if (free_space == 0) return 0;
@@ -379,14 +379,14 @@ size_t ShmTransport::TrySend(const uint8_t* buf, size_t len) {
   // freshest tail tells us whether that drain happened. (Only the
   // coalescing path consults it; the legacy path rings every advance.)
   const bool was_edge =
-      coalesce_ && r.tail.load(std::memory_order_seq_cst) == head;
+      coalesce_ && r.tail.load(std::memory_order_seq_cst) == head;  // atomic-ok: Dekker edge-check, pairs with waiter's seq_cst window
   NotifyHeadAdvance(chunk, was_edge);
   return chunk;
 }
 
 size_t ShmTransport::TryRecv(uint8_t* buf, size_t len) {
   ShmRing& r = seg_->rings[1 - out_ring_];
-  uint64_t tail = r.tail.load(std::memory_order_relaxed);  // sole consumer
+  uint64_t tail = r.tail.load(std::memory_order_relaxed);  // atomic-ok: sole consumer reads its own cursor
   uint64_t head = r.head.load(std::memory_order_acquire);
   size_t avail = static_cast<size_t>(head - tail);
   if (avail == 0) return 0;
@@ -405,7 +405,7 @@ size_t ShmTransport::TryConsumeViews(size_t done, size_t len,
                                      size_t view_align,
                                      const SegmentFn& on_segment) {
   ShmRing& r = seg_->rings[1 - out_ring_];
-  uint64_t tail = r.tail.load(std::memory_order_relaxed);  // sole consumer
+  uint64_t tail = r.tail.load(std::memory_order_relaxed);  // atomic-ok: sole consumer reads its own cursor
   uint64_t head = r.head.load(std::memory_order_acquire);
   size_t avail = static_cast<size_t>(head - tail);
   if (avail == 0) return 0;
@@ -506,7 +506,7 @@ void ShmTransport::WaitOutboundSpace() {
   // blocked-on-peer time (the WAIT bucket the perf attribution measures).
   ProfPhaseScope prof_wait(PerfPhase::WAIT);
   ShmRing& r = seg_->rings[out_ring_];
-  uint64_t head = r.head.load(std::memory_order_relaxed);
+  uint64_t head = r.head.load(std::memory_order_relaxed);  // atomic-ok: sole producer reads its own cursor
   for (int i = 0, spins = SpinIters(); i < spins; ++i) {
     if (r.tail.load(std::memory_order_acquire) + ring_bytes_ != head ||
         AbortedNow()) {
@@ -516,7 +516,7 @@ void ShmTransport::WaitOutboundSpace() {
   if (PeerDead()) return;
   uint32_t seq = r.tail_seq.load(std::memory_order_seq_cst);
   r.tail_waiters.fetch_add(1, std::memory_order_seq_cst);
-  if (r.tail.load(std::memory_order_seq_cst) + ring_bytes_ == head &&
+  if (r.tail.load(std::memory_order_seq_cst) + ring_bytes_ == head &&  // atomic-ok: Dekker re-check between waiter-count bump and futex park
       !AbortedNow()) {
     // Peer-wait accounting (tracing layer): time parked on the futex is
     // time the op stalled on the consumer, not ring bandwidth.
@@ -536,7 +536,7 @@ void ShmTransport::WaitInboundData() {
   // the tail): the in-place view consumer can be blocked on the back half
   // of a wrap-straddled element while the ring is technically non-empty.
   uint64_t observed = r.head.load(std::memory_order_acquire);
-  uint64_t tail = r.tail.load(std::memory_order_relaxed);
+  uint64_t tail = r.tail.load(std::memory_order_relaxed);  // atomic-ok: sole consumer reads its own cursor
   if (observed != tail) {
     // Bytes are available; only a partial element can be waiting. The
     // producer is mid-write — spin briefly, skip the futex (its next
@@ -560,7 +560,7 @@ void ShmTransport::WaitInboundData() {
   if (PeerDead()) return;
   uint32_t seq = r.head_seq.load(std::memory_order_seq_cst);
   r.head_waiters.fetch_add(1, std::memory_order_seq_cst);
-  if (r.head.load(std::memory_order_seq_cst) == observed &&
+  if (r.head.load(std::memory_order_seq_cst) == observed &&  // atomic-ok: Dekker re-check between waiter-count bump and futex park
       !AbortedNow()) {
     // Peer-wait accounting (tracing layer): parked waiting for the
     // producer to publish bytes — the shm analog of a blocked recv().

@@ -33,6 +33,8 @@
 
 #include "transport.h"
 
+#include "thread_roles.h"
+
 namespace hvdtpu {
 
 // Per-direction ring capacity. Must absorb one full in-flight collective
@@ -74,18 +76,24 @@ class ShmTransport : public Transport {
   // (DataPlane derives it from the pair's data-plane ports). Both return
   // null on failure — the caller falls back to TCP after the socket
   // handshake confirms the peer agrees.
+  HVDTPU_CALLED_ON(background)
   static std::unique_ptr<ShmTransport> Create(const std::string& name,
                                               size_t ring_bytes);
+  HVDTPU_CALLED_ON(background)
   static std::unique_ptr<ShmTransport> Open(const std::string& name,
                                             int timeout_ms);
   ~ShmTransport() override;
 
+  HVDTPU_CALLED_ON(any)
   const char* kind() const override { return "shm"; }
+  HVDTPU_CALLED_ON(background)
   int Send(const void* buf, size_t len) override;
+  HVDTPU_CALLED_ON(background)
   int Recv(void* buf, size_t len) override;
   // Zero-copy when on_segment is set: the payload is consumed IN PLACE via
   // ring views (elem-aligned per view_align; buf is untouched scratch).
   // Without a callback, bytes land in buf as before.
+  HVDTPU_CALLED_ON(background)
   int RecvSegmented(void* buf, size_t len, size_t segment_bytes,
                     size_t view_align, const SegmentFn& on_segment) override;
   // Interleaved full-duplex pump on the calling thread: no extra thread —
@@ -93,12 +101,14 @@ class ShmTransport : public Transport {
   // fires segment callbacks as contiguous (aligned) runs complete — in
   // place, like RecvSegmented. The peer's concurrent pump guarantees both
   // directions advance.
+  HVDTPU_CALLED_ON(background)
   int SendRecv(const void* send_buf, size_t send_bytes, void* recv_buf,
                size_t recv_bytes, size_t segment_bytes, size_t view_align,
                const SegmentFn& on_segment) override;
   // The data-plane algorithms exchange matched messages (every byte sent in
   // a step is consumed in the same step), so the ring is drained at each
   // step boundary and a payload no larger than the ring can never block.
+  HVDTPU_CALLED_ON(background)
   bool InlineSendSafe(size_t bytes) const override {
     return bytes <= ring_bytes_;
   }
@@ -106,12 +116,14 @@ class ShmTransport : public Transport {
   // Mark the segment aborted and wake both sides; any blocked ring op
   // (either process) returns -1. Called from DataPlane::Shutdown so a dying
   // rank releases its same-host peers.
+  HVDTPU_CALLED_ON(any)
   void Abort() override;
   // Peer-liveness probe: a SIGKILLed peer can never flip the abort flag, so
   // the wait loops also watch this (otherwise idle) socket to the peer and
   // abort on EOF — checked every wait slice, so a killed peer wakes a
   // blocked waiter within one slice. Optional; without it a dead peer
   // blocks until the caller tears the plane down.
+  HVDTPU_CALLED_ON(background)
   void set_liveness_fd(int fd) { liveness_fd_ = fd; }
   // Shared fault-detection block (socket_util.h IoControl): wait slices
   // shrink to its detect_slice_ms, a plane-wide abort breaks blocked ring
@@ -119,6 +131,7 @@ class ShmTransport : public Transport {
   // failed, and read_deadline_secs bounds a zero-progress op against a
   // hung-but-alive peer. Optional (standalone/unit-test use keeps the
   // segment-local abort flag only).
+  HVDTPU_CALLED_ON(background)
   void set_control(IoControl* ctl) { ctl_ = ctl; }
   // Futex-doorbell coalescing window in bytes (HVDTPU_DOORBELL_BATCH):
   // 0 = kDefaultDoorbellBatchBytes, 1 = ring on every advance (legacy).
@@ -128,6 +141,7 @@ class ShmTransport : public Transport {
   // protocol — their one wake IS the latency path, measured slower under
   // coalescing on a contended box. Set before traffic (Connect time);
   // each side tunes its own bells.
+  HVDTPU_CALLED_ON(background)
   void set_doorbell_batch(int64_t bytes) {
     doorbell_batch_ = bytes <= 0 ? kDefaultDoorbellBatchBytes : bytes;
   }
@@ -135,24 +149,30 @@ class ShmTransport : public Transport {
   // (HVDTPU_SHM_NUMA; mbind(MPOL_PREFERRED, MF_MOVE), page-rounded).
   // Returns true when a binding was applied; false = probed no-op
   // (single-node host, mode OFF, or the syscall is unavailable).
+  HVDTPU_CALLED_ON(background)
   bool ApplyNumaPolicy(ShmNumaMode mode);
   // Drop the name from the shm namespace (creator side, once the opener
   // confirmed attach over the socket handshake): an abnormal death after
   // this point leaks nothing. Idempotent.
+  HVDTPU_CALLED_ON(background)
   void Unlink();
 
+  HVDTPU_CALLED_ON(any)
   size_t ring_bytes() const { return ring_bytes_; }
   // Bytes sitting in the segment's two rings right now (producer head minus
   // consumer tail, both directions) — the per-lane occupancy gauge. Any
   // thread (the cursors are cross-process atomics already).
+  HVDTPU_CALLED_ON(any)
   int64_t OccupancyBytes() const override;
   // Futex wake syscalls this side has issued (doorbell-batching tests).
+  HVDTPU_CALLED_ON(any)
   int64_t futex_wakes() const { return futex_wakes_; }
   // True once THIS lane's liveness probe saw the peer die (EOF) or its
   // no-progress deadline expired — failure ATTRIBUTION for exchanges that
   // span two lanes (DataPlane::Exchange's DuplexPump path must not blame
   // the healthy neighbor; the plane-wide IoControl flags cannot say which
   // lane tripped first).
+  HVDTPU_CALLED_ON(any)
   bool peer_died() const { return peer_died_; }
 
   // Single-threaded duplex pump across TWO shm lanes (ring-neighbor
@@ -162,6 +182,7 @@ class ShmTransport : public Transport {
   // create/join, no cross-thread scheduling churn, and the receive side
   // consumes in place (view semantics, like RecvSegmented). Both lanes
   // must be driven by the calling thread (the usual single-driver rule).
+  HVDTPU_CALLED_ON(background)
   static int DuplexPump(ShmTransport* tx, const void* send_buf,
                         size_t send_bytes, ShmTransport* rx, void* recv_buf,
                         size_t recv_bytes, size_t view_align,

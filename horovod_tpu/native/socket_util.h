@@ -34,8 +34,8 @@ inline double MonoSeconds() {
 // atomics any thread may read; writers use MarkPeerFailed/store-release.
 // The plain-int tuning fields are written before Connect only.
 struct IoControl {
-  std::atomic<uint32_t> aborted{0};      // plane-wide: fail every lane op
-  std::atomic<uint32_t> peer_failed{0};  // a lane observed peer death
+  std::atomic<uint32_t> aborted{0};      // plane-wide: fail every lane op  // atomic: acquire-read
+  std::atomic<uint32_t> peer_failed{0};  // a lane observed peer death  // atomic: release-publish
   int64_t detect_slice_ms = 100;         // poll slice (abort latency bound)
   double read_deadline_secs = 0;         // 0 = no no-progress deadline
   // Cumulative peer-wait time: microseconds every controlled op spent
@@ -45,7 +45,7 @@ struct IoControl {
   // split hop time into wait vs wire (docs/tracing.md straggler
   // attribution). Relaxed adds on the already-slow blocked path: free on
   // the hot path.
-  std::atomic<int64_t> wait_us{0};
+  std::atomic<int64_t> wait_us{0};  // atomic: relaxed-counter
 
   bool is_aborted() const {
     return aborted.load(std::memory_order_acquire) != 0;

@@ -23,30 +23,40 @@ class Timeline {
   ~Timeline();
 
   // No-op unless initialized. file comes from HVDTPU_TIMELINE.
+  HVDTPU_CALLED_ON(any)
   void Initialize(const std::string& path, int rank) EXCLUDES(state_mu_, mu_);
+  HVDTPU_CALLED_ON(any)
   void Shutdown() EXCLUDES(state_mu_, mu_);
+  HVDTPU_CALLED_ON(any)
   bool Initialized() const { return initialized_; }
 
   // Phase events for a named tensor (tensor name becomes the trace "pid" row,
   // like the reference, timeline.cc:254-276).
+  HVDTPU_CALLED_ON(any)
   void NegotiateStart(const std::string& name);
+  HVDTPU_CALLED_ON(any)
   void NegotiateEnd(const std::string& name);
+  HVDTPU_CALLED_ON(any)
   void QueueStart(const std::string& name);
   // `transport` (optional) tags the op with the data-plane lane summary
   // ("shm", "tcp", "shm+tcp", with "+hier" under the two-level allreduce) as
   // a Chrome-trace arg — visible in the Perfetto slice details.
   // `compression` (optional) sits next to it: the op's effective wire
   // compression ("none", "fp16", "int8", "int4").
+  HVDTPU_CALLED_ON(any)
   void ActivityStart(const std::string& name, const std::string& activity,
                      const std::string& transport = "",
                      const std::string& compression = "");
+  HVDTPU_CALLED_ON(any)
   void ActivityEnd(const std::string& name);
   // raw_bytes/wire_bytes (optional, -1 = omit): payload this rank would
   // have sent uncompressed vs bytes actually sent, from the data plane's
   // per-op counters — the compression-ratio measurement surface
   // (docs/timeline.md).
+  HVDTPU_CALLED_ON(any)
   void OpDone(const std::string& name, const std::string& result,
               int64_t raw_bytes = -1, int64_t wire_bytes = -1);
+  HVDTPU_CALLED_ON(any)
   void MarkCycle() EXCLUDES(state_mu_, mu_);  // HVDTPU_TIMELINE_MARK_CYCLES
 
   // --- distributed-tracing surface (docs/tracing.md) ----------------------
@@ -54,20 +64,24 @@ class Timeline {
   // rank). start/end are ABSOLUTE steady-clock microseconds (SteadyAbsUs);
   // the timeline converts to its own origin at emission, so emitters can
   // timestamp without taking state_mu_. args_json: "{...}" or "".
+  HVDTPU_CALLED_ON(any)
   void Span(const std::string& track, const std::string& name,
             int64_t start_abs_us, int64_t end_abs_us,
             const std::string& args_json) EXCLUDES(state_mu_, mu_);
   // Trace-metadata instant on the reserved kTraceMetaTrack row: clock
   // offset ± error bound vs rank 0, steady/wall anchors — everything
   // scripts/trace_analyze.py needs to align this rank's events globally.
+  HVDTPU_CALLED_ON(any)
   void Metadata(const std::string& args_json) EXCLUDES(state_mu_, mu_);
   // Absolute steady-clock now in microseconds (the spans' time base).
+  HVDTPU_CALLED_ON(any)
   static int64_t SteadyAbsUs() {
     return std::chrono::duration_cast<std::chrono::microseconds>(
                std::chrono::steady_clock::now().time_since_epoch())
         .count();
   }
   // Absolute steady us of this timeline's ts origin (0 if uninitialized).
+  HVDTPU_CALLED_ON(any)
   int64_t init_steady_us() EXCLUDES(state_mu_);
 
   static constexpr const char* kTraceMetaTrack = "__hvdtpu_trace_meta";
@@ -94,7 +108,7 @@ class Timeline {
   Mutex state_mu_ ACQUIRED_BEFORE(mu_);
   // Lock-free fast-path check in Initialized(); every WRITE happens under
   // state_mu_ so Emit's snapshot (rank_/start_) stays consistent with it.
-  std::atomic<bool> initialized_{false};
+  std::atomic<bool> initialized_{false};  // atomic: seqcst(init latch, read via implicit loads)
   int rank_ GUARDED_BY(state_mu_) = 0;
   std::chrono::steady_clock::time_point start_ GUARDED_BY(state_mu_);
   int cycle_ GUARDED_BY(state_mu_) = 0;

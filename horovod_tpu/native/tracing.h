@@ -25,6 +25,8 @@
 #include <cstdint>
 #include <vector>
 
+#include "thread_roles.h"
+
 namespace hvdtpu {
 
 // One ping-pong: t1 = local steady us at send, t2 = peer steady us at its
@@ -54,10 +56,14 @@ ClockEstimate EstimateClockOffset(const std::vector<ClockSample>& samples);
 // the DataPlane that owns it.
 class TraceSampler {
  public:
+  HVDTPU_CALLED_ON(background)
   void set_every_n(int64_t n) { every_n_ = n; }
+  HVDTPU_CALLED_ON(background)
   int64_t every_n() const { return every_n_; }
+  HVDTPU_CALLED_ON(background)
   bool enabled() const { return every_n_ > 0; }
 
+  HVDTPU_CALLED_ON(background)
   bool SampleOp() {
     if (every_n_ <= 0) return false;
     return ops_++ % every_n_ == 0;

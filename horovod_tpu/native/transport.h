@@ -16,6 +16,7 @@
 #include <functional>
 
 #include "socket_util.h"
+#include "thread_roles.h"
 
 namespace hvdtpu {
 
@@ -66,12 +67,15 @@ class ZeroCopySender {
   // old kernels); AUTO/ON -> setsockopt(SO_ZEROCOPY) (EOPNOTSUPP/ENOPROTOOPT
   // leaves the engine disabled: AF_UNIX pairs, pre-4.14 kernels). OFF never
   // probes. Idempotent.
+  HVDTPU_CALLED_ON(background)
   void Init(int fd, ZeroCopyMode mode);
 
   // Lane armed (post-probe, not auto-disabled)?
+  HVDTPU_CALLED_ON(any)
   bool enabled() const { return lane_ != Lane::NONE; }
   // Engage for this payload? Small sends stay on the copy path: page
   // pinning + completion reaping cost more than one memcpy below this.
+  HVDTPU_CALLED_ON(background)
   bool ShouldUse(size_t len) const {
     return lane_ != Lane::NONE && len >= kMinBytes;
   }
@@ -82,11 +86,14 @@ class ZeroCopySender {
   // the engine disables itself. AUTO mode also self-disables after the
   // first drain whose completions all carry SO_EE_CODE_ZEROCOPY_COPIED
   // (the kernel copied anyway — loopback): later sends take the copy path.
+  HVDTPU_CALLED_ON(background)
   int SendAll(const void* buf, size_t len, IoControl* ctl);
 
   // Completed zero-copy sends / sends-that-fell-back since Init, for the
   // data plane's hvdtpu_zerocopy_{sends,fallbacks}_total counters.
+  HVDTPU_CALLED_ON(any)
   int64_t sends() const { return sends_; }
+  HVDTPU_CALLED_ON(any)
   int64_t kernel_copied_events() const { return copied_notifs_; }
 
   static constexpr size_t kMinBytes = 128 * 1024;
@@ -128,6 +135,7 @@ class Transport {
   virtual ~Transport() = default;
 
   // Lane tag for the timeline / introspection ("tcp", "tcp-zc", "shm", ...).
+  HVDTPU_CALLED_ON(any)
   virtual const char* kind() const = 0;
 
   // Exact-length transfers; 0 on success, -1 on error or abort.
@@ -135,7 +143,9 @@ class Transport {
   // in socket_util.h, used by the control plane's SendFrame — not a lane
   // method: every collective payload is a single contiguous region, so a
   // per-lane Sendv would be interface weight with no caller.)
+  HVDTPU_CALLED_ON(background)
   virtual int Send(const void* buf, size_t len) = 0;
+  HVDTPU_CALLED_ON(background)
   virtual int Recv(void* buf, size_t len) = 0;
 
   // Receive with segment callbacks so per-segment work (reduction) overlaps
@@ -144,12 +154,14 @@ class Transport {
   // `buf` is scratch a zero-copy lane may skip. view_align: every view
   // length/offset is a multiple of this (the caller's element size), so
   // in-place reducers never see a torn element.
+  HVDTPU_CALLED_ON(background)
   virtual int RecvSegmented(void* buf, size_t len, size_t segment_bytes,
                             size_t view_align, const SegmentFn& on_segment) = 0;
 
   // Full-duplex exchange with the SAME peer (both sides may send first
   // without deadlock) plus optional segment callbacks on the receive side
   // (same view semantics as RecvSegmented).
+  HVDTPU_CALLED_ON(background)
   virtual int SendRecv(const void* send_buf, size_t send_bytes,
                        void* recv_buf, size_t recv_bytes,
                        size_t segment_bytes, size_t view_align,
@@ -159,18 +171,21 @@ class Transport {
   // fits the transport's own buffering): callers may send inline before a
   // blocking receive with no deadlock risk, skipping the sender thread that
   // dominates small-message latency.
+  HVDTPU_CALLED_ON(background)
   virtual bool InlineSendSafe(size_t bytes) const = 0;
 
   // Break any blocked op on this lane (world abort / peer failure). The TCP
   // lane needs nothing here — DataPlane::Abort shuts the socket down and the
   // sliced reads observe the shared IoControl; the shm lane overrides to
   // flip its cross-process abort flag and wake futex waiters.
+  HVDTPU_CALLED_ON(any)
   virtual void Abort() {}
 
   // Bytes currently buffered inside the lane's own storage (the shm rings'
   // head-tail spread; 0 for lanes that buffer in the kernel) — the memory-
   // occupancy telemetry's per-lane gauge (docs/profiling.md). Any thread;
   // weakly consistent like the metrics it feeds.
+  HVDTPU_CALLED_ON(any)
   virtual int64_t OccupancyBytes() const { return 0; }
 };
 
@@ -190,24 +205,33 @@ class TcpTransport : public Transport {
     zc_.Init(fd, zc_mode);
   }
 
+  HVDTPU_CALLED_ON(any)
   const char* kind() const override {
     return zc_.enabled() ? "tcp-zc" : "tcp";
   }
+  HVDTPU_CALLED_ON(background)
   int Send(const void* buf, size_t len) override;
+  HVDTPU_CALLED_ON(background)
   int Recv(void* buf, size_t len) override;
+  HVDTPU_CALLED_ON(background)
   int RecvSegmented(void* buf, size_t len, size_t segment_bytes,
                     size_t view_align, const SegmentFn& on_segment) override;
+  HVDTPU_CALLED_ON(background)
   int SendRecv(const void* send_buf, size_t send_bytes, void* recv_buf,
                size_t recv_bytes, size_t segment_bytes, size_t view_align,
                const SegmentFn& on_segment) override;
+  HVDTPU_CALLED_ON(background)
   bool InlineSendSafe(size_t bytes) const override {
     return static_cast<int64_t>(bytes) <= inline_max_;
   }
 
   // Zero-copy introspection/accounting (the data plane scrapes these into
   // the metrics registry after each op; background thread only).
+  HVDTPU_CALLED_ON(any)
   bool zerocopy_enabled() const { return zc_.enabled(); }
+  HVDTPU_CALLED_ON(any)
   int64_t zerocopy_sends() const { return zc_.sends(); }
+  HVDTPU_CALLED_ON(any)
   int64_t zerocopy_fallbacks() const { return zc_fallbacks_; }
 
  private:

@@ -56,135 +56,37 @@ OP_ALLREDUCE = 0
 REDUCE_SUM = 1
 
 
+def _import_basics():
+    """Import horovod_tpu.basics WITHOUT the package __init__ (which pulls
+    JAX): stub the parent package so the relative imports inside basics.py
+    resolve, then load the module by file path — the bench keeps running on
+    boxes with no JAX install."""
+    import importlib.util
+    import types
+    if "horovod_tpu.basics" in sys.modules:
+        return sys.modules["horovod_tpu.basics"]
+    pkg_dir = os.path.join(REPO, "horovod_tpu")
+    if "horovod_tpu" not in sys.modules:
+        pkg = types.ModuleType("horovod_tpu")
+        pkg.__path__ = [pkg_dir]
+        sys.modules["horovod_tpu"] = pkg
+    spec = importlib.util.spec_from_file_location(
+        "horovod_tpu.basics", os.path.join(pkg_dir, "basics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["horovod_tpu.basics"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def load_lib(path: str) -> ctypes.CDLL:
-    lib = ctypes.CDLL(path)
-    lib.hvdtpu_create.restype = ctypes.c_void_p
-    lib.hvdtpu_create.argtypes = [
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
-        ctypes.c_double, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int,
-        ctypes.c_double]
-    lib.hvdtpu_start.restype = ctypes.c_int
-    lib.hvdtpu_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                 ctypes.c_int]
-    lib.hvdtpu_shutdown.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_destroy.argtypes = [ctypes.c_void_p]
-    lib.hvdtpu_enqueue.restype = ctypes.c_longlong
-    lib.hvdtpu_enqueue.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
-        ctypes.c_void_p, ctypes.c_double, ctypes.c_double, ctypes.c_int,
-        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
-        ctypes.c_int]
-    lib.hvdtpu_wait.restype = ctypes.c_int
-    lib.hvdtpu_wait.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
-                                ctypes.c_char_p, ctypes.c_int]
-    lib.hvdtpu_result_bytes.restype = ctypes.c_longlong
-    lib.hvdtpu_result_bytes.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
-    lib.hvdtpu_copy_result.restype = ctypes.c_int
-    lib.hvdtpu_copy_result.argtypes = [
-        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
-        ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
-    try:
-        lib.hvdtpu_set_allreduce_tuning.restype = ctypes.c_int
-        lib.hvdtpu_set_allreduce_tuning.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong,
-            ctypes.c_longlong]
-    except AttributeError:
-        pass  # seed build: no algorithm selection
-    try:
-        lib.hvdtpu_set_scale_tuning.restype = ctypes.c_int
-        lib.hvdtpu_set_scale_tuning.argtypes = [
-            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int]
-    except AttributeError:
-        pass  # pre-scale-out build: no SA group floor / ctrl batching
-    try:
-        lib.hvdtpu_set_transport.restype = ctypes.c_int
-        lib.hvdtpu_set_transport.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong, ctypes.c_int]
-    except AttributeError:
-        pass  # pre-transport-subsystem build: TCP only
-    try:
-        lib.hvdtpu_set_compression.restype = ctypes.c_int
-        lib.hvdtpu_set_compression.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_longlong,
-            ctypes.c_char_p]
-        lib.hvdtpu_wire_stats.restype = None
-        lib.hvdtpu_wire_stats.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
-            ctypes.POINTER(ctypes.c_longlong)]
-    except AttributeError:
-        pass  # pre-compression build: raw wire only
-    try:
-        lib.hvdtpu_set_transport_ext.restype = ctypes.c_int
-        lib.hvdtpu_set_transport_ext.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong]
-    except AttributeError:
-        pass  # pre-zero-copy build
-    try:
-        lib.hvdtpu_set_trace.restype = ctypes.c_int
-        lib.hvdtpu_set_trace.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
-                                         ctypes.c_double]
-    except AttributeError:
-        pass  # pre-tracing build
-    try:
-        lib.hvdtpu_set_flightrec.restype = ctypes.c_int
-        lib.hvdtpu_set_flightrec.argtypes = [ctypes.c_void_p,
-                                             ctypes.c_longlong,
-                                             ctypes.c_char_p]
-    except AttributeError:
-        pass  # pre-flight-recorder build
-    try:
-        lib.hvdtpu_set_perfstats.restype = ctypes.c_int
-        lib.hvdtpu_set_perfstats.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_double,
-            ctypes.c_longlong, ctypes.c_char_p]
-    except AttributeError:
-        pass  # pre-perfstats build
-    try:
-        lib.hvdtpu_set_gradstats.restype = ctypes.c_int
-        lib.hvdtpu_set_gradstats.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
-            ctypes.c_char_p]
-    except AttributeError:
-        pass  # pre-gradstats build
-    try:
-        lib.hvdtpu_set_profiler.restype = ctypes.c_int
-        lib.hvdtpu_set_profiler.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
-            ctypes.c_int, ctypes.c_char_p]
-        lib.hvdtpu_profiler_start.restype = ctypes.c_int
-        lib.hvdtpu_profiler_start.argtypes = [ctypes.c_void_p]
-    except AttributeError:
-        pass  # pre-profiler build
-    try:
-        lib.hvdtpu_enqueue_reducescatter.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_reducescatter.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.c_double, ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
-        lib.hvdtpu_enqueue_allgather.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_allgather.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.c_char_p, ctypes.c_int]
-    except AttributeError:
-        pass  # pre-reduce-scatter/allgather build
-    try:
-        lib.hvdtpu_enqueue_broadcast.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_broadcast.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
-        lib.hvdtpu_enqueue_alltoall.restype = ctypes.c_longlong
-        lib.hvdtpu_enqueue_alltoall.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
-            ctypes.c_int]
-    except AttributeError:
-        pass  # pre-broadcast/alltoall build
-    return lib
+    """dlopen + register the C API through the one shared table
+    (horovod_tpu/basics.py ``_C_API`` — the ABI-MIRROR lint's single
+    registration site). strict=False because the paired --ab "lib" mode
+    loads historical .so builds: every symbol is version-gated, absent
+    exports stay unregistered, and callers skip them behind hasattr (the
+    seed build without ``hvdtpu_set_allreduce_tuning`` still runs the
+    ring-only sweep)."""
+    return _import_basics().register_c_api(ctypes.CDLL(path), strict=False)
 
 
 def parse_sizes(args) -> list:

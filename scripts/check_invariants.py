@@ -31,6 +31,27 @@ Rules (each reported as ``path:line: [RULE] message``):
   ENUM-MIRROR native wire enums (DataType/OpType/ReduceOp/ResponseType/
               CtrlMsg/AllreduceAlgo/HierMode/WireCompression) match their
               Python mirrors byte-for-byte, both directions.
+  ATOMIC-DISCIPLINE
+              every ``std::atomic`` member/global in the native core
+              declares its ordering protocol in a same-line structured
+              comment (``// atomic: relaxed-counter | release-publish |
+              acquire-read | seqcst(<why>)``) and every load/store/RMW call
+              site uses an ordering the declared protocol allows — an
+              annotation-free default-seq_cst op on a relaxed counter (or a
+              relaxed load of a release-published pointer) is a finding,
+              not a code-review judgement call. ``std::atomic_flag`` is
+              exempt (its test_and_set/clear spinlock idiom is checked by
+              TSan, and it publishes nothing). Grammar and worked examples:
+              docs/static-analysis.md "Atomics discipline".
+  ABI-MIRROR  the ``extern "C" hvdtpu_*`` surface of native/core.cpp and
+              the ctypes registration table (``_C_API`` in basics.py) agree
+              exactly: every export registered, no stale entries, arity and
+              types position-for-position compatible, and the version-gate
+              flag correct — symbols in the frozen pre-table baseline are
+              required, anything newer must be gated so A/B benches can
+              load historical .so builds. Registrations outside the table
+              (a second ``.argtypes =`` site anywhere under horovod_tpu/,
+              scripts/ or tests/) are findings: one table is the contract.
 
 Exit status: 0 on a clean tree, 1 if any rule fired. ``--root`` points the
 linter at an alternative tree (the negative fixtures under
@@ -50,8 +71,9 @@ from pathlib import Path
 
 ENV_RE = re.compile(r"HVDTPU_[A-Z0-9_]+")
 # HVDTPU_-prefixed identifiers that are not environment variables (the C++
-# thread-safety-annotation macro family in native/common.h).
-NON_ENV_TOKENS = {"HVDTPU_TSA"}
+# thread-safety-annotation macro family in native/common.h and the
+# thread-role macros in native/thread_roles.h).
+NON_ENV_TOKENS = {"HVDTPU_TSA", "HVDTPU_ROLE", "HVDTPU_CALLED_ON"}
 
 ENVVARS_PY = "horovod_tpu/utils/envvars.py"
 ENV_DOC = "docs/envvars.md"
@@ -610,6 +632,420 @@ def check_enum_mirrors(root: Path, findings, ran):
         ran.append("ENUM-MIRROR(%s)" % ",".join(pairs_run))
 
 
+# ---------------------------------------------------------------------------
+# std::atomic ordering discipline
+# ---------------------------------------------------------------------------
+
+# Declared protocol -> allowed memory_order token(s) per operation class.
+# An op with NO explicit ordering defaults to seq_cst, which only the
+# seqcst(<why>) protocol allows. compare_exchange failure orders may always
+# weaken to acquire/relaxed (the standard requires no stronger than success).
+ATOMIC_PROTOCOLS = {
+    "relaxed-counter": {"load": {"relaxed"}, "store": {"relaxed"},
+                        "rmw": {"relaxed"}},
+    "release-publish": {"load": {"acquire"}, "store": {"release"},
+                        "rmw": {"acq_rel", "release"}},
+    "acquire-read": {"load": {"acquire"}, "store": {"release", "seq_cst"},
+                     "rmw": {"acq_rel"}},
+}
+ATOMIC_ANNOT_RE = re.compile(
+    r"//\s*atomic:\s*(relaxed-counter|release-publish|acquire-read|"
+    r"seqcst\([^)]+\))")
+ATOMIC_DECL_RE = re.compile(
+    r"^\s*(?:static\s+|mutable\s+|inline\s+|alignas\([^)]*\)\s*|"
+    r"thread_local\s+)*"
+    r"std::(?:atomic<|unique_ptr<std::atomic<)")
+ATOMIC_OPS_RE = re.compile(
+    r"\b(\w+)(?:\[[^\]]*\])?\s*(?:\.|->)\s*"
+    r"(load|store|exchange|fetch_add|fetch_sub|fetch_and|fetch_or|"
+    r"fetch_xor|compare_exchange_weak|compare_exchange_strong)\s*\(")
+MEMORY_ORDER_RE = re.compile(r"memory_order_(\w+)")
+# std::atomic method names that are not ordering-relevant member accesses
+# (is_lock_free etc. never appear in this codebase; keep the op list tight).
+
+ATOMIC_FILES_SKIP = {"unit_tests.cpp", "test_analyze.cpp"}
+
+
+def _atomic_member_name(line: str):
+    """Member/global name of an atomic declaration line, or None for
+    pointer/reference declarations (those alias an atomic declared — and
+    annotated — elsewhere)."""
+    # Strip the template type with bracket matching, then take the first
+    # identifier. `std::atomic<int>* p` (pointer) is skipped.
+    m = re.search(r"std::(?:atomic|unique_ptr)", line)
+    i, depth = m.end(), 0
+    while i < len(line):
+        c = line[i]
+        if c == "<":
+            depth += 1
+        elif c == ">":
+            depth -= 1
+            if depth == 0:
+                i += 1
+                break
+        i += 1
+    rest = line[i:]
+    if rest.lstrip().startswith(("*", "&")):
+        return None
+    nm = re.match(r"\s*(\w+)", rest)
+    return nm.group(1) if nm else None
+
+
+def _match_paren_span(text: str, open_idx: int) -> int:
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def check_atomic_discipline(root: Path, findings, ran):
+    native = root / NATIVE_DIR
+    if not native.is_dir():
+        return
+    files = [p for p in sorted(native.glob("*.h")) + sorted(native.glob("*.cpp"))
+             if p.name not in ATOMIC_FILES_SKIP]
+    if not files:
+        return
+    ran.append("ATOMIC-DISCIPLINE")
+    protocols = {}  # member name -> (protocol, rel, line)
+    texts = {}
+    for p in files:
+        rel = p.relative_to(root).as_posix()
+        text = p.read_text(encoding="utf-8", errors="replace")
+        texts[rel] = text
+        for i, line in enumerate(text.split("\n"), 1):
+            if not ATOMIC_DECL_RE.match(line):
+                continue
+            if "std::atomic_flag" in line:
+                continue  # exempt: spinlock idiom, publishes nothing
+            name = _atomic_member_name(line)
+            if name is None:
+                continue
+            am = ATOMIC_ANNOT_RE.search(line)
+            if am is None:
+                findings.append(Finding(
+                    rel, i, "ATOMIC-DISCIPLINE",
+                    f"std::atomic {name} declares no ordering protocol; "
+                    "append `// atomic: relaxed-counter | release-publish "
+                    "| acquire-read | seqcst(<why>)` on the declaration "
+                    "line"))
+                continue
+            proto = am.group(1)
+            key = proto.split("(")[0]
+            prev = protocols.get(name)
+            if prev is not None and prev[0].split("(")[0] != key:
+                findings.append(Finding(
+                    rel, i, "ATOMIC-DISCIPLINE",
+                    f"atomic {name} declares protocol {proto!r} here but "
+                    f"{prev[0]!r} at {prev[1]}:{prev[2]} — one name, one "
+                    "protocol (rename one of them)"))
+                continue
+            protocols.setdefault(name, (proto, rel, i))
+    # Call sites: every op on a declared atomic must use an ordering its
+    # protocol allows. A same-line `// atomic-ok: <reason>` waives one site
+    # (the SPSC ring's owner-side relaxed reads, double-checked fast paths)
+    # — the reason is mandatory documentation, not decoration.
+    for rel, text in sorted(texts.items()):
+        lines = text.split("\n")
+        for m in ATOMIC_OPS_RE.finditer(text):
+            name, op = m.group(1), m.group(2)
+            decl = protocols.get(name)
+            if decl is None:
+                continue  # not an atomic we track (or already reported)
+            if re.search(r"//\s*atomic-ok:\s*\S",
+                         lines[_line_of(text, m.start()) - 1]):
+                continue
+            proto = decl[0]
+            key = proto.split("(")[0]
+            span = text[m.end() - 1:_match_paren_span(text, m.end() - 1)]
+            orders = MEMORY_ORDER_RE.findall(span)
+            line = _line_of(text, m.start())
+            if key == "seqcst":
+                if any(o != "seq_cst" for o in orders):
+                    findings.append(Finding(
+                        rel, line, "ATOMIC-DISCIPLINE",
+                        f"{name}.{op}: uses memory_order_"
+                        f"{[o for o in orders if o != 'seq_cst'][0]} but "
+                        f"{name} is declared {proto!r} (default/explicit "
+                        "seq_cst only)"))
+                continue
+            opclass = op if op in ("load", "store") else "rmw"
+            allowed = ATOMIC_PROTOCOLS[key][opclass]
+            if not orders:
+                findings.append(Finding(
+                    rel, line, "ATOMIC-DISCIPLINE",
+                    f"{name}.{op}: no explicit memory_order (defaults to "
+                    f"seq_cst) but {name} is declared {proto!r} — spell "
+                    f"memory_order_{sorted(allowed)[0]} or re-declare the "
+                    "protocol"))
+                continue
+            bad = [o for o in orders if o not in allowed]
+            if op.startswith("compare_exchange") and len(orders) == 2:
+                # Failure order may weaken to acquire/relaxed.
+                bad = [o for o in [orders[0]] if o not in allowed]
+                if orders[1] not in allowed | {"acquire", "relaxed"}:
+                    bad.append(orders[1])
+            if bad:
+                findings.append(Finding(
+                    rel, line, "ATOMIC-DISCIPLINE",
+                    f"{name}.{op}: memory_order_{bad[0]} violates the "
+                    f"declared protocol {proto!r} (allowed: "
+                    f"{', '.join(sorted(allowed))})"))
+
+
+# ---------------------------------------------------------------------------
+# extern "C" <-> ctypes registration parity
+# ---------------------------------------------------------------------------
+
+CORE_CPP = f"{NATIVE_DIR}/core.cpp"
+BASICS_PY = "horovod_tpu/basics.py"
+
+# Exports that existed before the _C_API table (PR 20): every historical
+# .so has them, so the loader may hard-require them. Anything NOT in this
+# frozen set must carry required=False — the version-gate that lets the
+# A/B benches (scripts/bench_native_allreduce.py) load older builds. This
+# list only ever grows when a release is cut; it does not track basics.py.
+ABI_BASELINE_REQUIRED = frozenset({
+    "hvdtpu_create", "hvdtpu_start", "hvdtpu_shutdown", "hvdtpu_destroy",
+    "hvdtpu_enqueue", "hvdtpu_wait", "hvdtpu_poll", "hvdtpu_result_bytes",
+    "hvdtpu_copy_result", "hvdtpu_join", "hvdtpu_set_cache_capacity",
+    "hvdtpu_hmac_hex", "hvdtpu_set_secret", "hvdtpu_set_allreduce_tuning",
+    "hvdtpu_set_transport", "hvdtpu_set_transport_ext",
+    "hvdtpu_set_stall_shutdown", "hvdtpu_set_failure_detection",
+    "hvdtpu_set_chaos", "hvdtpu_observe_recovery", "hvdtpu_set_compression",
+    "hvdtpu_wire_stats", "hvdtpu_metrics_dump", "hvdtpu_set_flightrec",
+    "hvdtpu_flightrec_dump", "hvdtpu_set_perfstats", "hvdtpu_set_profiler",
+    "hvdtpu_profiler_start", "hvdtpu_profiler_stop",
+    "hvdtpu_profiler_running", "hvdtpu_profiler_snapshot",
+    "hvdtpu_set_gradstats", "hvdtpu_gradstats_snapshot",
+    "hvdtpu_perfstats_snapshot", "hvdtpu_flightrec_snapshot",
+    "hvdtpu_set_autotune", "hvdtpu_start_timeline", "hvdtpu_stop_timeline",
+    "hvdtpu_set_trace", "hvdtpu_start_trace", "hvdtpu_clock_offset",
+    "hvdtpu_cycle_time_ms", "hvdtpu_fusion_threshold",
+})
+
+C_EXPORT_RE = re.compile(
+    r"^((?:[A-Za-z_][\w ]*?)\**)\s*\b(hvdtpu_\w+)\s*\(", re.M)
+
+# Normalized C parameter type -> ctypes spellings the table may use.
+# Pointer params other than char*/void* accept c_void_p too: NumPy callers
+# pass `.ctypes.data` integers, which only c_void_p converts.
+C_TO_CTYPES = {
+    "int": {"c_int"},
+    "longlong": {"c_longlong"},
+    "double": {"c_double"},
+    "float": {"c_float"},
+    "void*": {"c_void_p"},
+    "char*": {"c_char_p"},
+    "unsignedchar*": {"P(c_ubyte)", "c_void_p"},
+    "longlong*": {"P(c_longlong)", "c_void_p"},
+    "int*": {"P(c_int)", "c_void_p"},
+    "float*": {"P(c_float)", "c_void_p"},
+    "double*": {"P(c_double)", "c_void_p"},
+}
+C_VOID_RETURN = {"void": {None}}
+
+
+def _norm_c_type(raw: str):
+    """'const long long *sizes' -> ('longlong*'); param names stripped."""
+    t = raw.strip()
+    if t in ("void", ""):
+        return "void"
+    t = re.sub(r"\bconst\b", " ", t)
+    stars = t.count("*")
+    t = t.replace("*", " ")
+    words = t.split()
+    # Last identifier is the parameter name iff more than one word remains
+    # and the tail isn't part of a multi-word type.
+    type_words = {"int", "long", "char", "double", "float", "void",
+                  "unsigned", "signed", "short"}
+    if len(words) > 1 and words[-1] not in type_words:
+        words = words[:-1]
+    return "".join(words) + "*" * stars
+
+
+def parse_c_exports(root: Path):
+    """-> {symbol: (ret, [param types], line)} from core.cpp, or None."""
+    text = _read(root, CORE_CPP)
+    if text is None:
+        return None
+    out = {}
+    for m in C_EXPORT_RE.finditer(text):
+        ret, sym = m.group(1).strip(), m.group(2)
+        close = _match_paren_span(text, m.end() - 1)
+        after = text[close:close + 8].lstrip()
+        if not after.startswith("{"):
+            continue  # declaration or call, not the definition
+        params_src = text[m.end():close - 1].strip()
+        params = [] if params_src in ("", "void") else [
+            _norm_c_type(p) for p in params_src.split(",")]
+        out[sym] = (_norm_c_type(ret), params, _line_of(text, m.start()))
+    return out or None
+
+
+def _ctypes_expr_str(node, aliases):
+    """Canonical string for a ctypes type expression in the _C_API table:
+    'c_int', 'c_void_p', 'P(c_longlong)', or None (void return)."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return None
+    if isinstance(node, ast.Attribute):
+        return node.attr  # ctypes.c_int -> "c_int"
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else ""
+        if fname == "POINTER" and node.args:
+            inner = _ctypes_expr_str(node.args[0], aliases)
+            return f"P({inner})"
+    return "<unparsed>"
+
+
+def parse_ctypes_table(root: Path, findings):
+    """-> {symbol: (restype, [argtypes], required, line)} from basics.py's
+    _C_API tuple, or None when basics.py (or the table) is absent."""
+    src = _read(root, BASICS_PY)
+    if src is None:
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    aliases = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            s = _ctypes_expr_str(node.value, aliases)
+            if isinstance(s, str) and (s.startswith("P(") or
+                                       s.startswith("c_")):
+                aliases[node.targets[0].id] = s
+    table = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_C_API" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            table = node.value
+    if table is None:
+        return None
+    out = {}
+    for entry in table.elts:
+        if not (isinstance(entry, ast.Tuple) and len(entry.elts) == 4):
+            findings.append(Finding(
+                BASICS_PY, entry.lineno, "ABI-MIRROR",
+                "_C_API entries must be (symbol, restype, argtypes, "
+                "required) 4-tuples"))
+            continue
+        sym_n, res_n, args_n, req_n = entry.elts
+        if not (isinstance(sym_n, ast.Constant) and
+                isinstance(sym_n.value, str)):
+            findings.append(Finding(
+                BASICS_PY, entry.lineno, "ABI-MIRROR",
+                "_C_API symbol must be a string literal"))
+            continue
+        args = [_ctypes_expr_str(a, aliases) for a in args_n.elts] \
+            if isinstance(args_n, (ast.Tuple, ast.List)) else None
+        req = req_n.value if isinstance(req_n, ast.Constant) else None
+        out[sym_n.value] = (_ctypes_expr_str(res_n, aliases), args, req,
+                            entry.lineno)
+    return out
+
+
+ARGTYPES_ASSIGN_RE = re.compile(r"\.\s*(argtypes|restype)\s*=")
+
+
+def check_abi_mirror(root: Path, findings, ran):
+    exports = parse_c_exports(root)
+    table = parse_ctypes_table(root, findings)
+    if exports is None or table is None:
+        return
+    ran.append("ABI-MIRROR")
+    for sym, (ret, params, line) in sorted(exports.items()):
+        if sym not in table:
+            findings.append(Finding(
+                CORE_CPP, line, "ABI-MIRROR",
+                f"export {sym} has no _C_API registration in {BASICS_PY} "
+                "— ctypes calls it with unchecked int defaults"))
+            continue
+        restype, argtypes, required, tline = table[sym]
+        # Version gate: baseline symbols are hard-required; newer exports
+        # must be gated so A/B benches can load historical builds.
+        want_required = sym in ABI_BASELINE_REQUIRED
+        if required is not want_required:
+            findings.append(Finding(
+                BASICS_PY, tline, "ABI-MIRROR",
+                f"{sym}: required={required} but the symbol is "
+                + ("in the frozen baseline (every .so has it; "
+                   "required=True)" if want_required else
+                   "newer than the baseline (must be version-gated: "
+                   "required=False)")))
+        # Return type.
+        want_ret = C_VOID_RETURN.get(ret) or C_TO_CTYPES.get(ret)
+        if want_ret is None:
+            findings.append(Finding(
+                CORE_CPP, line, "ABI-MIRROR",
+                f"{sym}: unmappable C return type {ret!r} (extend the "
+                "C_TO_CTYPES table if this is intentional)"))
+        elif restype not in want_ret:
+            findings.append(Finding(
+                BASICS_PY, tline, "ABI-MIRROR",
+                f"{sym}: restype {restype} does not match the C return "
+                f"type {ret!r}"))
+        # Arity + per-position types.
+        if argtypes is None:
+            findings.append(Finding(
+                BASICS_PY, tline, "ABI-MIRROR",
+                f"{sym}: argtypes must be a literal list"))
+            continue
+        if len(argtypes) != len(params):
+            findings.append(Finding(
+                BASICS_PY, tline, "ABI-MIRROR",
+                f"{sym}: {len(argtypes)} argtypes registered but the C "
+                f"signature takes {len(params)} parameters "
+                f"({CORE_CPP}:{line})"))
+            continue
+        for i, (ct, py) in enumerate(zip(params, argtypes)):
+            want = C_TO_CTYPES.get(ct)
+            if want is None:
+                findings.append(Finding(
+                    CORE_CPP, line, "ABI-MIRROR",
+                    f"{sym}: parameter {i} has unmappable C type {ct!r}"))
+            elif py not in want:
+                findings.append(Finding(
+                    BASICS_PY, tline, "ABI-MIRROR",
+                    f"{sym}: argtypes[{i}] is {py} but the C parameter "
+                    f"is {ct!r} (accepts: {', '.join(sorted(want))})"))
+    for sym, (_, _, _, tline) in sorted(table.items()):
+        if sym not in exports:
+            findings.append(Finding(
+                BASICS_PY, tline, "ABI-MIRROR",
+                f"_C_API registers {sym} but core.cpp exports no such "
+                "symbol (stale entry?)"))
+    # Single registration site: any .argtypes/.restype assignment outside
+    # basics.py bypasses the table (and this rule's checking).
+    for sub in ("horovod_tpu", "scripts", "tests"):
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            rel = p.relative_to(root).as_posix()
+            if rel == BASICS_PY or rel == "scripts/check_invariants.py":
+                continue  # the table itself / this rule's own docstring
+            text = p.read_text(encoding="utf-8", errors="replace")
+            for m in ARGTYPES_ASSIGN_RE.finditer(text):
+                findings.append(Finding(
+                    rel, _line_of(text, m.start()), "ABI-MIRROR",
+                    f".{m.group(1)} assignment outside {BASICS_PY}'s "
+                    "_C_API table — register through "
+                    "basics.register_c_api() instead"))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=None,
@@ -622,6 +1058,8 @@ def main(argv=None):
     check_metrics(root, findings, ran)
     check_flags(root, findings, ran)
     check_enum_mirrors(root, findings, ran)
+    check_atomic_discipline(root, findings, ran)
+    check_abi_mirror(root, findings, ran)
     for f in findings:
         print(f)
     print(f"check_invariants: {len(findings)} finding(s); "

@@ -1,0 +1,465 @@
+#!/usr/bin/env python3
+"""Thread-role contract checker (stdlib-only; tier-1 via
+tests/test_static_analysis.py, CI via `make lint`).
+
+The PR-5 Clang Thread Safety Analysis layer machine-checks every MUTEX, but
+the lock-free subsystems built since — the flight-recorder ring, the
+perfstats/gradstats slots, the shm SPSC rings, the profiler sample ring —
+rely on single-driver contracts that used to live in comments. This checker
+enforces the HVDTPU_ROLE / HVDTPU_CALLED_ON annotations from
+native/common.h (grammar in docs/static-analysis.md "Thread roles"):
+
+  ROLE-COVERAGE  every public method declared in the lock-free subsystem
+                 headers (data_plane, shm_transport, transport, flightrec,
+                 perfstats, gradstats, profiler, timeline, tracing) carries
+                 exactly one role annotation — deleting an annotation is a
+                 lint failure, not a silent contract loss.
+  ROLE-CALL      no call from a function running as role A into a function
+                 pinned to role B (B != A, B != any). `any` bodies may only
+                 call `any` callees; when a bare callee name resolves to
+                 several annotated methods the call passes if ANY candidate
+                 is compatible (conservative: no false positives from
+                 same-named methods on different classes).
+  SIGNAL-SAFE    nothing reachable from an HVDTPU_ROLE(signal) /
+                 HVDTPU_CALLED_ON(signal) root may call malloc/free,
+                 take a lock, or touch stdio — the fatal-handler contract
+                 of the flight recorder and the SIGPROF sampler.
+
+Call graph: `clang++ -ast-dump=json` when a clang is on PATH (annotate
+attributes ride the AST), with a disciplined regex fallback otherwise —
+the fallback is the enforced baseline, not a degraded mode: roles are
+always extracted textually (the macros are this repo's own grammar) and
+clang only refines the edges. Exit 0 clean / 1 findings; ``--root`` points
+at a fixture tree (tests/data/lint_fixtures/), where absent files simply
+skip their rules, mirroring scripts/check_invariants.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+NATIVE_DIR = "horovod_tpu/native"
+
+# Headers whose PUBLIC method declarations must all carry a role
+# (the lock-free subsystem surface named by docs/static-analysis.md).
+COVERAGE_HEADERS = (
+    "data_plane.h", "shm_transport.h", "transport.h", "flightrec.h",
+    "perfstats.h", "gradstats.h", "profiler.h", "timeline.h", "tracing.h",
+)
+
+# Sources excluded from scanning entirely (test scaffolding, not runtime).
+EXCLUDE_FILES = {"unit_tests.cpp", "test_analyze.cpp"}
+
+ROLES = {"background", "user", "signal", "any"}
+
+ANNOT_RE = re.compile(r"HVDTPU_(ROLE|CALLED_ON)\((\w+)\)")
+ANNOT_LINE_RE = re.compile(r"^\s*HVDTPU_(?:ROLE|CALLED_ON)\(\w+\)\s*$")
+
+# A method declaration (or inline definition) line at class-body depth:
+# optional annotation macro, qualifiers, a return type, then NAME( .
+METHOD_RE = re.compile(
+    r"^\s*(?:HVDTPU_(?:ROLE|CALLED_ON)\((?P<role>\w+)\)\s+)?"
+    r"(?:static\s+|virtual\s+|explicit\s+|constexpr\s+|inline\s+)*"
+    r"(?:const\s+)?"
+    r"(?P<rtype>[A-Za-z_][\w:<>,]*)(?:\s*[*&]+)?"
+    r"\s+[*&]?(?P<name>\w+)\s*\(")
+
+# Words that rule a METHOD_RE match out (statements, not declarations).
+NON_TYPE_TOKENS = {
+    "return", "delete", "new", "throw", "else", "case", "goto", "using",
+    "typedef", "template", "friend", "operator", "sizeof", "if", "for",
+    "while", "switch", "do", "static_assert", "public", "private",
+    "protected", "namespace", "enum", "class", "struct", "define",
+}
+
+# Function/method definition start (file or class scope): used for body
+# extraction in the regex call graph.
+DEF_RE = re.compile(
+    r"^(?P<indent>\s*)(?:HVDTPU_(?:ROLE|CALLED_ON)\((?P<role>\w+)\)\s+)?"
+    r"(?:static\s+|virtual\s+|explicit\s+|constexpr\s+|inline\s+)*"
+    r"(?:const\s+)?"
+    r"[A-Za-z_][\w:<>,]*(?:\s*[*&]+)?"
+    r"\s+[*&]?(?:(?P<cls>\w+)::)?(?P<name>\w+)\s*\(",
+    re.M)
+
+CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+
+CALL_KEYWORDS = {
+    "if", "for", "while", "switch", "return", "sizeof", "catch", "assert",
+    "defined", "alignof", "decltype", "static_assert", "noexcept",
+}
+
+# Async-signal-unsafe vocabulary: allocation, locks, stdio, condvars.
+SIGNAL_UNSAFE_RE = re.compile(
+    r"\b(malloc|calloc|realloc|free|fopen|fclose|fprintf|printf|fputs|"
+    r"puts|fwrite|fread|fflush|fscanf|snprintf|sprintf|vsnprintf|vfprintf|"
+    r"MutexLock|lock_guard|unique_lock|make_unique|make_shared)\b"
+    r"|\bnew\b|\.Lock\s*\(|->Lock\s*\(|\.lock\s*\(|->lock\s*\("
+    r"|\.wait\s*\(|notify_one|notify_all")
+
+
+class Finding:
+    def __init__(self, path, line, rule, message):
+        self.path, self.line, self.rule, self.message = path, line, rule, message
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _line_of(text: str, offset: int) -> int:
+    return text.count("\n", 0, offset) + 1
+
+
+def strip_comments(text: str) -> str:
+    """Blank out //, /* */ comments and string/char literals, preserving
+    the newline structure so offsets keep mapping to line numbers."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            j = n if j == -1 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            j = n if j == -1 else j + 2
+            out.append("".join(ch if ch == "\n" else " "
+                               for ch in text[i:j]))
+            i = j
+        elif c in "\"'":
+            q, j = c, i + 1
+            while j < n and text[j] != q:
+                j += 2 if text[j] == "\\" else 1
+            j = min(j + 1, n)
+            out.append(q + " " * (j - i - 2) + (q if j - i >= 2 else ""))
+            i = j
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def match_brace(text: str, open_idx: int) -> int:
+    """Index just past the brace matching text[open_idx] ('{')."""
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def find_classes(text: str):
+    """Yield (name, is_struct, body_start, body_end) for every class/struct
+    definition (comment-stripped text)."""
+    for m in re.finditer(r"\b(?:class|struct)\s+([^;{]*)\{", text):
+        # `enum class` is not a class; `};`-less forward decls never match.
+        pre = text[max(0, m.start() - 8):m.start()]
+        if re.search(r"enum\s*$", pre):
+            continue
+        head = m.group(1)
+        words = [w for w in re.findall(r"\w+", re.sub(r"\([^)]*\)", "", head.split(":")[0]))
+                 if w not in ("final",)]
+        if not words:
+            continue
+        name = words[-1]
+        is_struct = text[m.start():m.start() + 6] == "struct"
+        body_start = m.end()  # just past '{'
+        body_end = match_brace(text, m.end() - 1) - 1
+        yield name, is_struct, body_start, body_end
+
+
+def depth_at_offsets(text: str):
+    """Brace depth at the start of each line (list indexed by line-1)."""
+    depths, depth = [], 0
+    for line in text.split("\n"):
+        depths.append(depth)
+        depth += line.count("{") - line.count("}")
+    return depths
+
+
+def scan_header_roles(rel, text, coverage, findings, roles_by_name,
+                      roles_by_qname):
+    """Collect declaration roles; when `coverage`, require every public
+    method declaration to carry one (ROLE-COVERAGE)."""
+    lines = text.split("\n")
+    depths = depth_at_offsets(text)
+    for cls, is_struct, body_start, body_end in find_classes(text):
+        first_line = _line_of(text, body_start)
+        last_line = _line_of(text, body_end)
+        class_depth = depths[first_line - 1] + 1 if "{" in lines[first_line - 1] else depths[first_line - 1]
+        # Depth of class-body top level == depth at the line after '{'.
+        if first_line < len(depths):
+            class_depth = depths[first_line]  # line after the one with '{'
+        access = "public" if is_struct else "private"
+        for ln in range(first_line, min(last_line, len(lines))):
+            raw = lines[ln]
+            stripped = raw.strip()
+            acc = re.match(r"^(public|private|protected)\s*:", stripped)
+            if acc:
+                access = acc.group(1)
+                continue
+            if depths[ln] != class_depth or not stripped or stripped.startswith("#"):
+                continue
+            m = METHOD_RE.match(raw)
+            if not m:
+                continue
+            name, rtype = m.group("name"), m.group("rtype")
+            first_tok = rtype.split("::")[0].split("<")[0]
+            if first_tok in NON_TYPE_TOKENS or name == cls or name == "operator":
+                continue
+            role = m.group("role")
+            if role is None and ln > 0 and ANNOT_LINE_RE.match(lines[ln - 1]):
+                role = ANNOT_RE.search(lines[ln - 1]).group(2)
+            if role is not None and role not in ROLES:
+                findings.append(Finding(
+                    rel, ln + 1, "ROLE-COVERAGE",
+                    f"{cls}::{name}: unknown role {role!r} (expected "
+                    f"background|user|signal|any)"))
+                continue
+            if role is None:
+                if coverage and access == "public":
+                    findings.append(Finding(
+                        rel, ln + 1, "ROLE-COVERAGE",
+                        f"public method {cls}::{name} has no thread-role "
+                        f"annotation (HVDTPU_CALLED_ON/HVDTPU_ROLE)"))
+                continue
+            roles_by_name.setdefault(name, set()).add(role)
+            roles_by_qname[(cls, name)] = role
+
+
+def extract_definitions(rel, text):
+    """Yield (cls, name, role_or_None, body, body_offset) for function
+    definitions found in comment-stripped text (regex engine)."""
+    lines = text.split("\n")
+    for m in DEF_RE.finditer(text):
+        name = m.group("name")
+        rtype_area = m.group(0)
+        first_tok = re.match(r"\s*(?:HVDTPU_\w+\(\w+\)\s+)?"
+                             r"(?:static\s+|virtual\s+|explicit\s+|"
+                             r"constexpr\s+|inline\s+)*(?:const\s+)?(\w+)",
+                             rtype_area)
+        if first_tok and first_tok.group(1) in NON_TYPE_TOKENS:
+            continue
+        # Find the matching ')' of the parameter list.
+        i, depth = m.end() - 1, 0
+        while i < len(text):
+            if text[i] == "(":
+                depth += 1
+            elif text[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        j = i + 1
+        # Skip trailing qualifiers / TSA macros up to '{', ';' or ':'.
+        while j < len(text):
+            rest = text[j:j + 64]
+            ws = re.match(r"\s+", rest)
+            if ws:
+                j += ws.end()
+                continue
+            tok = re.match(r"(const|noexcept|override|final|"
+                           r"EXCLUDES|REQUIRES|ACQUIRE|RELEASE|TRY_ACQUIRE|"
+                           r"RETURN_CAPABILITY|NO_THREAD_SAFETY_ANALYSIS|"
+                           r"HVDTPU_\w+)\b", rest)
+            if tok:
+                j += tok.end()
+                if j < len(text) and text[j] == "(":
+                    j = _skip_parens(text, j)
+                continue
+            break
+        if j >= len(text) or text[j] != "{":
+            continue  # declaration / ctor-init-list / something else
+        end = match_brace(text, j)
+        role = m.group("role")
+        if role is None:
+            # Long signatures carry the annotation alone on the line above.
+            ln = _line_of(text, m.start()) - 1  # 0-based line of the def
+            if ln >= 1 and ANNOT_LINE_RE.match(lines[ln - 1]):
+                role = ANNOT_RE.search(lines[ln - 1]).group(2)
+        yield (m.group("cls"), name, role, text[j:end], j)
+
+
+def _skip_parens(text, open_idx):
+    depth = 0
+    for i in range(open_idx, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def calls_in(body):
+    """Yield (callee_name, offset) for call-looking sites in a body."""
+    for m in CALL_RE.finditer(body):
+        name = m.group(1)
+        if name in CALL_KEYWORDS or name in NON_TYPE_TOKENS:
+            continue
+        yield name, m.start()
+
+
+def clang_call_graph(root, files):
+    """Best-effort clang -ast-dump=json call-edge extraction. Returns
+    {(file_rel, caller_name): set(callee_names)} or None when no clang is
+    available / the dump fails (the regex fallback is then authoritative)."""
+    exe = shutil.which("clang++") or shutil.which("clang")
+    if exe is None:
+        return None
+    edges = {}
+    try:
+        for rel in files:
+            proc = subprocess.run(
+                [exe, "-x", "c++", "-std=c++17", "-fsyntax-only",
+                 "-Xclang", "-ast-dump=json", str(root / rel)],
+                capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0 or not proc.stdout:
+                return None
+            ast = json.loads(proc.stdout)
+
+            def walk(node, current):
+                if not isinstance(node, dict):
+                    return
+                kind = node.get("kind", "")
+                if kind in ("FunctionDecl", "CXXMethodDecl") and \
+                        node.get("name"):
+                    current = node["name"]
+                    edges.setdefault((rel, current), set())
+                if kind in ("DeclRefExpr", "MemberExpr") and current:
+                    ref = node.get("referencedDecl") or {}
+                    nm = ref.get("name") or node.get("name")
+                    if nm:
+                        edges[(rel, current)].add(nm)
+                for child in node.get("inner", []) or []:
+                    walk(child, current)
+
+            walk(ast, None)
+    except Exception:
+        return None
+    return edges
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="tree to lint (default: this repo); used by the "
+                         "negative-fixture tests")
+    ap.add_argument("--graph", choices=("auto", "regex", "clang"),
+                    default="auto",
+                    help="call-graph engine (auto: clang when available)")
+    args = ap.parse_args(argv)
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parent.parent
+
+    native = root / NATIVE_DIR
+    files = []
+    if native.is_dir():
+        files = sorted(
+            p.relative_to(root).as_posix()
+            for p in list(native.glob("*.h")) + list(native.glob("*.cpp"))
+            if p.name not in EXCLUDE_FILES)
+
+    findings, ran = [], []
+    roles_by_name, roles_by_qname = {}, {}
+    texts = {}
+    for rel in files:
+        texts[rel] = strip_comments(
+            (root / rel).read_text(encoding="utf-8", errors="replace"))
+
+    # --- ROLE-COVERAGE + declaration-role harvest --------------------------
+    headers = [f for f in files if f.endswith(".h")]
+    if headers:
+        ran.append("ROLE-COVERAGE")
+        for rel in headers:
+            coverage = rel.rsplit("/", 1)[-1] in COVERAGE_HEADERS
+            scan_header_roles(rel, texts[rel], coverage, findings,
+                              roles_by_name, roles_by_qname)
+
+    # --- definition harvest (bodies + definition-site roles) ---------------
+    defs = []  # (rel, cls, name, role, body, offset)
+    for rel in files:
+        for cls, name, role, body, off in extract_definitions(rel, texts[rel]):
+            if role is None:
+                role = roles_by_qname.get((cls, name))
+            if role is None:
+                cand = roles_by_name.get(name, set())
+                role = next(iter(cand)) if len(cand) == 1 else None
+            defs.append((rel, cls, name, role, body, off))
+
+    clang_edges = None
+    if files and args.graph in ("auto", "clang"):
+        clang_edges = clang_call_graph(root, files)
+    engine = "clang" if clang_edges is not None else "regex"
+
+    # --- ROLE-CALL ---------------------------------------------------------
+    if files:
+        ran.append("ROLE-CALL")
+        for rel, cls, name, role, body, off in defs:
+            if role is None:
+                continue  # unannotated bodies are out of contract scope
+            for callee, coff in calls_in(body):
+                callee_roles = roles_by_name.get(callee)
+                if not callee_roles or callee == name:
+                    continue
+                if "any" in callee_roles or role in callee_roles:
+                    continue
+                qual = f"{cls}::{name}" if cls else name
+                findings.append(Finding(
+                    rel, _line_of(texts[rel], off + coff), "ROLE-CALL",
+                    f"{qual} (role {role}) calls {callee} (pinned to "
+                    f"{'/'.join(sorted(callee_roles))})"))
+
+    # --- SIGNAL-SAFE -------------------------------------------------------
+    if files:
+        ran.append("SIGNAL-SAFE")
+        by_name = {}
+        for d in defs:
+            by_name.setdefault(d[2], []).append(d)
+        frontier = [d for d in defs if d[3] == "signal"]
+        seen = {(d[0], d[2]) for d in frontier}
+        reach = list(frontier)
+        while frontier:
+            nxt = []
+            for rel, cls, name, role, body, off in frontier:
+                for callee, _ in calls_in(body):
+                    for d in by_name.get(callee, []):
+                        key = (d[0], d[2])
+                        if key not in seen:
+                            seen.add(key)
+                            nxt.append(d)
+                            reach.append(d)
+            frontier = nxt
+        for rel, cls, name, role, body, off in reach:
+            for m in SIGNAL_UNSAFE_RE.finditer(body):
+                qual = f"{cls}::{name}" if cls else name
+                findings.append(Finding(
+                    rel, _line_of(texts[rel], off + m.start()),
+                    "SIGNAL-SAFE",
+                    f"{qual} is reachable from a signal-role root but "
+                    f"calls async-signal-unsafe {m.group(0).strip()!r}"))
+
+    for f in findings:
+        print(f)
+    print(f"check_threadroles: {len(findings)} finding(s); "
+          f"rules run: {', '.join(ran) if ran else 'none'}; "
+          f"graph={engine if files else 'n/a'}",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

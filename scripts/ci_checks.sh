@@ -30,6 +30,26 @@ run_check() {
 }
 
 run_check "lint"        make lint
+# `make lint` proves exit codes; this leg proves the rules themselves are
+# ALIVE — each checker's stderr summary must list every rule family
+# (thread roles, atomics discipline, ABI parity, plus the original
+# invariant rules), so a rule silently skipping (input file moved, regex
+# rotted) fails CI even though the tree is "clean".
+lint_rules_active() {
+  local inv roles
+  inv=$(python3 scripts/check_invariants.py 2>&1 >/dev/null) || return 1
+  roles=$(python3 scripts/check_threadroles.py 2>&1 >/dev/null) || return 1
+  local r
+  for r in ENV-DECL ENV-DOC ENV-RAW MET-DOC FLAG-DOC ENUM-MIRROR \
+           ATOMIC-DISCIPLINE ABI-MIRROR; do
+    echo "${inv}" | grep -q "${r}" || { echo "rule ${r} did not run"; return 1; }
+  done
+  for r in ROLE-COVERAGE ROLE-CALL SIGNAL-SAFE; do
+    echo "${roles}" | grep -q "${r}" || { echo "rule ${r} did not run"; return 1; }
+  done
+  return 0
+}
+run_check "lint-rules"  lint_rules_active
 run_check "check"       make check
 run_check "check-tsan"  make check-tsan
 run_check "check-asan"  make check-asan

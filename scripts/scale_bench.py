@@ -96,10 +96,9 @@ def parse_metrics(text: str) -> dict:
 # --------------------------------------------------------------------------
 
 def run_worker(args) -> int:
+    # load_lib registers the whole C API from the shared _C_API table
+    # (horovod_tpu/basics.py), metrics_dump included.
     lib = load_lib(args.lib)
-    lib.hvdtpu_metrics_dump.restype = ctypes.c_longlong
-    lib.hvdtpu_metrics_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.c_longlong]
     rank, n = args.rank, args.world
     core = lib.hvdtpu_create(rank, n, rank, n, 0, 1, b"127.0.0.1", args.port,
                              b"127.0.0.1", args.cycle_time_ms,

@@ -1,0 +1,4 @@
+"""Fixture ctypes table dropping hvdtpu_enqueue's second parameter."""
+_C_API = (
+    ("hvdtpu_enqueue", c_int, [c_void_p], True),
+)

@@ -1,0 +1,8 @@
+// Fixture: two-parameter export registered with one argtype.
+extern "C" {
+
+int hvdtpu_enqueue(void* h, long long n) {
+  return h != nullptr && n > 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,4 @@
+"""Fixture table hard-requiring a symbol newer than the frozen baseline."""
+_C_API = (
+    ("hvdtpu_fixture_probe", c_int, [c_int], True),
+)

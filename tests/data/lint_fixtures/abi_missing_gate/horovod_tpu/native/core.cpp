@@ -1,0 +1,8 @@
+// Fixture: post-baseline export hard-required instead of version-gated.
+extern "C" {
+
+int hvdtpu_fixture_probe(int x) {
+  return x;
+}
+
+}  // extern "C"

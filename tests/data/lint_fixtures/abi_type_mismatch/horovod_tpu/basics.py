@@ -1,0 +1,4 @@
+"""Fixture ctypes table with the wrong argtype for hvdtpu_set_chaos."""
+_C_API = (
+    ("hvdtpu_set_chaos", None, [c_int], True),
+)

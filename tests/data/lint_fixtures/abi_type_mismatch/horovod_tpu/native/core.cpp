@@ -1,0 +1,8 @@
+// Fixture: double parameter registered as c_int.
+extern "C" {
+
+void hvdtpu_set_chaos(double p) {
+  (void)p;
+}
+
+}  // extern "C"

@@ -1,0 +1,4 @@
+"""Fixture ctypes table missing the hvdtpu_fixture_new export."""
+_C_API = (
+    ("hvdtpu_create", c_int, [c_int, c_int], True),
+)

@@ -1,0 +1,12 @@
+// Fixture: one registered export, one missing from the _C_API table.
+extern "C" {
+
+int hvdtpu_create(int rank, int size) {
+  return rank + size;
+}
+
+int hvdtpu_fixture_new(int h) {
+  return h;
+}
+
+}  // extern "C"

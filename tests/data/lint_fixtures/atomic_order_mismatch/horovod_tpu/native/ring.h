@@ -1,0 +1,11 @@
+// Fixture: a relaxed-counter atomic read with the default (seq_cst) order.
+#pragma once
+#include <atomic>
+
+class Ring {
+ public:
+  int Get() const { return count_.load(); }
+
+ private:
+  std::atomic<int> count_{0};  // atomic: relaxed-counter
+};

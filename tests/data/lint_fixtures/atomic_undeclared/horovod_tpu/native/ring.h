@@ -1,0 +1,11 @@
+// Fixture: an atomic member with no ordering-protocol declaration.
+#pragma once
+#include <atomic>
+
+class Ring {
+ public:
+  void Push();
+
+ private:
+  std::atomic<int> count_{0};
+};

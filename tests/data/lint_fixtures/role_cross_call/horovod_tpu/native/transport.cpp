@@ -1,0 +1,8 @@
+// Fixture: a background-role body calling a user-pinned method.
+#include "transport.h"
+
+void Transport::Pump() {
+  Configure();
+}
+
+void Transport::Configure() {}

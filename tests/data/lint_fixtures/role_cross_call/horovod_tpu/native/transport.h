@@ -1,0 +1,10 @@
+// Fixture: fully-annotated coverage header; the .cpp crosses roles.
+#pragma once
+
+class Transport {
+ public:
+  HVDTPU_CALLED_ON(background)
+  void Pump();
+  HVDTPU_CALLED_ON(user)
+  void Configure();
+};

@@ -1,0 +1,12 @@
+// Fixture: coverage header with one annotated and one bare public method.
+#pragma once
+
+class ShmTransport {
+ public:
+  HVDTPU_CALLED_ON(background)
+  int Send(int n);
+  int Recv(int n);
+
+ private:
+  int x_;
+};

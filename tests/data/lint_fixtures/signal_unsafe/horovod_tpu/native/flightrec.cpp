@@ -1,0 +1,13 @@
+// Fixture: a signal-role root transitively reaching malloc.
+#include <cstdlib>
+
+static void WriteRing(int n) {
+  void* p = malloc(16);
+  (void)p;
+  (void)n;
+}
+
+HVDTPU_ROLE(signal)
+void FlightSignalHandler(int signo) {
+  WriteRing(signo);
+}

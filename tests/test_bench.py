@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 
 from conftest import REPO_ROOT, subprocess_env
 
@@ -28,6 +30,7 @@ _KNOBS = {
 }
 
 
+@pytest.mark.slow  # ~90 s even with the tiny knob set: full model sweep through bench.py
 def test_bench_cli_contract():
     env = subprocess_env()
     env.update(_KNOBS)

@@ -48,6 +48,7 @@ def _scan(results, *markers):
                 raise AssertionError(f"rank {rank} sanitizer report: {line}")
 
 
+@pytest.mark.slow  # ~40 s: sanitizer rebuild + 2-rank world; tier-1 keeps the tsan unit-test + pipelined smokes
 def test_tsan_process_mode():
     rt = _gcc_file("libtsan.so")
     if not rt:
@@ -88,6 +89,7 @@ def test_tsan_native_unit_tests():
         assert "ThreadSanitizer" not in line, line
 
 
+@pytest.mark.slow  # ~100 s: full ASan+UBSan unit-test binary; tier-1 keeps the tsan unit-test + pipelined smokes
 def test_asan_ubsan_native_unit_tests():
     """ASan+UBSan build of the same native unit-test binary (ISSUE 2
     satellite): the shm rings' mmap'ed cursor arithmetic and the segment
@@ -151,6 +153,7 @@ def test_tsan_pipelined_allreduce():
     assert results[0][1].count('"bytes"') == 2, results[0][1]
 
 
+@pytest.mark.slow  # ~45 s: standalone UBSan unit-test binary; tier-1 keeps the tsan unit-test + pipelined smokes
 def test_ubsan_native_unit_tests():
     """Standalone UBSan build of the native unit-test binary (ISSUE 5
     satellite): -fsanitize=undefined alone with -fno-sanitize-recover=all,
@@ -165,6 +168,7 @@ def test_ubsan_native_unit_tests():
         assert "runtime error" not in line, line
 
 
+@pytest.mark.slow  # ~55 s: UBSan rebuild + 2-rank world; tier-1 keeps the tsan unit-test + pipelined smokes
 def test_ubsan_process_mode():
     """The full process-mode op menu against the UBSan-only .so. libubsan
     is preloaded for the uninstrumented python host; any runtime-error
@@ -183,6 +187,7 @@ def test_ubsan_process_mode():
     _scan(results, "runtime error")
 
 
+@pytest.mark.slow  # ~175 s: ASan rebuild + 2-rank world; tier-1 keeps the tsan unit-test + pipelined smokes
 def test_asan_ubsan_process_mode():
     rt = _gcc_file("libasan.so")
     stdcxx = _gcc_file("libstdc++.so")

@@ -1,14 +1,19 @@
 """Tier-1 enforcement of the static correctness layer (docs/static-analysis.md).
 
-Three layers, one gate each:
+Four layers, one gate each:
 
 * the cross-language invariant linter (``scripts/check_invariants.py``) must
   exit 0 on the tree with its FULL rule set active — a renamed env var, an
-  undocumented metric or flag, or a drifted wire-frame tag fails here
-  instead of corrupting a 256-chip job;
-* every linter rule must actually fire — proven against the negative
-  fixtures under ``tests/data/lint_fixtures/``, down to the file:line the
-  finding anchors on;
+  undocumented metric or flag, a drifted wire-frame tag, an atomic op off
+  its declared ordering protocol, or a C-export/ctypes-table mismatch fails
+  here instead of corrupting a 256-chip job;
+* the thread-role checker (``scripts/check_threadroles.py``) must exit 0
+  with ROLE-COVERAGE / ROLE-CALL / SIGNAL-SAFE all active — deleting a
+  single HVDTPU_CALLED_ON annotation is a lint failure, not a silent
+  contract loss;
+* every rule of both checkers must actually fire — proven against the
+  negative fixtures under ``tests/data/lint_fixtures/``, down to the
+  file:line the finding anchors on;
 * the clang-dependent targets (``make analyze`` / ``make tidy``) must at
   minimum skip cleanly on clang-less boxes (on CI, with clang installed,
   they are the thread-safety / clang-tidy gates).
@@ -18,6 +23,7 @@ No clang, jax, or network required anywhere in this file.
 
 import os
 import re
+import shutil
 import subprocess
 import sys
 
@@ -25,13 +31,15 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LINTER = os.path.join(REPO, "scripts", "check_invariants.py")
+ROLE_CHECKER = os.path.join(REPO, "scripts", "check_threadroles.py")
 FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
 NATIVE = os.path.join(REPO, "horovod_tpu", "native")
 
 # Every rule the linter must run on the real tree. ENUM-MIRROR lists its
 # enum pairs so a silently-unparseable enum (file moved, regex rotted)
 # fails loudly here rather than skipping the check forever.
-EXPECTED_RULES = ["ENV-DECL", "ENV-DOC", "ENV-RAW", "MET-DOC", "FLAG-DOC"]
+EXPECTED_RULES = ["ENV-DECL", "ENV-DOC", "ENV-RAW", "MET-DOC", "FLAG-DOC",
+                  "ATOMIC-DISCIPLINE", "ABI-MIRROR"]
 EXPECTED_ENUM_PAIRS = ["DataType", "OpType", "CtrlMsg", "ResponseType",
                        "WireCompression", "ReduceOp", "AllreduceAlgo",
                        "HierMode"]
@@ -99,6 +107,33 @@ FIXTURE_CASES = [
         ("horovod_tpu/runner/launch.py", 9, "FLAG-DOC", "--prose-only-flag"),
         ("docs/runner.md", 11, "FLAG-DOC", "--stale-flag"),
     ]),
+    ("atomic_undeclared", 1, [
+        ("horovod_tpu/native/ring.h", 10, "ATOMIC-DISCIPLINE",
+         "count_ declares no ordering protocol"),
+    ]),
+    ("atomic_order_mismatch", 1, [
+        ("horovod_tpu/native/ring.h", 7, "ATOMIC-DISCIPLINE",
+         "count_.load: no explicit memory_order (defaults to seq_cst)"),
+    ]),
+    ("abi_unregistered_export", 1, [
+        ("horovod_tpu/native/core.cpp", 8, "ABI-MIRROR",
+         "export hvdtpu_fixture_new has no _C_API registration"),
+    ]),
+    ("abi_arity_mismatch", 1, [
+        ("horovod_tpu/basics.py", 3, "ABI-MIRROR",
+         "hvdtpu_enqueue: 1 argtypes registered but the C signature takes "
+         "2 parameters"),
+    ]),
+    ("abi_type_mismatch", 1, [
+        ("horovod_tpu/basics.py", 3, "ABI-MIRROR",
+         "hvdtpu_set_chaos: argtypes[0] is c_int but the C parameter is "
+         "'double'"),
+    ]),
+    ("abi_missing_gate", 1, [
+        ("horovod_tpu/basics.py", 3, "ABI-MIRROR",
+         "hvdtpu_fixture_probe: required=True but the symbol is newer than "
+         "the baseline"),
+    ]),
 ]
 
 
@@ -161,6 +196,101 @@ class TestRawEnvReadDetector:
                "b = env.get('HVDTPU_X')\n"               # plain dict
                "c = os.environ.get(key)\n")              # dynamic key
         assert self._findings(src) == []
+
+
+# (fixture dir, [(relpath, line, rule, message-fragment)]) — exit 1 each.
+ROLE_FIXTURE_CASES = [
+    ("role_missing_annotation", [
+        ("horovod_tpu/native/shm_transport.h", 8, "ROLE-COVERAGE",
+         "public method ShmTransport::Recv has no thread-role annotation"),
+    ]),
+    ("role_cross_call", [
+        ("horovod_tpu/native/transport.cpp", 5, "ROLE-CALL",
+         "Transport::Pump (role background) calls Configure (pinned to "
+         "user)"),
+    ]),
+    ("signal_unsafe", [
+        ("horovod_tpu/native/flightrec.cpp", 5, "SIGNAL-SAFE",
+         "WriteRing is reachable from a signal-role root but calls "
+         "async-signal-unsafe 'malloc'"),
+    ]),
+]
+
+
+def run_role_checker(root=None):
+    cmd = [sys.executable, ROLE_CHECKER]
+    if root is not None:
+        cmd += ["--root", root]
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+
+
+class TestThreadRoles:
+    """The concurrency-contract checker (docs/static-analysis.md
+    "Thread roles"): clean on the real tree with all three rules active,
+    and every rule proven to fire on its negative fixture."""
+
+    def test_clean_on_the_tree_with_all_rules(self):
+        r = run_role_checker()
+        assert r.returncode == 0, \
+            f"thread-role contract drift:\n{r.stdout}{r.stderr}"
+        for rule in ("ROLE-COVERAGE", "ROLE-CALL", "SIGNAL-SAFE"):
+            assert rule in r.stderr, f"rule {rule} did not run: {r.stderr}"
+
+    @pytest.mark.parametrize("name,expected", ROLE_FIXTURE_CASES,
+                             ids=[c[0] for c in ROLE_FIXTURE_CASES])
+    def test_fixture(self, name, expected):
+        r = run_role_checker(os.path.join(FIXTURES, name))
+        assert r.returncode == 1, \
+            f"{name}: exit {r.returncode}, wanted 1:\n{r.stdout}"
+        for rel, line, rule, frag in expected:
+            want = f"{rel}:{line}: [{rule}]"
+            hit = [l for l in r.stdout.splitlines()
+                   if l.startswith(want) and frag in l]
+            assert hit, (f"{name}: expected a finding '{want} ...{frag}...', "
+                         f"got:\n{r.stdout}")
+        assert len(r.stdout.strip().splitlines()) == len(expected), \
+            f"{name}: unexpected extra findings:\n{r.stdout}"
+
+
+class TestDeletionTripwires:
+    """The acceptance contract in reverse: strip ONE annotation / ONE table
+    entry from the real tree (copied aside) and the matching checker must go
+    red. Guards against the rules rotting into always-green."""
+
+    def _native_copy(self, tmp_path):
+        dst = tmp_path / "horovod_tpu" / "native"
+        dst.parent.mkdir(parents=True)
+        shutil.copytree(NATIVE, dst,
+                        ignore=shutil.ignore_patterns(
+                            "*.o", "*.so", "build-*", "unit_tests"))
+        return tmp_path
+
+    def test_deleting_one_role_annotation_fails_the_checker(self, tmp_path):
+        root = self._native_copy(tmp_path)
+        hdr = root / "horovod_tpu" / "native" / "shm_transport.h"
+        text = hdr.read_text()
+        lines = text.splitlines(keepends=True)
+        victim = next(i for i, l in enumerate(lines)
+                      if "HVDTPU_CALLED_ON(" in l)
+        del lines[victim]
+        hdr.write_text("".join(lines))
+        r = run_role_checker(str(root))
+        assert r.returncode != 0, \
+            "deleting an annotation must fail ROLE-COVERAGE"
+        assert "[ROLE-COVERAGE]" in r.stdout and "shm_transport.h" in r.stdout
+
+    def test_deleting_one_argtypes_entry_fails_the_linter(self, tmp_path):
+        root = self._native_copy(tmp_path)
+        src = os.path.join(REPO, "horovod_tpu", "basics.py")
+        lines = open(src).read().splitlines(keepends=True)
+        victim = next(i for i, l in enumerate(lines)
+                      if '"hvdtpu_wire_stats"' in l)
+        del lines[victim]
+        (root / "horovod_tpu" / "basics.py").write_text("".join(lines))
+        r = run_linter(str(root))
+        assert r.returncode != 0, \
+            "deleting a _C_API entry must fail ABI-MIRROR"
+        assert "[ABI-MIRROR]" in r.stdout and "hvdtpu_wire_stats" in r.stdout
 
 
 class TestClangTargets:

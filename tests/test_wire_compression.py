@@ -27,19 +27,11 @@ INT8, INT4 = 2, 3  # hvdtpu::WireCompression
 
 
 def _wire_lib():
+    # The shared _C_API table covers the wire codec trio (version-gated);
+    # registering through it keeps this file out of the ABI-MIRROR lint's
+    # "registration outside the canonical table" findings.
     from horovod_tpu import basics
-    lib = ctypes.CDLL(basics._ensure_built())
-    lib.hvdtpu_wire_compressed_bytes.restype = ctypes.c_longlong
-    lib.hvdtpu_wire_compressed_bytes.argtypes = [ctypes.c_int,
-                                                 ctypes.c_longlong]
-    lib.hvdtpu_wire_compress.restype = ctypes.c_int
-    lib.hvdtpu_wire_compress.argtypes = [
-        ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
-        ctypes.c_void_p]
-    lib.hvdtpu_wire_decompress.restype = ctypes.c_int
-    lib.hvdtpu_wire_decompress.argtypes = [
-        ctypes.c_int, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p]
-    return lib
+    return basics.register_c_api(ctypes.CDLL(basics._ensure_built()))
 
 
 def _native_compress(lib, mode, x, residual=None):
